@@ -1,0 +1,65 @@
+"""smartbft_tpu — a TPU-native Byzantine fault-tolerant SMR framework.
+
+A from-scratch re-design of the capabilities of pkucode/SmartBFT (surveyed in
+/root/repo/SURVEY.md): a PBFT-style three-phase consensus core with leader
+rotation, deterministic blacklisting, a full view-change sub-protocol,
+heartbeat failure detection, state transfer, dynamic reconfiguration, and a
+crash-tolerant segmented WAL — with the signature-verification hot path
+(ECDSA P-256 / Ed25519 quorum checks) batched and executed on TPU via JAX.
+
+Layering (top-down, mirrors SURVEY.md §1):
+  consensus.Consensus  — composition root / public facade
+  api                  — the 10-interface plugin SPI the embedder implements
+  core                 — Controller, View, ViewChanger, Pool, Batcher,
+                         HeartbeatMonitor, StateCollector, PersistedState
+  messages / codec     — canonical wire & persistence schema
+  wal                  — durable segmented log
+  crypto + ops         — TPU batch Signer/Verifier (the point of the project)
+  parallel             — device-mesh sharding for the verify kernels
+  testing              — in-process fault-injection network harness
+"""
+
+__version__ = "0.1.0"
+
+from .config import DEFAULT_CONFIG, Configuration
+from .messages import (
+    Commit,
+    HeartBeat,
+    HeartBeatResponse,
+    NewView,
+    PrePrepare,
+    Prepare,
+    Proposal,
+    Signature,
+    SignedViewData,
+    StateTransferRequest,
+    StateTransferResponse,
+    ViewChange,
+    ViewData,
+    ViewMetadata,
+)
+from .types import Checkpoint, Decision, Reconfig, RequestInfo, SyncResponse
+
+__all__ = [
+    "Configuration",
+    "DEFAULT_CONFIG",
+    "Commit",
+    "HeartBeat",
+    "HeartBeatResponse",
+    "NewView",
+    "PrePrepare",
+    "Prepare",
+    "Proposal",
+    "Signature",
+    "SignedViewData",
+    "StateTransferRequest",
+    "StateTransferResponse",
+    "ViewChange",
+    "ViewData",
+    "ViewMetadata",
+    "Checkpoint",
+    "Decision",
+    "Reconfig",
+    "RequestInfo",
+    "SyncResponse",
+]

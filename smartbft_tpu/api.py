@@ -1,0 +1,165 @@
+"""Plugin SPI: the boundary the embedding application implements.
+
+Re-design of /root/reference/pkg/api/dependencies.go:14-99.  Ten abstract
+interfaces plus one deliberate extension: :class:`Verifier` gains a *batch*
+method, ``verify_consenter_sigs_batch``, so the protocol core is
+batching-native from day one — the reference fans out one goroutine per
+commit signature (/root/reference/internal/bft/view.go:537-541); here the
+View accumulates votes and flushes them as one call, which the TPU verifier
+executes as a single vmap'd kernel launch.
+
+All methods are synchronous; implementations that need concurrency (the TPU
+bridge) do their own batching/queueing internally.  The consensus core runs
+on a single asyncio loop and calls potentially-blocking SPI methods through
+``asyncio.to_thread`` where latency matters (sync, batch verify).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Optional, Sequence
+
+from .messages import Message, Proposal, Signature
+from .types import Decision, Reconfig, RequestInfo, SyncResponse
+
+
+class Application(abc.ABC):
+    """Receives consented proposals (dependencies.go:14-19)."""
+
+    @abc.abstractmethod
+    def deliver(self, proposal: Proposal, signatures: Sequence[Signature]) -> Reconfig:
+        """Persist the decided proposal; returns reconfiguration info."""
+
+
+class Comm(abc.ABC):
+    """Node-to-node transport, supplied by the embedder (dependencies.go:22-30)."""
+
+    @abc.abstractmethod
+    def send_consensus(self, target_id: int, msg: Message) -> None: ...
+
+    @abc.abstractmethod
+    def send_transaction(self, target_id: int, request: bytes) -> None: ...
+
+    @abc.abstractmethod
+    def nodes(self) -> list[int]:
+        """Participating node ids (return a fresh copy)."""
+
+
+class Assembler(abc.ABC):
+    """Creates proposals from batched requests (dependencies.go:33-37)."""
+
+    @abc.abstractmethod
+    def assemble_proposal(self, metadata: bytes, requests: Sequence[bytes]) -> Proposal: ...
+
+
+class WriteAheadLog(abc.ABC):
+    """Durable log (dependencies.go:40-44)."""
+
+    @abc.abstractmethod
+    def append(self, entry: bytes, truncate_to: bool) -> None: ...
+
+
+class Signer(abc.ABC):
+    """Signs data / proposals (dependencies.go:47-52)."""
+
+    @abc.abstractmethod
+    def sign(self, data: bytes) -> bytes: ...
+
+    @abc.abstractmethod
+    def sign_proposal(self, proposal: Proposal, auxiliary_input: bytes) -> Signature: ...
+
+
+class Verifier(abc.ABC):
+    """Validates requests, proposals and signatures (dependencies.go:55-71).
+
+    ``verify_consenter_sigs_batch`` is the TPU seam: the default
+    implementation loops over :meth:`verify_consenter_sig`, while the TPU
+    verifier overrides it with one batched kernel launch.
+    """
+
+    @abc.abstractmethod
+    def verify_proposal(self, proposal: Proposal) -> list[RequestInfo]:
+        """Raises on invalid proposal; returns the included requests' info."""
+
+    @abc.abstractmethod
+    def verify_request(self, raw_request: bytes) -> RequestInfo:
+        """Raises on invalid request; returns its info."""
+
+    @abc.abstractmethod
+    def verify_consenter_sig(self, signature: Signature, proposal: Proposal) -> bytes:
+        """Raises on invalid signature; returns the signature's auxiliary data."""
+
+    @abc.abstractmethod
+    def verify_signature(self, signature: Signature) -> None:
+        """Raises on invalid signature."""
+
+    @abc.abstractmethod
+    def verification_sequence(self) -> int:
+        """Current config-epoch for request re-validation."""
+
+    @abc.abstractmethod
+    def requests_from_proposal(self, proposal: Proposal) -> list[RequestInfo]: ...
+
+    @abc.abstractmethod
+    def auxiliary_data(self, msg: bytes) -> bytes:
+        """Extracts auxiliary data from a signature's message."""
+
+    # --- batching extension (not in the reference SPI) ---
+
+    def verify_consenter_sigs_batch(
+        self, signatures: Sequence[Signature], proposal: Proposal
+    ) -> list[Optional[bytes]]:
+        """Verify many consenter signatures over one proposal.
+
+        Returns, per signature, its auxiliary data on success or ``None`` on
+        failure — never raises for individual bad signatures.  Override in
+        batched (TPU) verifiers; the default is the sequential fallback.
+        """
+        out: list[Optional[bytes]] = []
+        for sig in signatures:
+            try:
+                out.append(self.verify_consenter_sig(sig, proposal))
+            except Exception:
+                out.append(None)
+        return out
+
+
+class MembershipNotifier(abc.ABC):
+    """Signals membership change in the last proposal (dependencies.go:74-78)."""
+
+    @abc.abstractmethod
+    def membership_change(self) -> bool: ...
+
+
+class RequestInspector(abc.ABC):
+    """Extracts (client id, request id) from a raw request (dependencies.go:81-85)."""
+
+    @abc.abstractmethod
+    def request_id(self, raw_request: bytes) -> RequestInfo: ...
+
+
+class Synchronizer(abc.ABC):
+    """Fetches remote decisions to catch this replica up (dependencies.go:88-93)."""
+
+    @abc.abstractmethod
+    def sync(self) -> SyncResponse: ...
+
+
+class Logger(abc.ABC):
+    """Structured-logging contract (dependencies.go:96-99)."""
+
+    @abc.abstractmethod
+    def debugf(self, template: str, *args) -> None: ...
+
+    @abc.abstractmethod
+    def infof(self, template: str, *args) -> None: ...
+
+    @abc.abstractmethod
+    def warnf(self, template: str, *args) -> None: ...
+
+    @abc.abstractmethod
+    def errorf(self, template: str, *args) -> None: ...
+
+    @abc.abstractmethod
+    def panicf(self, template: str, *args) -> None:
+        """Log and raise."""

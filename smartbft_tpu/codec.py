@@ -1,0 +1,236 @@
+"""Canonical, deterministic binary codec for wire messages and digests.
+
+The reference serializes wire messages with protobuf and computes digests over
+ASN.1-marshaled structures (/root/reference/pkg/types/types.go:50-69,
+/root/reference/internal/bft/util.go:557-579).  Protobuf encoding is not
+byte-deterministic across implementations, and the blacklist/digest logic of
+the protocol requires *byte-exact* agreement between replicas.  This codec is
+therefore a from-scratch, reflection-driven, fully canonical encoding:
+
+- ``int``   -> 8-byte big-endian unsigned (all protocol ints are uint64)
+- ``bool``  -> 1 byte (0/1)
+- ``bytes`` -> u32 length + payload
+- ``str``   -> u32 length + UTF-8 payload
+- ``list[X]``      -> u32 count + each element
+- ``Optional[Msg]``-> 1-byte presence flag + body
+- nested dataclass -> fields in declaration order, inline
+
+Every encodable message is a frozen dataclass registered via ``@wiremsg``.
+Oneof-style unions (the top-level consensus ``Message``) are encoded as a
+1-byte type tag + body; tags are assigned at registration time and are part
+of the wire format, so registration order is stable and append-only.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+import typing
+from typing import Any, Optional, Type, TypeVar, get_args, get_origin
+
+T = TypeVar("T")
+
+_U64 = struct.Struct(">Q")
+_U32 = struct.Struct(">I")
+
+# registry: class -> tag, tag -> class (for union-tagged encoding)
+_TAG_BY_CLS: dict[type, int] = {}
+_CLS_BY_TAG: dict[int, type] = {}
+_NEXT_TAG = [1]
+
+# cached per-class field plans: list of (attr_name, encoder, decoder)
+_PLAN: dict[type, list[tuple[str, Any, Any]]] = {}
+
+
+class CodecError(Exception):
+    pass
+
+
+def wiremsg(cls: Type[T]) -> Type[T]:
+    """Class decorator: freeze as dataclass and register a wire tag."""
+    cls = dataclasses.dataclass(frozen=True)(cls)
+    tag = _NEXT_TAG[0]
+    _NEXT_TAG[0] += 1
+    _TAG_BY_CLS[cls] = tag
+    _CLS_BY_TAG[tag] = cls
+    return cls
+
+
+def _enc_int(out: bytearray, v: int) -> None:
+    if v < 0:
+        raise CodecError(f"negative int not encodable: {v}")
+    out += _U64.pack(v)
+
+
+def _dec_int(buf: memoryview, off: int) -> tuple[int, int]:
+    return _U64.unpack_from(buf, off)[0], off + 8
+
+
+def _enc_bool(out: bytearray, v: bool) -> None:
+    out.append(1 if v else 0)
+
+
+def _dec_bool(buf: memoryview, off: int) -> tuple[bool, int]:
+    return buf[off] != 0, off + 1
+
+
+def _enc_bytes(out: bytearray, v: bytes) -> None:
+    out += _U32.pack(len(v))
+    out += v
+
+
+def _dec_bytes(buf: memoryview, off: int) -> tuple[bytes, int]:
+    n = _U32.unpack_from(buf, off)[0]
+    off += 4
+    return bytes(buf[off : off + n]), off + n
+
+
+def _enc_str(out: bytearray, v: str) -> None:
+    _enc_bytes(out, v.encode("utf-8"))
+
+
+def _dec_str(buf: memoryview, off: int) -> tuple[str, int]:
+    b, off = _dec_bytes(buf, off)
+    return b.decode("utf-8"), off
+
+
+def _make_list_codec(elem_enc, elem_dec):
+    def enc(out: bytearray, v: list) -> None:
+        out += _U32.pack(len(v))
+        for e in v:
+            elem_enc(out, e)
+
+    def dec(buf: memoryview, off: int) -> tuple[list, int]:
+        n = _U32.unpack_from(buf, off)[0]
+        off += 4
+        res = []
+        for _ in range(n):
+            e, off = elem_dec(buf, off)
+            res.append(e)
+        return res, off
+
+    return enc, dec
+
+
+def _make_optional_codec(elem_enc, elem_dec):
+    def enc(out: bytearray, v) -> None:
+        if v is None:
+            out.append(0)
+        else:
+            out.append(1)
+            elem_enc(out, v)
+
+    def dec(buf: memoryview, off: int):
+        flag = buf[off]
+        off += 1
+        if flag == 0:
+            return None, off
+        return elem_dec(buf, off)
+
+    return enc, dec
+
+
+def _make_msg_codec(cls):
+    def enc(out: bytearray, v) -> None:
+        if type(v) is not cls:
+            raise CodecError(f"expected {cls.__name__}, got {type(v).__name__}")
+        _encode_into(out, v)
+
+    def dec(buf: memoryview, off: int):
+        return _decode_from(cls, buf, off)
+
+    return enc, dec
+
+
+def _codec_for(tp):
+    origin = get_origin(tp)
+    if tp is int:
+        return _enc_int, _dec_int
+    if tp is bool:
+        return _enc_bool, _dec_bool
+    if tp is bytes:
+        return _enc_bytes, _dec_bytes
+    if tp is str:
+        return _enc_str, _dec_str
+    if origin in (list, tuple):
+        (elem,) = get_args(tp)[:1]
+        return _make_list_codec(*_codec_for(elem))
+    if origin is typing.Union:
+        args = [a for a in get_args(tp) if a is not type(None)]
+        if len(args) == 1 and len(get_args(tp)) == 2:
+            return _make_optional_codec(*_codec_for(args[0]))
+        raise CodecError(f"only Optional unions supported, got {tp}")
+    if dataclasses.is_dataclass(tp):
+        return _make_msg_codec(tp)
+    raise CodecError(f"unsupported field type {tp!r}")
+
+
+def _plan(cls) -> list[tuple[str, Any, Any]]:
+    plan = _PLAN.get(cls)
+    if plan is None:
+        hints = typing.get_type_hints(cls)
+        plan = []
+        for f in dataclasses.fields(cls):
+            enc, dec = _codec_for(hints[f.name])
+            plan.append((f.name, enc, dec))
+        _PLAN[cls] = plan
+    return plan
+
+
+def _encode_into(out: bytearray, msg) -> None:
+    for name, enc, _ in _plan(type(msg)):
+        enc(out, getattr(msg, name))
+
+
+def _decode_from(cls: Type[T], buf: memoryview, off: int) -> tuple[T, int]:
+    kwargs = {}
+    for name, _, dec in _plan(cls):
+        kwargs[name], off = dec(buf, off)
+    return cls(**kwargs), off
+
+
+def encode(msg) -> bytes:
+    """Canonical encoding of a registered message (no type tag)."""
+    out = bytearray()
+    _encode_into(out, msg)
+    return bytes(out)
+
+
+def decode(cls: Type[T], data: bytes) -> T:
+    try:
+        msg, off = _decode_from(cls, memoryview(data), 0)
+    except (struct.error, IndexError, UnicodeDecodeError) as e:
+        raise CodecError(f"malformed {cls.__name__}: {e}") from e
+    if off != len(data):
+        raise CodecError(f"{len(data) - off} trailing bytes decoding {cls.__name__}")
+    return msg
+
+
+def encode_tagged(msg) -> bytes:
+    """Encoding prefixed with the registered 1-byte type tag (for oneofs)."""
+    cls = type(msg)
+    tag = _TAG_BY_CLS.get(cls)
+    if tag is None:
+        raise CodecError(f"{cls.__name__} is not a registered wire message")
+    out = bytearray([tag])
+    _encode_into(out, msg)
+    return bytes(out)
+
+
+def decode_tagged(data: bytes):
+    if not data:
+        raise CodecError("empty buffer")
+    cls = _CLS_BY_TAG.get(data[0])
+    if cls is None:
+        raise CodecError(f"unknown wire tag {data[0]}")
+    try:
+        msg, off = _decode_from(cls, memoryview(data), 1)
+    except (struct.error, IndexError, UnicodeDecodeError) as e:
+        raise CodecError(f"malformed {cls.__name__}: {e}") from e
+    if off != len(data):
+        raise CodecError(f"{len(data) - off} trailing bytes decoding {cls.__name__}")
+    return msg
+
+
+def tag_of(cls: type) -> int:
+    return _TAG_BY_CLS[cls]

@@ -1,0 +1,107 @@
+"""Consensus configuration.
+
+Python re-design of the reference's 21-field configuration struct
+(/root/reference/pkg/types/config.go:14-187).  Durations are float seconds
+(the reference uses ``time.Duration``); all timeouts are consumed by the
+tick-driven time source in :mod:`smartbft_tpu.utils.clock`, so sub-tick
+precision is not meaningful.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+
+
+class ConfigError(ValueError):
+    pass
+
+
+@dataclass(frozen=True)
+class Configuration:
+    # Identity
+    self_id: int = 0
+
+    # Batching (config.go:18-28)
+    request_batch_max_count: int = 100
+    request_batch_max_bytes: int = 10 * 1024 * 1024
+    request_batch_max_interval: float = 0.05
+
+    # Buffers / pool (config.go:30-35)
+    incoming_message_buffer_size: int = 200
+    request_pool_size: int = 400
+
+    # Request timeout chain (config.go:37-45)
+    request_forward_timeout: float = 2.0
+    request_complain_timeout: float = 20.0
+    request_auto_remove_timeout: float = 180.0
+
+    # View change (config.go:47-51)
+    view_change_resend_interval: float = 5.0
+    view_change_timeout: float = 20.0
+
+    # Heartbeats (config.go:53-62)
+    leader_heartbeat_timeout: float = 60.0
+    leader_heartbeat_count: int = 10
+    num_of_ticks_behind_before_syncing: int = 10
+
+    # State collection (config.go:64-66)
+    collect_timeout: float = 1.0
+
+    # Flags (config.go:68-75)
+    sync_on_start: bool = False
+    speed_up_view_change: bool = False
+
+    # Leader rotation (config.go:77-80)
+    leader_rotation: bool = True
+    decisions_per_leader: int = 3
+
+    # Request limits (config.go:82-87)
+    request_max_bytes: int = 10 * 1024
+    request_pool_submit_timeout: float = 5.0
+
+    def validate(self) -> None:
+        def positive(name: str) -> None:
+            v = getattr(self, name)
+            if v <= 0:
+                raise ConfigError(f"{name} should be greater than zero")
+
+        if self.self_id == 0:
+            raise ConfigError("self_id should be greater than zero")
+        for field in (
+            "request_batch_max_count",
+            "request_batch_max_bytes",
+            "request_batch_max_interval",
+            "incoming_message_buffer_size",
+            "request_pool_size",
+            "request_forward_timeout",
+            "request_complain_timeout",
+            "request_auto_remove_timeout",
+            "view_change_resend_interval",
+            "view_change_timeout",
+            "leader_heartbeat_timeout",
+            "leader_heartbeat_count",
+            "num_of_ticks_behind_before_syncing",
+            "collect_timeout",
+            "request_max_bytes",
+            "request_pool_submit_timeout",
+        ):
+            positive(field)
+        if self.request_batch_max_count > self.request_batch_max_bytes:
+            raise ConfigError("request_batch_max_count is bigger than request_batch_max_bytes")
+        if self.request_forward_timeout > self.request_complain_timeout:
+            raise ConfigError("request_forward_timeout is bigger than request_complain_timeout")
+        if self.request_complain_timeout > self.request_auto_remove_timeout:
+            raise ConfigError("request_complain_timeout is bigger than request_auto_remove_timeout")
+        if self.view_change_resend_interval > self.view_change_timeout:
+            raise ConfigError("view_change_resend_interval is bigger than view_change_timeout")
+        if self.leader_rotation and self.decisions_per_leader == 0:
+            raise ConfigError("decisions_per_leader should be greater than zero when leader rotation is active")
+        if not self.leader_rotation and self.decisions_per_leader != 0:
+            raise ConfigError("decisions_per_leader should be zero when leader rotation is off")
+
+    def with_self_id(self, self_id: int) -> "Configuration":
+        return replace(self, self_id=self_id)
+
+
+#: Reasonable defaults for a ~10ms-RTT cluster (config.go:92-113).
+DEFAULT_CONFIG = Configuration()

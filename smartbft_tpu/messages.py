@@ -1,0 +1,222 @@
+"""Wire and persistence message schema.
+
+Mirrors the reference protobuf schema field-for-field
+(/root/reference/smartbftprotos/messages.proto:14-129,
+/root/reference/smartbftprotos/logrecord.proto:13-24) but encoded with the
+canonical deterministic codec in :mod:`smartbft_tpu.codec` instead of
+protobuf.  The top-level consensus ``Message`` oneof becomes the 1-byte tag
+union of the ten message classes; ``SavedMessage`` (the WAL payload oneof)
+likewise.
+
+All integers are unsigned 64-bit.  ``digest`` fields are ``str`` (hex), as in
+the reference.  Registration order below fixes the wire tags — append only.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+from .codec import (
+    decode,
+    decode_tagged,
+    encode,
+    encode_tagged,
+    wiremsg,
+)
+
+
+@wiremsg
+class Signature:
+    signer: int = 0
+    value: bytes = b""
+    msg: bytes = b""
+
+
+@wiremsg
+class Proposal:
+    header: bytes = b""
+    payload: bytes = b""
+    metadata: bytes = b""
+    verification_sequence: int = 0
+
+
+@wiremsg
+class ViewMetadata:
+    view_id: int = 0
+    latest_sequence: int = 0
+    decisions_in_view: int = 0
+    black_list: list[int] = None  # type: ignore[assignment]
+    prev_commit_signature_digest: bytes = b""
+
+    def __post_init__(self):
+        if self.black_list is None:
+            object.__setattr__(self, "black_list", [])
+
+
+@wiremsg
+class PrePrepare:
+    view: int = 0
+    seq: int = 0
+    proposal: Optional[Proposal] = None
+    prev_commit_signatures: list[Signature] = None  # type: ignore[assignment]
+
+    def __post_init__(self):
+        if self.prev_commit_signatures is None:
+            object.__setattr__(self, "prev_commit_signatures", [])
+
+
+@wiremsg
+class Prepare:
+    view: int = 0
+    seq: int = 0
+    digest: str = ""
+    assist: bool = False
+
+
+@wiremsg
+class Commit:
+    view: int = 0
+    seq: int = 0
+    digest: str = ""
+    signature: Optional[Signature] = None
+    assist: bool = False
+
+
+@wiremsg
+class PreparesFrom:
+    ids: list[int] = None  # type: ignore[assignment]
+
+    def __post_init__(self):
+        if self.ids is None:
+            object.__setattr__(self, "ids", [])
+
+
+@wiremsg
+class ViewChange:
+    next_view: int = 0
+    reason: str = ""
+
+
+@wiremsg
+class ViewData:
+    next_view: int = 0
+    last_decision: Optional[Proposal] = None
+    last_decision_signatures: list[Signature] = None  # type: ignore[assignment]
+    in_flight_proposal: Optional[Proposal] = None
+    in_flight_prepared: bool = False
+
+    def __post_init__(self):
+        if self.last_decision_signatures is None:
+            object.__setattr__(self, "last_decision_signatures", [])
+
+
+@wiremsg
+class SignedViewData:
+    raw_view_data: bytes = b""
+    signer: int = 0
+    signature: bytes = b""
+
+
+@wiremsg
+class NewView:
+    signed_view_data: list[SignedViewData] = None  # type: ignore[assignment]
+
+    def __post_init__(self):
+        if self.signed_view_data is None:
+            object.__setattr__(self, "signed_view_data", [])
+
+
+@wiremsg
+class HeartBeat:
+    view: int = 0
+    seq: int = 0
+
+
+@wiremsg
+class HeartBeatResponse:
+    view: int = 0
+
+
+@wiremsg
+class StateTransferRequest:
+    """Empty in the reference schema (messages.proto:122-124)."""
+
+
+@wiremsg
+class StateTransferResponse:
+    view_num: int = 0
+    sequence: int = 0
+
+
+#: The consensus wire "oneof": any of the ten protocol messages.
+Message = Union[
+    PrePrepare,
+    Prepare,
+    Commit,
+    ViewChange,
+    SignedViewData,
+    NewView,
+    HeartBeat,
+    HeartBeatResponse,
+    StateTransferRequest,
+    StateTransferResponse,
+]
+
+CONSENSUS_MSG_TYPES = (
+    PrePrepare,
+    Prepare,
+    Commit,
+    ViewChange,
+    SignedViewData,
+    NewView,
+    HeartBeat,
+    HeartBeatResponse,
+    StateTransferRequest,
+    StateTransferResponse,
+)
+
+
+@wiremsg
+class ProposedRecord:
+    pre_prepare: Optional[PrePrepare] = None
+    prepare: Optional[Prepare] = None
+
+
+#: WAL payload "oneof" (messages.proto:113-120): what gets persisted at each
+#: phase transition.  ``CommitRecord`` wraps the commit message; ``NewViewRecord``
+#: stores the adopted ViewMetadata.
+@wiremsg
+class CommitRecord:
+    commit: Optional[Commit] = None
+
+
+@wiremsg
+class NewViewRecord:
+    metadata: Optional[ViewMetadata] = None
+
+
+@wiremsg
+class ViewChangeRecord:
+    view_change: Optional[ViewChange] = None
+
+
+SavedMessage = Union[ProposedRecord, CommitRecord, NewViewRecord, ViewChangeRecord]
+
+SAVED_MSG_TYPES = (ProposedRecord, CommitRecord, NewViewRecord, ViewChangeRecord)
+
+
+def marshal(msg) -> bytes:
+    """Tagged canonical encoding — the wire format for Comm and the WAL."""
+    return encode_tagged(msg)
+
+
+def unmarshal(data: bytes):
+    return decode_tagged(data)
+
+
+def marshal_untagged(msg) -> bytes:
+    return encode(msg)
+
+
+def unmarshal_as(cls, data: bytes):
+    return decode(cls, data)

@@ -1,0 +1,282 @@
+"""Metrics SPI + default providers + the consensus metric bundles.
+
+Re-design of /root/reference/pkg/metrics/provider.go:11-169 (Fabric-style
+Provider/Counter/Gauge/Histogram with label support), the no-op provider
+(pkg/metrics/disabled/provider.go), and the five metric bundles of
+/root/reference/pkg/api/metrics.go:106-548 — plus the TPU-plane additions
+required by BASELINE.json: signature-batch occupancy ("batch-fill %") and
+verify-latency histograms.
+
+The in-memory provider doubles as the benchmark introspection surface.
+"""
+
+from __future__ import annotations
+
+import abc
+import threading
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+
+@dataclass(frozen=True)
+class MetricOpts:
+    namespace: str = ""
+    subsystem: str = ""
+    name: str = ""
+    help: str = ""
+    label_names: tuple[str, ...] = ()
+    buckets: tuple[float, ...] = ()
+
+    @property
+    def full_name(self) -> str:
+        return ".".join(p for p in (self.namespace, self.subsystem, self.name) if p)
+
+
+class Counter(abc.ABC):
+    @abc.abstractmethod
+    def add(self, delta: float) -> None: ...
+
+    @abc.abstractmethod
+    def with_labels(self, *label_values: str) -> "Counter": ...
+
+
+class Gauge(abc.ABC):
+    @abc.abstractmethod
+    def set(self, value: float) -> None: ...
+
+    @abc.abstractmethod
+    def add(self, delta: float) -> None: ...
+
+    @abc.abstractmethod
+    def with_labels(self, *label_values: str) -> "Gauge": ...
+
+
+class Histogram(abc.ABC):
+    @abc.abstractmethod
+    def observe(self, value: float) -> None: ...
+
+    @abc.abstractmethod
+    def with_labels(self, *label_values: str) -> "Histogram": ...
+
+
+class Provider(abc.ABC):
+    @abc.abstractmethod
+    def new_counter(self, opts: MetricOpts) -> Counter: ...
+
+    @abc.abstractmethod
+    def new_gauge(self, opts: MetricOpts) -> Gauge: ...
+
+    @abc.abstractmethod
+    def new_histogram(self, opts: MetricOpts) -> Histogram: ...
+
+
+# ---------------------------------------------------------------------------
+# Disabled (no-op) provider — the default, as in the reference
+# (pkg/consensus/consensus.go:113-115).
+# ---------------------------------------------------------------------------
+
+
+class _NopCounter(Counter):
+    def add(self, delta: float) -> None:
+        pass
+
+    def with_labels(self, *label_values: str) -> Counter:
+        return self
+
+
+class _NopGauge(Gauge):
+    def set(self, value: float) -> None:
+        pass
+
+    def add(self, delta: float) -> None:
+        pass
+
+    def with_labels(self, *label_values: str) -> Gauge:
+        return self
+
+
+class _NopHistogram(Histogram):
+    def observe(self, value: float) -> None:
+        pass
+
+    def with_labels(self, *label_values: str) -> Histogram:
+        return self
+
+
+class DisabledProvider(Provider):
+    def new_counter(self, opts: MetricOpts) -> Counter:
+        return _NopCounter()
+
+    def new_gauge(self, opts: MetricOpts) -> Gauge:
+        return _NopGauge()
+
+    def new_histogram(self, opts: MetricOpts) -> Histogram:
+        return _NopHistogram()
+
+
+# ---------------------------------------------------------------------------
+# In-memory provider
+# ---------------------------------------------------------------------------
+
+
+class _MemCounter(Counter):
+    def __init__(self, store: dict, key: str):
+        self._store = store
+        self._key = key
+        store.setdefault(key, 0.0)
+
+    def add(self, delta: float) -> None:
+        self._store[self._key] = self._store.get(self._key, 0.0) + delta
+
+    def with_labels(self, *label_values: str) -> Counter:
+        return _MemCounter(self._store, self._key + "{" + ",".join(label_values) + "}")
+
+
+class _MemGauge(Gauge):
+    def __init__(self, store: dict, key: str):
+        self._store = store
+        self._key = key
+        store.setdefault(key, 0.0)
+
+    def set(self, value: float) -> None:
+        self._store[self._key] = value
+
+    def add(self, delta: float) -> None:
+        self._store[self._key] = self._store.get(self._key, 0.0) + delta
+
+    def with_labels(self, *label_values: str) -> Gauge:
+        return _MemGauge(self._store, self._key + "{" + ",".join(label_values) + "}")
+
+
+class _MemHistogram(Histogram):
+    def __init__(self, store: dict, key: str):
+        self._store = store
+        self._key = key
+        store.setdefault(key, [])
+
+    def observe(self, value: float) -> None:
+        self._store.setdefault(self._key, []).append(value)
+
+    def with_labels(self, *label_values: str) -> Histogram:
+        return _MemHistogram(self._store, self._key + "{" + ",".join(label_values) + "}")
+
+
+class InMemoryProvider(Provider):
+    """Thread-compatible in-memory metrics, introspectable by tests/bench."""
+
+    def __init__(self) -> None:
+        self.counters: dict[str, float] = {}
+        self.gauges: dict[str, float] = {}
+        self.histograms: dict[str, list[float]] = {}
+
+    def new_counter(self, opts: MetricOpts) -> Counter:
+        return _MemCounter(self.counters, opts.full_name)
+
+    def new_gauge(self, opts: MetricOpts) -> Gauge:
+        return _MemGauge(self.gauges, opts.full_name)
+
+    def new_histogram(self, opts: MetricOpts) -> Histogram:
+        return _MemHistogram(self.histograms, opts.full_name)
+
+    def histogram_quantile(self, name: str, q: float) -> Optional[float]:
+        vals = sorted(self.histograms.get(name, []))
+        if not vals:
+            return None
+        idx = min(len(vals) - 1, int(q * len(vals)))
+        return vals[idx]
+
+
+# ---------------------------------------------------------------------------
+# Metric bundles (pkg/api/metrics.go)
+# ---------------------------------------------------------------------------
+
+
+def _c(p: Provider, subsystem: str, name: str, help: str = "") -> Counter:
+    return p.new_counter(MetricOpts(namespace="consensus", subsystem=subsystem, name=name, help=help))
+
+
+def _g(p: Provider, subsystem: str, name: str, help: str = "") -> Gauge:
+    return p.new_gauge(MetricOpts(namespace="consensus", subsystem=subsystem, name=name, help=help))
+
+
+def _h(p: Provider, subsystem: str, name: str, help: str = "") -> Histogram:
+    return p.new_histogram(MetricOpts(namespace="consensus", subsystem=subsystem, name=name, help=help))
+
+
+class RequestPoolMetrics:
+    """metrics.go:106-172 — seven request-pool metrics."""
+
+    def __init__(self, p: Provider):
+        self.count_of_requests = _g(p, "pool", "count_of_requests")
+        self.count_of_failed_add_requests = _c(p, "pool", "count_of_failed_add_requests")
+        self.count_of_leader_forward_requests = _c(p, "pool", "count_of_leader_forward_requests")
+        self.count_leader_forward_timeout = _c(p, "pool", "count_leader_forward_timeout")
+        self.count_of_complain_timeout = _c(p, "pool", "count_of_complain_timeout")
+        self.count_of_deleted_requests = _c(p, "pool", "count_of_deleted_requests")
+        self.latency_of_requests = _h(p, "pool", "latency_of_requests")
+
+
+class BlacklistMetrics:
+    """metrics.go:239-258."""
+
+    def __init__(self, p: Provider):
+        self.count_black_list = _g(p, "blacklist", "count_black_list")
+        self.nodes_in_black_list = _g(p, "blacklist", "nodes_in_black_list")
+
+
+class ConsensusMetrics:
+    """metrics.go:299-343."""
+
+    def __init__(self, p: Provider):
+        self.count_consensus_reconfig = _c(p, "consensus", "count_consensus_reconfig")
+        self.latency_sync = _h(p, "consensus", "latency_sync")
+
+
+class ViewMetrics:
+    """metrics.go:346-460 — per-view protocol progress metrics."""
+
+    def __init__(self, p: Provider):
+        self.view_number = _g(p, "view", "number")
+        self.leader_id = _g(p, "view", "leader_id")
+        self.proposal_sequence = _g(p, "view", "proposal_sequence")
+        self.decisions_in_view = _g(p, "view", "decisions_in_view")
+        self.phase = _g(p, "view", "phase")
+        self.count_txs_in_batch = _g(p, "view", "count_txs_in_batch")
+        self.count_batch_all = _c(p, "view", "count_batch_all")
+        self.count_txs_all = _c(p, "view", "count_txs_all")
+        self.size_of_batch = _c(p, "view", "size_of_batch")
+        self.latency_batch_processing = _h(p, "view", "latency_batch_processing")
+        self.latency_batch_save = _h(p, "view", "latency_batch_save")
+
+
+class ViewChangeMetrics:
+    """metrics.go:520-548."""
+
+    def __init__(self, p: Provider):
+        self.current_view = _g(p, "viewchange", "current_view")
+        self.next_view = _g(p, "viewchange", "next_view")
+        self.real_view = _g(p, "viewchange", "real_view")
+
+
+class TPUCryptoMetrics:
+    """TPU-plane additions (BASELINE.json): batch occupancy + verify latency."""
+
+    def __init__(self, p: Provider):
+        self.batch_fill_percent = _h(p, "tpu", "batch_fill_percent")
+        self.verify_latency_per_sig_us = _h(p, "tpu", "verify_latency_per_sig_us")
+        self.count_sigs_verified = _c(p, "tpu", "count_sigs_verified")
+        self.count_batches = _c(p, "tpu", "count_batches")
+
+
+class MetricsBundle:
+    """All bundles wired from one provider — what Consensus hands to components."""
+
+    def __init__(self, p: Optional[Provider] = None):
+        p = p or DisabledProvider()
+        self.provider = p
+        self.pool = RequestPoolMetrics(p)
+        self.blacklist = BlacklistMetrics(p)
+        self.consensus = ConsensusMetrics(p)
+        self.view = ViewMetrics(p)
+        self.view_change = ViewChangeMetrics(p)
+        self.tpu = TPUCryptoMetrics(p)

@@ -1,0 +1,119 @@
+"""Native (C++) runtime helpers, loaded via ctypes with Python fallbacks.
+
+The reference is pure Go; the TPU-native rebuild keeps its runtime plane
+(WAL framing, hashing) native where throughput demands it.  Libraries are
+compiled on first import with ``g++`` into this directory and cached; any
+build failure falls back to the pure-Python implementations so the framework
+never hard-depends on a toolchain at runtime.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB_NAME = "libsmartbft_native.so"
+_SOURCES = ["crc32c.cc"]
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_attempted = False
+
+
+def _build_lib(lib_path: str) -> bool:
+    srcs = [os.path.join(_DIR, s) for s in _SOURCES]
+    if not all(os.path.exists(s) for s in srcs):
+        return False
+    tmp = lib_path + f".tmp.{os.getpid()}"
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, *srcs]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, lib_path)
+        return True
+    except Exception:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def _stale(lib_path: str) -> bool:
+    try:
+        lib_mtime = os.path.getmtime(lib_path)
+    except OSError:
+        return True
+    return any(
+        os.path.getmtime(os.path.join(_DIR, s)) > lib_mtime for s in _SOURCES
+    )
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library; None on failure."""
+    global _lib, _load_attempted
+    with _lock:
+        if _lib is not None or _load_attempted:
+            return _lib
+        _load_attempted = True
+        if os.environ.get("SMARTBFT_NO_NATIVE"):
+            return None
+        lib_path = os.path.join(_DIR, _LIB_NAME)
+        if _stale(lib_path) and not _build_lib(lib_path):
+            return None
+        try:
+            lib = ctypes.CDLL(lib_path)
+            lib.smartbft_crc32c_update.restype = ctypes.c_uint32
+            lib.smartbft_crc32c_update.argtypes = [
+                ctypes.c_uint32,
+                ctypes.c_char_p,
+                ctypes.c_size_t,
+            ]
+            _lib = lib
+        except OSError:
+            _lib = None
+        return _lib
+
+
+# ---------------------------------------------------------------------------
+# crc32c
+# ---------------------------------------------------------------------------
+
+_PY_TABLE: Optional[list[int]] = None
+
+
+def _py_table() -> list[int]:
+    global _PY_TABLE
+    if _PY_TABLE is None:
+        poly = 0x82F63B78
+        table = []
+        for i in range(256):
+            c = i
+            for _ in range(8):
+                c = (c >> 1) ^ (poly if c & 1 else 0)
+            table.append(c)
+        _PY_TABLE = table
+    return _PY_TABLE
+
+
+def _crc32c_update_py(crc: int, data: bytes) -> int:
+    table = _py_table()
+    crc ^= 0xFFFFFFFF
+    for b in data:
+        crc = table[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def crc32c_update(crc: int, data: bytes) -> int:
+    """Castagnoli CRC with Go ``crc32.Update`` chaining semantics."""
+    lib = load()
+    if lib is not None:
+        return lib.smartbft_crc32c_update(crc, data, len(data))
+    return _crc32c_update_py(crc, data)
+
+
+def using_native() -> bool:
+    return load() is not None

@@ -1,0 +1,110 @@
+"""Application-facing types.
+
+Re-design of /root/reference/pkg/types/types.go:18-122.  The reference splits
+wire structs (protobuf) from app-facing structs (plain Go with ASN.1 digest);
+here both share the canonical-codec dataclasses in
+:mod:`smartbft_tpu.messages`, and this module adds digests, the thread-safe
+Checkpoint, and the decision/sync/reconfig carriers.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+from .codec import encode
+from .config import Configuration
+from .messages import Proposal, Signature, ViewMetadata
+
+
+def proposal_digest(p: Proposal) -> str:
+    """Hex SHA-256 over the canonical proposal encoding.
+
+    Mirrors ``Proposal.Digest`` (types.go:50-61): a deterministic
+    serialization of (header, payload, metadata, verification_sequence)
+    hashed with SHA-256, hex-encoded.  Byte-exact agreement across replicas
+    is what matters, not reference-byte compatibility.
+    """
+    return hashlib.sha256(encode(p)).hexdigest()
+
+
+def commit_signatures_digest(sigs: Sequence[Signature]) -> bytes:
+    """Deterministic digest over a list of commit signatures.
+
+    Mirrors ``CommitSignaturesDigest`` (internal/bft/util.go:557-579): empty
+    input digests to empty bytes; otherwise SHA-256 over the canonical
+    concatenation of (signer, value, msg) triples in the given order.
+    """
+    if not sigs:
+        return b""
+    h = hashlib.sha256()
+    for sig in sigs:
+        h.update(encode(sig))
+    return h.digest()
+
+
+@dataclass(frozen=True)
+class RequestInfo:
+    client_id: str = ""
+    request_id: str = ""
+
+    def __str__(self) -> str:
+        return f"{self.client_id}:{self.request_id}"
+
+
+@dataclass(frozen=True)
+class Decision:
+    proposal: Proposal
+    signatures: tuple[Signature, ...] = ()
+
+
+@dataclass(frozen=True)
+class ViewAndSeq:
+    view: int = 0
+    seq: int = 0
+
+
+@dataclass(frozen=True)
+class Reconfig:
+    """Returned by Application.deliver / carried by SyncResponse (types.go:107-122)."""
+
+    in_latest_decision: bool = False
+    current_nodes: tuple[int, ...] = ()
+    current_config: Optional[Configuration] = None
+
+
+@dataclass(frozen=True)
+class SyncResponse:
+    latest: Optional[Decision] = None
+    reconfig: Reconfig = field(default_factory=Reconfig)
+
+
+class Checkpoint:
+    """Thread-safe holder of the last decided proposal + quorum signatures.
+
+    Mirrors ``types.Checkpoint`` (types.go:71-105).  Written by the deliver
+    path, read by pre-prepare construction and the view-change ViewData.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._proposal = Proposal()
+        self._signatures: tuple[Signature, ...] = ()
+
+    def get(self) -> tuple[Proposal, tuple[Signature, ...]]:
+        with self._lock:
+            return self._proposal, self._signatures
+
+    def set(self, proposal: Proposal, signatures: Sequence[Signature]) -> None:
+        with self._lock:
+            self._proposal = proposal
+            self._signatures = tuple(signatures)
+
+
+def view_metadata_of(p: Proposal) -> ViewMetadata:
+    """Decode the ViewMetadata carried in a proposal's metadata bytes."""
+    from .codec import decode
+
+    return decode(ViewMetadata, p.metadata)

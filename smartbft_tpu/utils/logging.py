@@ -1,0 +1,77 @@
+"""Logger SPI implementation over the stdlib ``logging`` module.
+
+The reference leaves logging to the embedder via the ``Logger`` interface
+(/root/reference/pkg/api/dependencies.go:96-99) and uses zap in tests.  This
+module provides the stdlib-backed default plus a recording logger used by
+unit tests to observe state transitions (the reference hooks zap output the
+same way, e.g. view_test.go:399-403).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+from ..api import Logger
+
+
+class PanicError(RuntimeError):
+    """Raised by ``panicf`` — the Python analogue of zap's Panicf."""
+
+
+class StdLogger(Logger):
+    def __init__(self, name: str = "smartbft", level: int = logging.INFO):
+        self._log = logging.getLogger(name)
+        if level is not None:
+            self._log.setLevel(level)
+
+    def debugf(self, template: str, *args) -> None:
+        self._log.debug(template, *args)
+
+    def infof(self, template: str, *args) -> None:
+        self._log.info(template, *args)
+
+    def warnf(self, template: str, *args) -> None:
+        self._log.warning(template, *args)
+
+    def errorf(self, template: str, *args) -> None:
+        self._log.error(template, *args)
+
+    def panicf(self, template: str, *args) -> None:
+        msg = template % args if args else template
+        self._log.critical(msg)
+        raise PanicError(msg)
+
+
+class RecordingLogger(StdLogger):
+    """Captures formatted log lines for assertion in tests."""
+
+    def __init__(self, name: str = "smartbft.test", level: int = logging.DEBUG):
+        super().__init__(name, level)
+        self._lock = threading.Lock()
+        self.lines: list[str] = []
+
+    def _record(self, template: str, args) -> None:
+        line = template % args if args else template
+        with self._lock:
+            self.lines.append(line)
+
+    def debugf(self, template: str, *args) -> None:
+        self._record(template, args)
+        super().debugf(template, *args)
+
+    def infof(self, template: str, *args) -> None:
+        self._record(template, args)
+        super().infof(template, *args)
+
+    def warnf(self, template: str, *args) -> None:
+        self._record(template, args)
+        super().warnf(template, *args)
+
+    def errorf(self, template: str, *args) -> None:
+        self._record(template, args)
+        super().errorf(template, *args)
+
+    def contains(self, needle: str) -> bool:
+        with self._lock:
+            return any(needle in line for line in self.lines)

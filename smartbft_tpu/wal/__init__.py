@@ -1,0 +1,25 @@
+from .log import (
+    CRC_SEED,
+    DEFAULT_FILE_SIZE_BYTES,
+    CorruptWALError,
+    RepairableWALError,
+    WALError,
+    WriteAheadLogFile,
+    create,
+    initialize_and_read_all,
+    open_wal,
+    repair,
+)
+
+__all__ = [
+    "CRC_SEED",
+    "DEFAULT_FILE_SIZE_BYTES",
+    "CorruptWALError",
+    "RepairableWALError",
+    "WALError",
+    "WriteAheadLogFile",
+    "create",
+    "initialize_and_read_all",
+    "open_wal",
+    "repair",
+]
