@@ -57,8 +57,8 @@ def wiremsg(cls: Type[T]) -> Type[T]:
 
 
 def _enc_int(out: bytearray, v: int) -> None:
-    if v < 0:
-        raise CodecError(f"negative int not encodable: {v}")
+    if v < 0 or v > 0xFFFFFFFFFFFFFFFF:
+        raise CodecError(f"int out of uint64 range: {v}")
     out += _U64.pack(v)
 
 

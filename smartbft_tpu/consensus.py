@@ -1,0 +1,481 @@
+"""The Consensus facade: composition root and public API.
+
+Re-design of /root/reference/pkg/consensus/consensus.go:28-523.  Validates
+configuration, wires ViewChanger / StateCollector / Controller / Pool /
+Batcher / HeartbeatMonitor, computes the start view/seq from the checkpoint
+metadata plus WAL-restored ViewChange/NewView records, and runs the reconfig
+loop: when a delivered decision or a sync carries a reconfiguration, stop
+all components, swap config and node set, rebuild, restart.
+
+All timing flows through one tick-driven Scheduler; production attaches a
+WallClockDriver, tests advance it manually.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional, Sequence
+
+from . import api as bft_api
+from .codec import decode
+from .config import Configuration
+from .core.batcher import BatchBuilder
+from .core.controller import Controller
+from .core.heartbeat import FOLLOWER, LEADER, HeartbeatMonitor
+from .core.pool import Pool, PoolOptions
+from .core.proposer import ProposalMaker
+from .core.state import PersistedState
+from .core.statecollector import StateCollector
+from .core.util import InFlightData
+from .core.view import ViewSequencesHolder
+from .core.viewchanger import ViewChanger
+from .messages import Message, ViewMetadata
+from .metrics import MetricsBundle
+from .types import Checkpoint, Proposal, Reconfig, Signature, SyncResponse
+from .utils.clock import Scheduler, Ticker, WallClockDriver
+
+
+class Consensus:
+    """Public entry points: start / stop / submit_request / handle_message /
+    handle_request / get_leader_id (consensus.go:28-68,108,283-317)."""
+
+    def __init__(
+        self,
+        *,
+        config: Configuration,
+        application: bft_api.Application,
+        assembler: bft_api.Assembler,
+        wal: bft_api.WriteAheadLog,
+        wal_initial_content: Sequence[bytes],
+        comm: bft_api.Comm,
+        signer: bft_api.Signer,
+        verifier: bft_api.Verifier,
+        membership_notifier: Optional[bft_api.MembershipNotifier],
+        request_inspector: bft_api.RequestInspector,
+        synchronizer: bft_api.Synchronizer,
+        logger: bft_api.Logger,
+        metadata: ViewMetadata,
+        last_proposal: Proposal,
+        last_signatures: Sequence[Signature],
+        scheduler: Optional[Scheduler] = None,
+        metrics: Optional[MetricsBundle] = None,
+        viewchanger_tick_interval: float = 1.0,
+        heartbeat_tick_interval: float = 1.0,
+    ):
+        self.config = config
+        self.application = application
+        self.assembler = assembler
+        self.wal = wal
+        self.wal_initial_content = list(wal_initial_content)
+        self.comm = comm
+        self.signer = signer
+        self.verifier = verifier
+        self.membership_notifier = membership_notifier
+        self.request_inspector = request_inspector
+        self.synchronizer = synchronizer
+        self.logger = logger
+        self.metadata = metadata
+        self.last_proposal = last_proposal
+        self.last_signatures = list(last_signatures)
+        self.metrics = metrics or MetricsBundle()
+        self.scheduler = scheduler if scheduler is not None else Scheduler()
+        self._own_scheduler = scheduler is None
+        self._clock_driver: Optional[WallClockDriver] = None
+        self.viewchanger_tick_interval = viewchanger_tick_interval
+        self.heartbeat_tick_interval = heartbeat_tick_interval
+
+        self.nodes: list[int] = []
+        self.num_nodes = 0
+        self._node_set: set[int] = set()
+
+        self.pool: Optional[Pool] = None
+        self.controller: Optional[Controller] = None
+        self.view_changer: Optional[ViewChanger] = None
+        self.collector: Optional[StateCollector] = None
+        self.state: Optional[PersistedState] = None
+        self.in_flight: Optional[InFlightData] = None
+        self.checkpoint = Checkpoint()
+
+        self._running = False
+        self._stopping = False
+        self._reconfig_queue: asyncio.Queue = asyncio.Queue()
+        self._run_task: Optional[asyncio.Task] = None
+        self._tickers: list[Ticker] = []
+        self._restore_view_change = False
+
+    # ------------------------------------------------------------------ SPI glue
+
+    def complain(self, view_num: int, stop_view: bool) -> None:
+        """FailureDetector for the Controller/View (consensus.go:70-74)."""
+        if self.view_changer is not None:
+            self.view_changer.start_view_change(view_num, stop_view)
+
+    def deliver(self, proposal: Proposal, signatures) -> Reconfig:
+        """Application wrapper that detects reconfig (consensus.go:76-84).
+        Runs on an executor thread — route reconfigs back thread-safely."""
+        reconfig = self.application.deliver(proposal, signatures)
+        if reconfig.in_latest_decision:
+            self.logger.debugf("Detected a reconfig in deliver")
+            self._loop.call_soon_threadsafe(self._reconfig_queue.put_nowait, reconfig)
+        return reconfig
+
+    def sync(self) -> SyncResponse:
+        """Synchronizer wrapper that detects reconfig (consensus.go:86-100).
+        Runs on an executor thread."""
+        sync_response = self.synchronizer.sync()
+        if sync_response.reconfig.in_latest_decision:
+            self.logger.debugf("Detected a reconfig in sync")
+            self._loop.call_soon_threadsafe(
+                self._reconfig_queue.put_nowait,
+                Reconfig(
+                    in_latest_decision=True,
+                    current_nodes=sync_response.reconfig.current_nodes,
+                    current_config=sync_response.reconfig.current_config,
+                ),
+            )
+        return sync_response
+
+    # ------------------------------------------------------------------ public
+
+    def get_leader_id(self) -> int:
+        """consensus.go:103-107 — zero when not running."""
+        if not self._running or self.controller is None:
+            return 0
+        return self.controller.get_leader_id()
+
+    async def start(self) -> None:
+        """consensus.go:108-165."""
+        self._loop = asyncio.get_running_loop()
+        self.validate_configuration(self.comm.nodes())
+
+        self._set_nodes(self.comm.nodes())
+        self.in_flight = InFlightData()
+        self.state = PersistedState(
+            self.in_flight, self.wal_initial_content, self.logger, self.wal
+        )
+        self.checkpoint.set(self.last_proposal, self.last_signatures)
+
+        self._create_components()
+        self._create_pool()
+        self._continue_create_components()
+
+        view, seq, dec = self._set_view_and_seq(
+            self.metadata.view_id,
+            self.metadata.latest_sequence,
+            self.metadata.decisions_in_view,
+        )
+
+        self._run_task = self._loop.create_task(
+            self._run(), name=f"consensus-{self.config.self_id}"
+        )
+
+        if self._own_scheduler:
+            self._clock_driver = WallClockDriver(self.scheduler)
+            self._clock_driver.start()
+
+        await self._start_components(view, seq, dec, config_sync=True)
+        self._running = True
+
+    async def _run(self) -> None:
+        """Reconfig/stop loop (consensus.go:167-184)."""
+        try:
+            while True:
+                reconfig = await self._reconfig_queue.get()
+                if reconfig is None:
+                    return
+                await self._reconfig(reconfig)
+                if self._stopping:
+                    return
+        finally:
+            self.logger.infof("Exiting")
+            self._running = False
+
+    async def _reconfig(self, reconfig: Reconfig) -> None:
+        """consensus.go:186-253."""
+        self.logger.debugf("Starting reconfig")
+        await self.view_changer.stop()
+        await self.controller.stop(pool_pause=True)
+        self.collector.stop()
+        self._stop_tickers()
+
+        if self.config.self_id not in reconfig.current_nodes:
+            self.logger.infof("Evicted in reconfiguration, shutting down")
+            self._stopping = True
+            return
+
+        if reconfig.current_config is not None:
+            self.config = reconfig.current_config.with_self_id(self.config.self_id)
+        try:
+            self.validate_configuration(list(reconfig.current_nodes))
+        except ValueError as e:
+            if "does not contain the SelfID" in str(e):
+                self._stopping = True
+                return
+            raise
+
+        self._set_nodes(list(reconfig.current_nodes))
+        self._create_components()
+        self.pool.change_options(
+            self.controller,
+            PoolOptions(
+                queue_size=self.pool._opts.queue_size,
+                forward_timeout=self.config.request_forward_timeout,
+                complain_timeout=self.config.request_complain_timeout,
+                auto_remove_timeout=self.config.request_auto_remove_timeout,
+                request_max_bytes=self.config.request_max_bytes,
+                submit_timeout=self.config.request_pool_submit_timeout,
+            ),
+        )
+        self._continue_create_components()
+
+        proposal, _ = self.checkpoint.get()
+        md = decode(ViewMetadata, proposal.metadata) if proposal.metadata else ViewMetadata()
+        view, seq, dec = self._set_view_and_seq(
+            md.view_id, md.latest_sequence, md.decisions_in_view
+        )
+        await self._start_components(view, seq, dec, config_sync=False)
+        self.pool.restart_timers()
+        self.metrics.consensus.count_consensus_reconfig.add(1)
+        self.logger.debugf("Reconfig is done")
+
+    async def stop(self) -> None:
+        """consensus.go:283-291."""
+        self._stopping = True
+        if self.view_changer is not None:
+            await self.view_changer.stop()
+        if self.controller is not None:
+            await self.controller.stop()
+        if self.collector is not None:
+            self.collector.stop()
+        self._stop_tickers()
+        if self._clock_driver is not None:
+            await self._clock_driver.stop()
+            self._clock_driver = None
+        self._reconfig_queue.put_nowait(None)
+        if self._run_task is not None:
+            await self._run_task
+            self._run_task = None
+        self._running = False
+
+    def handle_message(self, sender: int, m: Message) -> None:
+        """consensus.go:293-300 — membership filter then dispatch."""
+        if sender not in self._node_set:
+            self.logger.warnf("Received message from unexpected node %d", sender)
+            return
+        if self.controller is not None:
+            self.controller.process_messages(sender, m)
+
+    async def handle_request(self, sender: int, req: bytes) -> None:
+        if self.controller is not None:
+            await self.controller.handle_request(sender, req)
+
+    async def submit_request(self, req: bytes) -> None:
+        """consensus.go:309-317."""
+        if self.get_leader_id() == 0:
+            raise RuntimeError("no leader")
+        await self.controller.submit_request(req)
+
+    # ------------------------------------------------------------------ wiring
+
+    def validate_configuration(self, nodes: list[int]) -> None:
+        """consensus.go:342-364."""
+        self.config.validate()
+        node_set = set()
+        for val in nodes:
+            if val == 0:
+                raise ValueError(f"nodes contains node id 0 which is not permitted, nodes: {nodes}")
+            node_set.add(val)
+        if self.config.self_id not in node_set:
+            raise ValueError(
+                f"nodes does not contain the SelfID: {self.config.self_id}, nodes: {nodes}"
+            )
+        if len(node_set) != len(nodes):
+            raise ValueError(f"nodes contains duplicate IDs, nodes: {nodes}")
+
+    def _set_nodes(self, nodes: list[int]) -> None:
+        self.nodes = sorted(nodes)
+        self.num_nodes = len(nodes)
+        self._node_set = set(nodes)
+
+    def _create_components(self) -> None:
+        """consensus.go:387-450."""
+        self.view_changer = ViewChanger(
+            self_id=self.config.self_id,
+            n=self.num_nodes,
+            nodes_list=self.nodes,
+            leader_rotation=self.config.leader_rotation,
+            decisions_per_leader=self.config.decisions_per_leader,
+            speed_up_view_change=self.config.speed_up_view_change,
+            logger=self.logger,
+            signer=self.signer,
+            verifier=self.verifier,
+            checkpoint=self.checkpoint,
+            in_flight=self.in_flight,
+            state=self.state,
+            resend_timeout=self.config.view_change_resend_interval,
+            view_change_timeout=self.config.view_change_timeout,
+            in_msg_q_size=self.config.incoming_message_buffer_size,
+            metrics_view_change=self.metrics.view_change,
+            metrics_blacklist=self.metrics.blacklist,
+            metrics_view=self.metrics.view,
+        )
+        self.collector = StateCollector(
+            self_id=self.config.self_id,
+            n=self.num_nodes,
+            logger=self.logger,
+            collect_timeout=self.config.collect_timeout,
+            scheduler=self.scheduler,
+        )
+        view_sequences = ViewSequencesHolder()
+        self.controller = Controller(
+            self_id=self.config.self_id,
+            n=self.num_nodes,
+            nodes_list=self.nodes,
+            leader_rotation=self.config.leader_rotation,
+            decisions_per_leader=self.config.decisions_per_leader,
+            request_pool=self.pool,  # set for real in _create_pool on first start
+            batcher=None,
+            leader_monitor=None,
+            verifier=self.verifier,
+            logger=self.logger,
+            assembler=self.assembler,
+            application=self,  # facade: detects reconfigs (consensus.go:430)
+            synchronizer=self,  # facade: detects reconfigs
+            signer=self.signer,
+            request_inspector=self.request_inspector,
+            proposer_builder=None,
+            checkpoint=self.checkpoint,
+            failure_detector=self,  # facade: complain -> view changer
+            view_changer=self.view_changer,
+            collector=self.collector,
+            state=self.state,
+            in_flight=self.in_flight,
+            comm=self.comm,
+            view_sequences=view_sequences,
+            metrics_view=self.metrics.view,
+            metrics_consensus=self.metrics.consensus,
+        )
+        # ViewChanger wiring (consensus.go:445-450,466-470)
+        self.view_changer.application = self.controller.deliver
+        self.view_changer.comm = self.controller
+        self.view_changer.synchronizer = self.controller
+        self.view_changer.controller = self.controller
+        self.view_changer.pruner = self.controller
+        self.view_changer.view_sequences = view_sequences
+
+        self.controller.proposer_builder = self._proposal_maker(view_sequences)
+
+    def _proposal_maker(self, view_sequences: ViewSequencesHolder) -> ProposalMaker:
+        """consensus.go:319-340."""
+        return ProposalMaker(
+            decisions_per_leader=self.config.decisions_per_leader,
+            checkpoint=self.checkpoint,
+            state=self.state,
+            comm=self.controller,
+            decider=self.controller,
+            logger=self.logger,
+            metrics_blacklist=self.metrics.blacklist,
+            metrics_view=self.metrics.view,
+            signer=self.signer,
+            membership_notifier=self.membership_notifier,
+            self_id=self.config.self_id,
+            synchronizer=self.controller,
+            failure_detector=self,
+            verifier=self.verifier,
+            n=self.num_nodes,
+            nodes_list=self.nodes,
+            in_msg_q_size=self.config.incoming_message_buffer_size,
+            view_sequences=view_sequences,
+        )
+
+    def _create_pool(self) -> None:
+        """consensus.go:139-151."""
+        self.pool = Pool(
+            self.logger,
+            self.request_inspector,
+            self.controller,
+            PoolOptions(
+                queue_size=self.config.request_pool_size,
+                forward_timeout=self.config.request_forward_timeout,
+                complain_timeout=self.config.request_complain_timeout,
+                auto_remove_timeout=self.config.request_auto_remove_timeout,
+                request_max_bytes=self.config.request_max_bytes,
+                submit_timeout=self.config.request_pool_submit_timeout,
+            ),
+            self.scheduler,
+            metrics=self.metrics.pool,
+        )
+        self.controller.request_pool = self.pool
+
+    def _continue_create_components(self) -> None:
+        """consensus.go:452-463."""
+        batcher = BatchBuilder(
+            self.pool,
+            self.scheduler,
+            self.config.request_batch_max_count,
+            self.config.request_batch_max_bytes,
+            self.config.request_batch_max_interval,
+        )
+        self.pool._on_submitted = batcher.on_submitted
+        leader_monitor = HeartbeatMonitor(
+            self.logger,
+            self.config.leader_heartbeat_timeout,
+            self.config.leader_heartbeat_count,
+            self.controller,
+            self.num_nodes,
+            self.controller,
+            self.controller.view_sequences,
+            self.config.num_of_ticks_behind_before_syncing,
+        )
+        self.controller.batcher = batcher
+        self.controller.leader_monitor = leader_monitor
+        self.view_changer.requests_timer = self.pool
+
+    def _set_view_and_seq(self, view: int, seq: int, dec: int) -> tuple[int, int, int]:
+        """consensus.go:465-505."""
+        new_view, new_seq = view, seq
+        # decisions in view is incremented after delivery; expect dec+1 next,
+        # unless genesis
+        new_dec = dec + 1
+        if seq == 0:
+            new_dec = 0
+        view_change = self.state.load_view_change_if_applicable()
+        self._restore_view_change = False
+        if view_change is not None and view_change.next_view >= view:
+            self.logger.debugf("Restoring from view change with view %d", view_change.next_view)
+            new_view = view_change.next_view
+            self._restore_view_change = True
+        view_seq = self.state.load_new_view_if_applicable()
+        if view_seq is not None and view_seq.seq >= seq:
+            self.logger.debugf(
+                "Restoring from new view with view %d and seq %d", view_seq.view, view_seq.seq
+            )
+            new_view = view_seq.view
+            new_seq = view_seq.seq
+            new_dec = 0
+        return new_view, new_seq, new_dec
+
+    async def _start_components(
+        self, view: int, seq: int, dec: int, config_sync: bool
+    ) -> None:
+        """consensus.go:513-523."""
+        self.collector.start()
+        self.view_changer.start(view)
+        if self._restore_view_change:
+            self.view_changer.restore_trigger()
+        self._tickers.append(
+            Ticker(self.scheduler, self.viewchanger_tick_interval,
+                   lambda: self.view_changer.tick(self.scheduler.now()))
+        )
+        self._tickers.append(
+            Ticker(self.scheduler, self.heartbeat_tick_interval,
+                   lambda: self.controller.leader_monitor.tick(self.scheduler.now()))
+        )
+        await self.controller.start(
+            view, seq + 1, dec, self.config.sync_on_start if config_sync else False
+        )
+
+    def _stop_tickers(self) -> None:
+        for t in self._tickers:
+            t.stop()
+        self._tickers.clear()

@@ -1,0 +1,103 @@
+"""Batch builder: blocks until the batch is full or a timeout elapses.
+
+Re-design of /root/reference/internal/bft/batcher.go:13-92.  The reference's
+``select {closeChan, timeout, submittedChan}`` becomes an asyncio wait over a
+submitted-event and a scheduler timer — closing the reference's TODO
+("use task-scheduler based on logical time", batcher.go:46): the timeout
+runs on the shared logical-time Scheduler, so tests drive it
+deterministically.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+from ..utils.clock import Scheduler
+from .pool import Pool
+
+
+class BatchBuilder:
+    def __init__(
+        self,
+        pool: Pool,
+        scheduler: Scheduler,
+        max_msg_count: int,
+        max_size_bytes: int,
+        batch_timeout: float,
+    ):
+        self._pool = pool
+        self._scheduler = scheduler
+        self._max_msg_count = max_msg_count
+        self._max_size_bytes = max_size_bytes
+        self._batch_timeout = batch_timeout
+        self._closed = False
+        self._wakeup: Optional[asyncio.Future] = None
+        self._pending_signal = False
+
+    def on_submitted(self) -> None:
+        """Wired as the pool's submitted signal (1-slot, like the reference's
+        buffered submittedChan)."""
+        if self._wakeup is not None and not self._wakeup.done():
+            self._wakeup.set_result("submitted")
+        else:
+            self._pending_signal = True
+
+    async def next_batch(self) -> Optional[list[bytes]]:
+        """Return the next proposal batch; None if closed (batcher.go:40-63)."""
+        batch, full = self._pool.next_requests(
+            self._max_msg_count, self._max_size_bytes, check=True
+        )
+        if full:
+            return batch
+        if self._closed:
+            return None
+
+        deadline = self._scheduler.now() + self._batch_timeout
+        timer = self._scheduler.schedule(self._batch_timeout, self._on_timeout)
+        try:
+            while True:
+                if self._pending_signal:
+                    self._pending_signal = False
+                else:
+                    self._wakeup = asyncio.get_running_loop().create_future()
+                    reason = await self._wakeup
+                    self._wakeup = None
+                    if reason == "closed":
+                        return None
+                    if reason == "timeout":
+                        batch, _ = self._pool.next_requests(
+                            self._max_msg_count, self._max_size_bytes, check=False
+                        )
+                        return batch
+                if self._closed:
+                    return None
+                if self._scheduler.now() >= deadline:
+                    batch, _ = self._pool.next_requests(
+                        self._max_msg_count, self._max_size_bytes, check=False
+                    )
+                    return batch
+                batch, full = self._pool.next_requests(
+                    self._max_msg_count, self._max_size_bytes, check=True
+                )
+                if full:
+                    return batch
+        finally:
+            timer.cancel()
+            self._wakeup = None
+
+    def _on_timeout(self) -> None:
+        if self._wakeup is not None and not self._wakeup.done():
+            self._wakeup.set_result("timeout")
+
+    def close(self) -> None:
+        self._closed = True
+        if self._wakeup is not None and not self._wakeup.done():
+            self._wakeup.set_result("closed")
+
+    def closed(self) -> bool:
+        return self._closed
+
+    def reset(self) -> None:
+        self._closed = False
+        self._pending_signal = False

@@ -1,0 +1,748 @@
+"""The Controller: orchestrates views, decisions, sync, and leadership.
+
+Re-design of /root/reference/internal/bft/controller.go:88-965.  The
+reference's ``run()`` goroutine selects over decision / view-change /
+abort-view / leader-token / sync channels; here those become one typed event
+queue drained by a single asyncio task, which preserves the reference's
+ordering guarantees (a queued decision is always delivered before a
+subsequently queued abort) without channel machinery.
+
+The Decide handoff keeps the reference's rendezvous semantics
+(controller.go:873-890): the View awaits a future that the controller loop
+resolves only after the application delivered the decision.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from ..api import (
+    Application,
+    Assembler,
+    Comm,
+    Logger,
+    RequestInspector,
+    Signer,
+    Synchronizer,
+    Verifier,
+)
+from ..codec import decode
+from ..messages import (
+    Commit,
+    HeartBeat,
+    HeartBeatResponse,
+    Message,
+    NewView,
+    NewViewRecord,
+    PrePrepare,
+    Prepare,
+    SignedViewData,
+    StateTransferRequest,
+    StateTransferResponse,
+    ViewChange,
+    ViewMetadata,
+)
+from ..metrics import ConsensusMetrics, ViewMetrics
+from ..types import Checkpoint, Proposal, Reconfig, RequestInfo, ViewAndSeq
+from .pool import Pool, RequestTimeoutHandler
+from .state import ABORT, COMMITTED
+from .util import InFlightData, compute_quorum, get_leader_id
+from .view import ViewSequence, ViewSequencesHolder
+
+
+@dataclass
+class _Decision:
+    proposal: Proposal
+    signatures: list
+    requests: list
+    done: asyncio.Future
+
+
+@dataclass
+class _ViewChangeEvt:
+    view_number: int
+    proposal_seq: int
+
+
+@dataclass
+class _AbortViewEvt:
+    view: int
+
+
+class _ProposeEvt:
+    pass
+
+
+class _SyncEvt:
+    pass
+
+
+class _StopEvt:
+    pass
+
+
+class Controller(RequestTimeoutHandler):
+    """Composed by the Consensus facade; fields mirror controller.go:88-144."""
+
+    def __init__(
+        self,
+        *,
+        self_id: int,
+        n: int,
+        nodes_list: list[int],
+        leader_rotation: bool,
+        decisions_per_leader: int,
+        request_pool: Pool,
+        batcher,
+        leader_monitor,
+        verifier: Verifier,
+        logger: Logger,
+        assembler: Assembler,
+        application: Application,
+        synchronizer: Synchronizer,
+        signer: Signer,
+        request_inspector: RequestInspector,
+        proposer_builder,
+        checkpoint: Checkpoint,
+        failure_detector,
+        view_changer,
+        collector,
+        state,
+        in_flight: InFlightData,
+        comm: Comm,
+        view_sequences: ViewSequencesHolder,
+        metrics_view: Optional[ViewMetrics] = None,
+        metrics_consensus: Optional[ConsensusMetrics] = None,
+    ):
+        self.id = self_id
+        self.n = n
+        self.nodes_list = nodes_list
+        self.leader_rotation = leader_rotation
+        self.decisions_per_leader = decisions_per_leader
+        self.request_pool = request_pool
+        self.batcher = batcher
+        self.leader_monitor = leader_monitor
+        self.verifier = verifier
+        self.logger = logger
+        self.assembler = assembler
+        self.application = application
+        self.deliver = MutuallyExclusiveDeliver(self)
+        self.synchronizer = synchronizer
+        self.signer = signer
+        self.request_inspector = request_inspector
+        self.proposer_builder = proposer_builder
+        self.checkpoint = checkpoint
+        self.failure_detector = failure_detector
+        self.view_changer = view_changer
+        self.collector = collector
+        self.state = state
+        self.in_flight = in_flight
+        self.comm = comm
+        self.view_sequences = view_sequences
+        self.metrics_view = metrics_view
+        self.metrics_consensus = metrics_consensus
+
+        self.quorum = 0
+        self.curr_view = None
+        self.curr_view_number = 0
+        self.curr_decisions_in_view = 0
+        self.verification_sequence = 0
+
+        self._events: asyncio.Queue = asyncio.Queue()
+        self._stopped = False
+        self._task: Optional[asyncio.Task] = None
+        self._propose_pending = False  # 1-slot leader token (controller.go:748-761)
+        self._sync_pending = False  # 1-slot sync token (controller.go:718-730)
+        self._sync_lock = asyncio.Lock()  # deliver-vs-sync (controller.go:143,940)
+        self._reconfig: Optional[Reconfig] = None
+
+    # ------------------------------------------------------------------ info
+
+    def blacklist(self) -> list[int]:
+        prop, _ = self.checkpoint.get()
+        if not prop.metadata:
+            return []
+        return list(decode(ViewMetadata, prop.metadata).black_list)
+
+    def latest_seq(self) -> int:
+        prop, _ = self.checkpoint.get()
+        if not prop.metadata:
+            return 0
+        return decode(ViewMetadata, prop.metadata).latest_sequence
+
+    def leader_id(self) -> int:
+        return get_leader_id(
+            self.curr_view_number, self.n, self.nodes_list, self.leader_rotation,
+            self.curr_decisions_in_view, self.decisions_per_leader, self.blacklist(),
+        )
+
+    def get_leader_id(self) -> int:
+        return self.leader_id()
+
+    def i_am_the_leader(self) -> tuple[bool, int]:
+        leader = self.leader_id()
+        return leader == self.id, leader
+
+    # ------------------------------------------------------------------ requests
+
+    async def submit_request(self, request: bytes) -> None:
+        """consensus entry (controller.go:249-264)."""
+        info = self.request_inspector.request_id(request)
+        try:
+            await self.request_pool.submit(request)
+        except Exception as e:
+            self.logger.infof("Request %s was not submitted, error: %s", info, e)
+            raise
+        self.logger.debugf("Request %s was submitted", info)
+
+    async def handle_request(self, sender: int, req: bytes) -> None:
+        """A forwarded client request lands at the leader
+        (controller.go:231-247)."""
+        i_am, leader = self.i_am_the_leader()
+        if not i_am:
+            self.logger.warnf(
+                "Got request from %d but the leader is %d, dropping request", sender, leader
+            )
+            return
+        try:
+            self.verifier.verify_request(req)
+        except Exception as e:
+            self.logger.warnf("Got bad request from %d: %s", sender, e)
+            return
+        try:
+            await self.submit_request(req)
+        except Exception:
+            pass
+
+    # -- pool timeout chain (controller.go:266-297) ------------------------
+
+    def on_request_timeout(self, request: bytes, info: RequestInfo) -> None:
+        i_am, leader = self.i_am_the_leader()
+        if i_am:
+            self.logger.infof(
+                "Request %s timeout expired, this node is the leader, nothing to do", info
+            )
+            return
+        self.logger.infof(
+            "Request %s timeout expired, forwarding request to leader: %d", info, leader
+        )
+        self.comm.send_transaction(leader, request)
+
+    def on_leader_fwd_request_timeout(self, request: bytes, info: RequestInfo) -> None:
+        i_am, leader = self.i_am_the_leader()
+        if i_am:
+            self.leader_monitor.stop_leader_send_msg()
+            return
+        self.logger.warnf(
+            "Request %s leader-forwarding timeout expired, complaining about leader: %d",
+            info, leader,
+        )
+        self.failure_detector.complain(self.curr_view_number, True)
+
+    def on_auto_remove_timeout(self, info: RequestInfo) -> None:
+        self.logger.debugf("Request %s auto-remove timeout expired", info)
+
+    # -- heartbeat events (controller.go:301-318) --------------------------
+
+    def on_heartbeat_timeout(self, view: int, leader_id: int) -> None:
+        i_am, current_leader = self.i_am_the_leader()
+        if i_am:
+            return
+        if leader_id != current_leader:
+            self.logger.warnf(
+                "Heartbeat timeout expired, but current leader: %d differs from reported leader: %d; ignoring",
+                current_leader, leader_id,
+            )
+            return
+        self.logger.warnf("Heartbeat timeout expired, complaining about leader: %d", leader_id)
+        self.failure_detector.complain(self.curr_view_number, True)
+
+    # ------------------------------------------------------------------ routing
+
+    def process_messages(self, sender: int, m: Message) -> None:
+        """Dispatch inbound consensus messages (controller.go:321-344)."""
+        if isinstance(m, (PrePrepare, Prepare, Commit)):
+            if self.curr_view is not None:
+                self.curr_view.handle_message(sender, m)
+            if self.view_changer is not None:
+                self.view_changer.handle_view_message(sender, m)
+            if sender == self.leader_id():
+                from .view import proposal_sequence_of_msg, view_number_of_msg
+
+                self.leader_monitor.inject_artificial_heartbeat(
+                    sender,
+                    HeartBeat(view=view_number_of_msg(m), seq=proposal_sequence_of_msg(m)),
+                )
+        elif isinstance(m, (ViewChange, SignedViewData, NewView)):
+            if self.view_changer is not None:
+                self.view_changer.handle_message(sender, m)
+        elif isinstance(m, (HeartBeat, HeartBeatResponse)):
+            self.leader_monitor.process_msg(sender, m)
+        elif isinstance(m, StateTransferRequest):
+            self._respond_to_state_transfer_request(sender)
+        elif isinstance(m, StateTransferResponse):
+            self.collector.handle_message(sender, m)
+        else:
+            self.logger.warnf("Unexpected message type, ignoring")
+
+    def _respond_to_state_transfer_request(self, sender: int) -> None:
+        vs = self.view_sequences.load()
+        if vs is None:
+            self.logger.panicf("ViewSequences is nil")
+        self.comm.send_consensus(
+            sender,
+            StateTransferResponse(view_num=self.curr_view_number, sequence=vs.proposal_seq),
+        )
+
+    # ------------------------------------------------------------------ views
+
+    def _start_view(self, proposal_sequence: int) -> None:
+        """controller.go:375-396."""
+        view, init_phase = self.proposer_builder.new_proposer(
+            self.leader_id(), proposal_sequence, self.curr_view_number,
+            self.curr_decisions_in_view, self.quorum,
+        )
+        self.curr_view = view
+        view.start()
+        leader, _ = self.i_am_the_leader()
+        role = "follower"
+        if leader:
+            if init_phase in (COMMITTED, ABORT):
+                self._acquire_leader_token()
+            role = "leader"
+        self.leader_monitor.change_role(role, self.curr_view_number, self.leader_id())
+        self.logger.infof(
+            "Starting view with number %d, sequence %d, and decisions %d",
+            self.curr_view_number, proposal_sequence, self.curr_decisions_in_view,
+        )
+
+    async def _change_view(
+        self, new_view_number: int, new_proposal_sequence: int, new_decisions_in_view: int
+    ) -> None:
+        """controller.go:428-454."""
+        latest_view = self.curr_view_number
+        if latest_view > new_view_number:
+            return
+        leader = self.curr_view.get_leader_id() if self.curr_view else 0
+        stopped = self.curr_view.stopped() if self.curr_view else True
+        if (
+            not stopped
+            and latest_view == new_view_number
+            and self.leader_id() == leader
+            and self.curr_decisions_in_view == new_decisions_in_view
+        ):
+            self.logger.debugf("Got view change to %d but view is already running", new_view_number)
+            return
+        if not await self._abort_view(latest_view):
+            return
+        self.curr_view_number = new_view_number
+        self.curr_decisions_in_view = new_decisions_in_view
+        self._start_view(new_proposal_sequence)
+        if self.i_am_the_leader()[0]:
+            self.batcher.reset()
+
+    async def _abort_view(self, view: int) -> bool:
+        """controller.go:456-473."""
+        if view < self.curr_view_number:
+            return False
+        self._propose_pending = False  # drain leader token
+        if self.curr_view is not None:
+            await self.curr_view.abort()
+        return True
+
+    # -- externally invoked transitions ------------------------------------
+
+    def sync(self) -> None:
+        """Trigger a sync (controller.go:449-454): 1-slot token."""
+        if self.i_am_the_leader()[0]:
+            self.batcher.close()
+        if not self._sync_pending:
+            self._sync_pending = True
+            self._events.put_nowait(_SyncEvt())
+
+    def abort_view(self, view: int) -> None:
+        """ViewChanger asks to abort (controller.go:457-463)."""
+        self.batcher.close()
+        self._events.put_nowait(_AbortViewEvt(view=view))
+
+    def view_changed(self, new_view_number: int, new_proposal_sequence: int) -> None:
+        """ViewChanger announces the new view (controller.go:466-473)."""
+        if self.i_am_the_leader()[0]:
+            self.batcher.close()
+        self._events.put_nowait(
+            _ViewChangeEvt(view_number=new_view_number, proposal_seq=new_proposal_sequence)
+        )
+
+    def _acquire_leader_token(self) -> None:
+        if not self._propose_pending:
+            self._propose_pending = True
+            self._events.put_nowait(_ProposeEvt())
+
+    # ------------------------------------------------------------------ propose
+
+    async def _propose(self) -> None:
+        """controller.go:475-487."""
+        self._propose_pending = False
+        if self._stopped or self.batcher.closed():
+            return
+        next_batch = await self.batcher.next_batch()
+        if not next_batch:
+            self._acquire_leader_token()  # try again later
+            return
+        metadata = self.curr_view.get_metadata()
+        proposal = self.assembler.assemble_proposal(metadata, next_batch)
+        self.curr_view.propose(proposal)
+
+    # ------------------------------------------------------------------ loop
+
+    async def _run(self) -> None:
+        try:
+            while True:
+                evt = await self._events.get()
+                if isinstance(evt, _StopEvt):
+                    return
+                if isinstance(evt, _Decision):
+                    await self._decide(evt)
+                elif isinstance(evt, _ViewChangeEvt):
+                    await self._change_view(evt.view_number, evt.proposal_seq, 0)
+                elif isinstance(evt, _AbortViewEvt):
+                    await self._abort_view(evt.view)
+                elif isinstance(evt, _ProposeEvt):
+                    await self._propose()
+                elif isinstance(evt, _SyncEvt):
+                    await self._handle_sync_event()
+        finally:
+            self.logger.infof("Exiting")
+            if self.curr_view is not None:
+                await self.curr_view.abort()
+            self._drain_pending_decisions()
+
+    def _drain_pending_decisions(self) -> None:
+        while True:
+            try:
+                evt = self._events.get_nowait()
+            except asyncio.QueueEmpty:
+                return
+            if isinstance(evt, _Decision) and not evt.done.done():
+                evt.done.set_result(None)
+
+    async def _handle_sync_event(self) -> None:
+        """controller.go:509-523."""
+        self._sync_pending = False
+        view, seq, dec = await self._sync()
+        self.maybe_prune_revoked_requests()
+        if view > 0 or seq > 0:
+            await self._change_view(view, seq, dec)
+        else:
+            vs = self.view_sequences.load()
+            if vs is None:
+                self.logger.panicf("ViewSequences is nil")
+            await self._change_view(
+                self.curr_view_number, vs.proposal_seq, self.curr_decisions_in_view
+            )
+
+    # ------------------------------------------------------------------ decide
+
+    async def decide(self, proposal: Proposal, signatures: list, requests: list) -> None:
+        """Called by the View; resolves after delivery (controller.go:873-890)."""
+        if self._stopped:
+            return
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._events.put_nowait(
+            _Decision(proposal=proposal, signatures=signatures, requests=requests, done=fut)
+        )
+        await fut
+
+    async def _decide(self, d: _Decision) -> None:
+        """controller.go:528-558."""
+        reconfig = await self.deliver.deliver(d.proposal, d.signatures)
+        if reconfig.in_latest_decision:
+            self._reconfig = reconfig
+            self.close()
+        self.logger.debugf("Node %d delivered proposal", self.id)
+        for info in d.requests:
+            try:
+                self.request_pool.remove_request(info)
+            except Exception:
+                pass
+        if not d.done.done():
+            d.done.set_result(None)
+        if self._stopped:
+            return
+        self.curr_decisions_in_view += 1
+        md = decode(ViewMetadata, d.proposal.metadata)
+        if self._check_if_rotate(list(md.black_list)):
+            self.logger.debugf("Restarting view to rotate the leader")
+            await self._change_view(
+                self.curr_view_number, md.latest_sequence + 1, self.curr_decisions_in_view
+            )
+            self.request_pool.restart_timers()
+        self.maybe_prune_revoked_requests()
+        if self.i_am_the_leader()[0]:
+            self._acquire_leader_token()
+
+    def _check_if_rotate(self, blacklist: list[int]) -> bool:
+        """controller.go:560-574 (called after increment)."""
+        view = self.curr_view_number
+        dec = self.curr_decisions_in_view
+        curr_leader = get_leader_id(
+            view, self.n, self.nodes_list, self.leader_rotation,
+            dec - 1, self.decisions_per_leader, blacklist,
+        )
+        next_leader = get_leader_id(
+            view, self.n, self.nodes_list, self.leader_rotation,
+            dec, self.decisions_per_leader, blacklist,
+        )
+        rotate = curr_leader != next_leader
+        if rotate:
+            self.logger.infof("Rotating leader from %d to %d", curr_leader, next_leader)
+        return rotate
+
+    # ------------------------------------------------------------------ sync
+
+    async def _sync(self) -> tuple[int, int, int]:
+        """controller.go:576-680.  Returns (view, seq, decisions); zeros mean
+        'nothing learned'."""
+        begin = time.monotonic()
+        async with self._sync_lock:
+            sync_response = await asyncio.get_running_loop().run_in_executor(
+                None, self.synchronizer.sync
+            )
+        if self.metrics_consensus:
+            self.metrics_consensus.latency_sync.observe(time.monotonic() - begin)
+        if sync_response.reconfig.in_latest_decision:
+            self.close()
+            self.view_changer.close()
+
+        latest_decision = sync_response.latest
+        latest_seq = latest_view = latest_dec = 0
+        latest_md = None
+        if latest_decision is not None and latest_decision.proposal.metadata:
+            latest_md = decode(ViewMetadata, latest_decision.proposal.metadata)
+            latest_seq = latest_md.latest_sequence
+            latest_view = latest_md.view_id
+            latest_dec = latest_md.decisions_in_view
+        else:
+            self.logger.infof("Synchronizer returned with an empty proposal metadata")
+
+        controller_sequence = self.latest_seq()
+        new_proposal_sequence = controller_sequence + 1
+        controller_view_num = self.curr_view_number
+        new_view_num = controller_view_num
+        new_decisions_in_view = 0
+
+        if latest_seq > controller_sequence:
+            self.logger.infof(
+                "Synchronizer returned with sequence %d while the controller is at sequence %d",
+                latest_seq, controller_sequence,
+            )
+            self.checkpoint.set(latest_decision.proposal, latest_decision.signatures)
+            self.verification_sequence = latest_decision.proposal.verification_sequence
+            new_proposal_sequence = latest_seq + 1
+            new_decisions_in_view = latest_dec + 1
+
+        if latest_view > controller_view_num:
+            new_view_num = latest_view
+
+        response = await self._fetch_state()
+        if response is None:
+            self.logger.infof("Fetching state failed")
+            if latest_md is None or latest_view < controller_view_num:
+                return 0, 0, 0
+        else:
+            if response.view <= controller_view_num and latest_view < controller_view_num:
+                return 0, 0, 0
+            if response.view > new_view_num and response.seq == latest_seq + 1:
+                self.logger.infof(
+                    "Node %d collected state with view %d and sequence %d",
+                    self.id, response.view, response.seq,
+                )
+                self.state.save(
+                    NewViewRecord(
+                        metadata=ViewMetadata(
+                            view_id=response.view,
+                            latest_sequence=latest_seq,
+                            decisions_in_view=0,
+                        )
+                    )
+                )
+                new_view_num = response.view
+                new_decisions_in_view = 0
+
+        if latest_md is not None:
+            self._maybe_prune_in_flight(latest_md)
+
+        if new_view_num > controller_view_num:
+            self.view_changer.inform_new_view(new_view_num)
+
+        return new_view_num, new_proposal_sequence, new_decisions_in_view
+
+    def _maybe_prune_in_flight(self, sync_md: ViewMetadata) -> None:
+        """controller.go:682-705."""
+        in_flight = self.in_flight.in_flight_proposal()
+        if in_flight is None:
+            return
+        in_flight_md = decode(ViewMetadata, in_flight.metadata)
+        if sync_md.latest_sequence < in_flight_md.latest_sequence:
+            return
+        self.logger.infof(
+            "Synced to sequence %d, deleting in-flight as it is stale", sync_md.latest_sequence
+        )
+        self.in_flight.clear()
+
+    async def _fetch_state(self) -> Optional[ViewAndSeq]:
+        """controller.go:707-716."""
+        self.collector.clear_collected()
+        self.broadcast_consensus(StateTransferRequest())
+        return await self.collector.collect_state_responses()
+
+    def maybe_prune_revoked_requests(self) -> None:
+        """controller.go:733-746."""
+        new_seq = self.verifier.verification_sequence()
+        if new_seq == self.verification_sequence:
+            return
+        old = self.verification_sequence
+        self.verification_sequence = new_seq
+        self.logger.infof("Verification sequence changed: %d --> %d", old, new_seq)
+
+        def predicate(req: bytes):
+            try:
+                self.verifier.verify_request(req)
+                return None
+            except Exception as e:
+                return e
+
+        self.request_pool.prune(predicate)
+
+    # ------------------------------------------------------------------ start/stop
+
+    async def _sync_on_start(
+        self, start_view: int, start_seq: int, start_dec: int
+    ) -> tuple[int, int, int]:
+        """controller.go:763-778."""
+        sync_view, sync_seq, sync_dec = await self._sync()
+        self.maybe_prune_revoked_requests()
+        view, seq, dec = start_view, start_seq, start_dec
+        if sync_view > start_view:
+            view = sync_view
+            dec = sync_dec
+        if sync_seq > start_seq:
+            seq = sync_seq
+            dec = sync_dec
+        return view, seq, dec
+
+    async def start(
+        self,
+        start_view_number: int,
+        start_proposal_sequence: int,
+        start_decisions_in_view: int,
+        sync_on_start: bool,
+    ) -> None:
+        """controller.go:781-814."""
+        self._stopped = False
+        q, f = compute_quorum(self.n)
+        self.quorum = q
+        self.verification_sequence = self.verifier.verification_sequence()
+        if sync_on_start:
+            (
+                start_view_number,
+                start_proposal_sequence,
+                start_decisions_in_view,
+            ) = await self._sync_on_start(
+                start_view_number, start_proposal_sequence, start_decisions_in_view
+            )
+        self.curr_view_number = start_view_number
+        self.curr_decisions_in_view = start_decisions_in_view
+        self._start_view(start_proposal_sequence)
+        self._task = asyncio.get_running_loop().create_task(
+            self._run(), name=f"controller-{self.id}"
+        )
+
+    def close(self) -> None:
+        if not self._stopped:
+            self._stopped = True
+            self._events.put_nowait(_StopEvt())
+
+    async def stop(self, pool_pause: bool = False) -> None:
+        """controller.go:829-861."""
+        self.close()
+        self.batcher.close()
+        if pool_pause:
+            self.request_pool.stop_timers()
+        else:
+            self.request_pool.close()
+        self.leader_monitor.close()
+        self._propose_pending = False
+        if self._task is not None:
+            await self._task
+            self._task = None
+
+    def stopped(self) -> bool:
+        return self._stopped
+
+    # ------------------------------------------------------------------ comm
+
+    def broadcast_consensus(self, m: Message) -> None:
+        """Broadcast = loop over peers (controller.go:912-926)."""
+        for node in self.nodes_list:
+            if node == self.id:
+                continue
+            self.comm.send_consensus(node, m)
+        if isinstance(m, (PrePrepare, Prepare, Commit)):
+            if self.i_am_the_leader()[0]:
+                self.leader_monitor.heartbeat_was_sent()
+
+    def send_consensus(self, target: int, m: Message) -> None:
+        self.comm.send_consensus(target, m)
+
+    def send_transaction(self, target: int, request: bytes) -> None:
+        self.comm.send_transaction(target, request)
+
+    def nodes(self) -> list[int]:
+        return list(self.nodes_list)
+
+
+class MutuallyExclusiveDeliver:
+    """Deliver guarded against concurrent sync (controller.go:928-965)."""
+
+    def __init__(self, controller: Controller):
+        self.c = controller
+
+    async def deliver(self, proposal: Proposal, signatures: list) -> Reconfig:
+        pending_md = decode(ViewMetadata, proposal.metadata)
+        async with self.c._sync_lock:
+            latest = self.c.latest_seq()
+            if latest != 0 and latest >= pending_md.latest_sequence:
+                self.c.logger.infof(
+                    "Attempted to deliver block %d via view change but meanwhile view change "
+                    "already synced to seq %d, returning result from sync",
+                    pending_md.latest_sequence, latest,
+                )
+                sync_result = await asyncio.get_running_loop().run_in_executor(
+                    None, self.c.synchronizer.sync
+                )
+                self.c.checkpoint.set(
+                    sync_result.latest.proposal, sync_result.latest.signatures
+                )
+                r = sync_result.reconfig
+                return Reconfig(
+                    in_latest_decision=getattr(
+                        r, "in_replicated_decisions", getattr(r, "in_latest_decision", False)
+                    ),
+                    current_nodes=tuple(r.current_nodes),
+                    current_config=r.current_config,
+                )
+            begin = time.monotonic()
+            # executor offload: the app's deliver may block (disk/IPC), and
+            # other components must keep making progress meanwhile — the
+            # reference's deliver blocks only the controller goroutine.
+            result = await asyncio.get_running_loop().run_in_executor(
+                None, self.c.application.deliver, proposal, signatures
+            )
+            if self.c.metrics_view:
+                self.c.metrics_view.latency_batch_save.observe(time.monotonic() - begin)
+            self.c.checkpoint.set(proposal, signatures)
+            return result

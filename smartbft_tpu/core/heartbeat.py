@@ -1,0 +1,224 @@
+"""Leader liveness monitoring via heartbeats.
+
+Re-design of /root/reference/internal/bft/heartbeatmonitor.go:47-414.  The
+reference runs a goroutine selecting over tick/msg/command channels; every
+input here is already a callback on the consensus event loop, so the monitor
+is a plain event-driven object fed by a scheduler Ticker — same transitions,
+no task.
+
+Leader: broadcast HeartBeat{view,seq} every timeout/count, suppressed when
+real traffic was recently sent.  Follower: complain on heartbeat timeout;
+detect being one sequence behind for N consecutive ticks -> sync; collect
+HeartBeatResponses — f+1 higher-view responses force the leader to sync.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..api import Logger
+from ..messages import HeartBeat, HeartBeatResponse, Message
+from .util import compute_quorum
+from .view import ViewSequencesHolder
+
+LEADER = "leader"
+FOLLOWER = "follower"
+
+
+class HeartbeatMonitor:
+    def __init__(
+        self,
+        logger: Logger,
+        heartbeat_timeout: float,
+        heartbeat_count: int,
+        comm,
+        num_nodes: int,
+        handler,
+        view_sequences: ViewSequencesHolder,
+        num_of_ticks_behind_before_syncing: int,
+    ):
+        self._log = logger
+        self._hb_timeout = heartbeat_timeout
+        self._hb_count = heartbeat_count
+        self._comm = comm
+        self._n = num_nodes
+        self._handler = handler  # Controller: on_heartbeat_timeout / sync
+        self._view_sequences = view_sequences
+        self._ticks_behind_limit = num_of_ticks_behind_before_syncing
+
+        self._view = 0
+        self._leader_id = 0
+        self._follower = True
+        self._stop_send_heartbeat_from_leader = False
+        self._last_heartbeat: Optional[float] = None
+        self._last_tick: float = 0.0
+        self._hb_resp_collector: dict[int, int] = {}
+        self._timed_out = False
+        self._sync_req = False
+        self._behind_seq = 0
+        self._behind_counter = 0
+        self._follower_behind = False
+        self._closed = False
+
+    # ------------------------------------------------------------------ inputs
+
+    def change_role(self, role: str, view: int, leader_id: int) -> None:
+        """heartbeatmonitor.go:174-195,330-343."""
+        self._log.infof(
+            "Changing to %s role, current view: %d, current leader: %d", role, view, leader_id
+        )
+        self._stop_send_heartbeat_from_leader = False
+        self._view = view
+        self._leader_id = leader_id
+        self._follower = role == FOLLOWER
+        self._timed_out = False
+        self._last_heartbeat = self._last_tick
+        self._hb_resp_collector = {}
+        self._sync_req = False
+
+    def stop_leader_send_msg(self) -> None:
+        """Demote to non-sending without changing view (monitor keeps
+        follower-ticking) — heartbeatmonitor.go:161-171,325-328."""
+        self._stop_send_heartbeat_from_leader = True
+
+    def process_msg(self, sender: int, msg: Message) -> None:
+        if self._closed:
+            return
+        if isinstance(msg, HeartBeat):
+            self._handle_heartbeat(sender, msg, artificial=False)
+        elif isinstance(msg, HeartBeatResponse):
+            self._handle_heartbeat_response(sender, msg)
+        else:
+            self._log.warnf("Unexpected message type, ignoring")
+
+    def inject_artificial_heartbeat(self, sender: int, msg: Message) -> None:
+        """Real leader traffic counts as a sign of life
+        (controller.go:330-332)."""
+        if self._closed or not isinstance(msg, HeartBeat):
+            return
+        self._handle_heartbeat(sender, msg, artificial=True)
+
+    def heartbeat_was_sent(self) -> None:
+        """Leader sent real traffic; suppress the next heartbeat
+        (heartbeatmonitor.go:408-414)."""
+        self._last_heartbeat = self._last_tick
+
+    def close(self) -> None:
+        self._closed = True
+
+    # ------------------------------------------------------------------ ticks
+
+    def tick(self, now: float) -> None:
+        """heartbeatmonitor.go:345-350."""
+        if self._closed:
+            return
+        self._last_tick = now
+        if self._last_heartbeat is None:
+            self._last_heartbeat = now
+        if self._follower or self._stop_send_heartbeat_from_leader:
+            self._follower_tick(now)
+        else:
+            self._leader_tick(now)
+
+    def _leader_tick(self, now: float) -> None:
+        """Emit a heartbeat every hb_timeout/hb_count (go:352-376)."""
+        if (now - self._last_heartbeat) * self._hb_count < self._hb_timeout:
+            return
+        vs = self._view_sequences.load()
+        if vs is None or not vs.view_active:
+            self._log.infof("ViewSequence uninitialized or view inactive")
+            return
+        self._comm.broadcast_consensus(HeartBeat(view=self._view, seq=vs.proposal_seq))
+        self._last_heartbeat = now
+
+    def _follower_tick(self, now: float) -> None:
+        """Complain on silence; sync when persistently behind (go:378-406)."""
+        if self._timed_out or self._last_heartbeat is None:
+            self._last_heartbeat = now
+            return
+        delta = now - self._last_heartbeat
+        if delta >= self._hb_timeout:
+            self._log.warnf(
+                "Heartbeat timeout (%s) from %d expired; last heartbeat was observed %s ago",
+                self._hb_timeout, self._leader_id, delta,
+            )
+            self._handler.on_heartbeat_timeout(self._view, self._leader_id)
+            self._timed_out = True
+            return
+        if not self._follower_behind:
+            return
+        self._behind_counter += 1
+        if self._behind_counter >= self._ticks_behind_limit:
+            self._log.warnf(
+                "Syncing since the follower with seq %d is behind the leader for the last %d ticks",
+                self._behind_seq, self._ticks_behind_limit,
+            )
+            self._handler.sync()
+            self._behind_counter = 0
+
+    # ------------------------------------------------------------------ msgs
+
+    def _handle_heartbeat(self, sender: int, hb: HeartBeat, artificial: bool) -> None:
+        """heartbeatmonitor.go:216-257."""
+        if hb.view < self._view:
+            self._send_heartbeat_response(sender)
+            return
+        if not self._stop_send_heartbeat_from_leader and sender != self._leader_id:
+            self._log.debugf(
+                "Heartbeat sender is not leader, ignoring; leader: %d, sender: %d",
+                self._leader_id, sender,
+            )
+            return
+        if hb.view > self._view:
+            self._log.debugf(
+                "Heartbeat view is bigger than expected, syncing and ignoring; expected-view=%d, received-view: %d",
+                self._view, hb.view,
+            )
+            self._handler.sync()
+            return
+
+        active, our_seq = self._view_active()
+        if active and not artificial:
+            if our_seq + 1 < hb.seq:
+                self._log.debugf(
+                    "Heartbeat sequence is bigger than expected, leader's sequence is %d and ours is %d, syncing",
+                    hb.seq, our_seq,
+                )
+                self._handler.sync()
+                return
+            if our_seq + 1 == hb.seq:
+                self._follower_behind = True
+                if our_seq > self._behind_seq:
+                    self._behind_seq = our_seq
+                    self._behind_counter = 0
+            else:
+                self._follower_behind = False
+        else:
+            self._follower_behind = False
+
+        self._last_heartbeat = self._last_tick
+
+    def _handle_heartbeat_response(self, sender: int, hbr: HeartBeatResponse) -> None:
+        """f+1 higher-view responses force a sync (go:260-286)."""
+        if self._follower or self._sync_req:
+            return
+        if self._view >= hbr.view:
+            return
+        self._hb_resp_collector[sender] = hbr.view
+        _, f = compute_quorum(self._n)
+        if len(self._hb_resp_collector) >= f + 1:
+            self._log.infof(
+                "Received HeartBeatResponse triggered a call to HeartBeatEventHandler Sync, view: %d",
+                hbr.view,
+            )
+            self._handler.sync()
+            self._sync_req = True
+
+    def _send_heartbeat_response(self, target: int) -> None:
+        self._comm.send_consensus(target, HeartBeatResponse(view=self._view))
+
+    def _view_active(self) -> tuple[bool, int]:
+        vs = self._view_sequences.load()
+        if vs is None or not vs.view_active:
+            return False, 0
+        return True, vs.proposal_seq

@@ -1,0 +1,345 @@
+"""Bounded FIFO request pool with a three-stage timeout chain.
+
+Re-design of /root/reference/internal/bft/requestpool.go:52-567.  The
+reference uses a linked list + existence map + weighted semaphore + one
+``time.AfterFunc`` goroutine per request; here the FIFO and existence map
+collapse into one ordered dict, the semaphore into a waiter queue of
+futures, and every timer goes through the shared tick-driven
+:class:`~smartbft_tpu.utils.clock.Scheduler` so tests are deterministic.
+
+Timeout chain per request (requestpool.go:493-567):
+  forward timeout  -> on_request_timeout  (forward request to leader)
+  complain timeout -> on_leader_fwd_request_timeout (complain -> view change)
+  auto-remove      -> on_auto_remove_timeout (drop the request)
+"""
+
+from __future__ import annotations
+
+import abc
+import asyncio
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from ..api import Logger, RequestInspector
+from ..metrics import RequestPoolMetrics
+from ..types import RequestInfo
+from ..utils.clock import Scheduler, TaskHandle
+
+# dedup memory of recently deleted requests (requestpool.go:26)
+DEFAULT_SIZE_OF_DEL_ELEMENTS = 1000
+
+
+class PoolError(Exception):
+    pass
+
+
+class ReqAlreadyExistsError(PoolError):
+    pass
+
+
+class ReqAlreadyProcessedError(PoolError):
+    pass
+
+
+class RequestTooBigError(PoolError):
+    pass
+
+
+class SubmitTimeoutError(PoolError):
+    pass
+
+
+class PoolClosedError(PoolError):
+    pass
+
+
+class RequestTimeoutHandler(abc.ABC):
+    """Implemented by the Controller (requestpool.go:38-47)."""
+
+    @abc.abstractmethod
+    def on_request_timeout(self, request: bytes, info: RequestInfo) -> None: ...
+
+    @abc.abstractmethod
+    def on_leader_fwd_request_timeout(self, request: bytes, info: RequestInfo) -> None: ...
+
+    @abc.abstractmethod
+    def on_auto_remove_timeout(self, info: RequestInfo) -> None: ...
+
+
+@dataclass
+class PoolOptions:
+    queue_size: int = 200
+    forward_timeout: float = 10.0
+    complain_timeout: float = 10.0
+    auto_remove_timeout: float = 10.0
+    request_max_bytes: int = 100 * 1024
+    submit_timeout: float = 10.0
+
+
+class _Item:
+    __slots__ = ("request", "timer", "addition_time")
+
+    def __init__(self, request: bytes, timer: Optional[TaskHandle], addition_time: float):
+        self.request = request
+        self.timer = timer
+        self.addition_time = addition_time
+
+
+class Pool:
+    """The request pool.  Owned by the consensus event loop; ``submit`` is
+    async (it may wait for space), everything else is synchronous."""
+
+    def __init__(
+        self,
+        logger: Logger,
+        inspector: RequestInspector,
+        timeout_handler: RequestTimeoutHandler,
+        options: PoolOptions,
+        scheduler: Scheduler,
+        metrics: Optional[RequestPoolMetrics] = None,
+        on_submitted: Optional[Callable[[], None]] = None,
+    ):
+        self._log = logger
+        self._inspector = inspector
+        self._th = timeout_handler
+        self._opts = options
+        self._scheduler = scheduler
+        self._metrics = metrics
+        self._on_submitted = on_submitted or (lambda: None)
+
+        self._items: "OrderedDict[RequestInfo, _Item]" = OrderedDict()
+        self._size_bytes = 0
+        self._closed = False
+        self._stopped = False
+        self._del_map: set[RequestInfo] = set()
+        self._del_slice: list[RequestInfo] = []
+        self._space_waiters: "list[asyncio.Future]" = []
+
+    # ------------------------------------------------------------------ submit
+
+    async def submit(self, request: bytes) -> None:
+        """Add a request; dedups against in-pool and recently-deleted; waits
+        up to submit_timeout for space (requestpool.go:191-284)."""
+        info = self._inspector.request_id(request)
+        if self._closed:
+            raise PoolClosedError(f"pool closed, request rejected: {info}")
+        if len(request) > self._opts.request_max_bytes:
+            if self._metrics:
+                self._metrics.count_of_failed_add_requests.with_labels("max_bytes").add(1)
+            raise RequestTooBigError(
+                f"submitted request ({len(request)}) is bigger than "
+                f"request max bytes ({self._opts.request_max_bytes})"
+            )
+        self._check_dup(info)
+
+        while len(self._items) >= self._opts.queue_size:
+            fut: asyncio.Future = asyncio.get_running_loop().create_future()
+            self._space_waiters.append(fut)
+            timer = self._scheduler.schedule(
+                self._opts.submit_timeout,
+                lambda: fut.done() or fut.set_exception(
+                    SubmitTimeoutError(f"timeout submitting to request pool: {info}")
+                ),
+            )
+            try:
+                await fut
+            except SubmitTimeoutError:
+                if self._metrics:
+                    self._metrics.count_of_failed_add_requests.with_labels("semaphore").add(1)
+                raise
+            finally:
+                timer.cancel()
+                if fut in self._space_waiters:
+                    self._space_waiters.remove(fut)
+            if self._closed:
+                raise PoolClosedError(f"pool closed, request rejected: {info}")
+            # space may have been taken by another waiter; dedup again and loop
+            self._check_dup(info)
+
+        timer = self._scheduler.schedule(
+            self._opts.forward_timeout, lambda: self._on_request_to(request, info)
+        )
+        if self._stopped:
+            timer.cancel()
+            timer = None
+        self._items[info] = _Item(request, timer, self._scheduler.now())
+        self._size_bytes += len(request)
+        if self._metrics:
+            self._metrics.count_of_requests.set(len(self._items))
+        self._on_submitted()
+
+    def _check_dup(self, info: RequestInfo) -> None:
+        if info in self._items:
+            raise ReqAlreadyExistsError(f"request already exists: {info}")
+        if info in self._del_map:
+            raise ReqAlreadyProcessedError(f"request already processed: {info}")
+
+    # ------------------------------------------------------------------ batch
+
+    def size(self) -> int:
+        return len(self._items)
+
+    def size_bytes(self) -> int:
+        return self._size_bytes
+
+    def next_requests(
+        self, max_count: int, max_size_bytes: int, check: bool
+    ) -> tuple[list[bytes], bool]:
+        """Slice up to (max_count, max_size_bytes) from the FIFO front;
+        ``full`` means calling again cannot grow the batch
+        (requestpool.go:297-332)."""
+        if check and len(self._items) < max_count and self._size_bytes < max_size_bytes:
+            return [], False
+        batch: list[bytes] = []
+        total = 0
+        for item in self._items.values():
+            if len(batch) >= max_count:
+                break
+            req_len = len(item.request)
+            if total + req_len > max_size_bytes:
+                return batch, True
+            batch.append(item.request)
+            total += req_len
+        full = total >= max_size_bytes or len(batch) == max_count
+        return batch, full
+
+    def prune(self, predicate: Callable[[bytes], Optional[Exception]]) -> None:
+        """Remove requests failing re-verification (requestpool.go:335-354)."""
+        snapshot = [(info, item.request) for info, item in self._items.items()]
+        pruned = 0
+        for info, request in snapshot:
+            err = predicate(request)
+            if err is None:
+                continue
+            try:
+                self.remove_request(info)
+                pruned += 1
+                self._log.debugf("Pruned request: %s; predicate error: %s", info, err)
+            except PoolError:
+                pass
+        if pruned:
+            self._log.debugf("Pruned %d requests", pruned)
+
+    # ------------------------------------------------------------------ remove
+
+    def remove_request(self, info: RequestInfo) -> None:
+        item = self._items.pop(info, None)
+        if item is None:
+            self._move_to_del(info)
+            raise PoolError(f"request {info} is not in the pool at remove time")
+        if item.timer is not None:
+            item.timer.cancel()
+        self._size_bytes -= len(item.request)
+        self._move_to_del(info)
+        if self._metrics:
+            self._metrics.count_of_requests.set(len(self._items))
+            self._metrics.latency_of_requests.observe(
+                self._scheduler.now() - item.addition_time
+            )
+        self._release_space()
+
+    def _move_to_del(self, info: RequestInfo) -> None:
+        if info in self._del_map:
+            return
+        self._del_map.add(info)
+        self._del_slice.append(info)
+        # bounded dedup memory (requestpool.go:418-437)
+        if len(self._del_slice) > 2 * DEFAULT_SIZE_OF_DEL_ELEMENTS:
+            drop = len(self._del_slice) - DEFAULT_SIZE_OF_DEL_ELEMENTS
+            for r in self._del_slice[:drop]:
+                self._del_map.discard(r)
+            self._del_slice = self._del_slice[drop:]
+
+    def _release_space(self) -> None:
+        while self._space_waiters and len(self._items) < self._opts.queue_size:
+            fut = self._space_waiters.pop(0)
+            if not fut.done():
+                fut.set_result(None)
+                break
+
+    # ------------------------------------------------------------------ timers
+
+    def _on_request_to(self, request: bytes, info: RequestInfo) -> None:
+        item = self._items.get(info)
+        if item is None:
+            return
+        if self._closed or self._stopped:
+            return
+        item.timer = self._scheduler.schedule(
+            self._opts.complain_timeout,
+            lambda: self._on_leader_fwd_request_to(request, info),
+        )
+        if self._metrics:
+            self._metrics.count_of_leader_forward_requests.add(1)
+        self._th.on_request_timeout(request, info)
+
+    def _on_leader_fwd_request_to(self, request: bytes, info: RequestInfo) -> None:
+        item = self._items.get(info)
+        if item is None:
+            return
+        if self._closed or self._stopped:
+            return
+        item.timer = self._scheduler.schedule(
+            self._opts.auto_remove_timeout, lambda: self._on_auto_remove_to(info)
+        )
+        if self._metrics:
+            self._metrics.count_of_complain_timeout.add(1)
+        self._th.on_leader_fwd_request_timeout(request, info)
+
+    def _on_auto_remove_to(self, info: RequestInfo) -> None:
+        try:
+            self.remove_request(info)
+        except PoolError as e:
+            self._log.errorf("Removal of request %s failed; error: %s", info, e)
+            return
+        if self._metrics:
+            self._metrics.count_of_deleted_requests.add(1)
+        self._th.on_auto_remove_timeout(info)
+
+    # ------------------------------------------------------------------ epochs
+
+    def change_options(self, timeout_handler: RequestTimeoutHandler, options: PoolOptions) -> None:
+        """Swap the timeout handler and timeouts across a reconfig
+        (requestpool.go:146-180); queue size is kept."""
+        options.queue_size = self._opts.queue_size
+        self._opts = options
+        self._th = timeout_handler
+        self._log.debugf("Changed pool timeouts")
+
+    def stop_timers(self) -> None:
+        """Freeze all request timers during a view change
+        (requestpool.go:456-470)."""
+        self._stopped = True
+        for item in self._items.values():
+            if item.timer is not None:
+                item.timer.cancel()
+                item.timer = None
+        self._log.debugf("Stopped all timers: size=%d", len(self._items))
+
+    def restart_timers(self) -> None:
+        """Restart all request timers as forward timeouts
+        (requestpool.go:472-490)."""
+        self._stopped = False
+        for info, item in self._items.items():
+            if item.timer is not None:
+                item.timer.cancel()
+            req = item.request
+            item.timer = self._scheduler.schedule(
+                self._opts.forward_timeout,
+                (lambda r, i: lambda: self._on_request_to(r, i))(req, info),
+            )
+        self._log.debugf("Restarted all timers: size=%d", len(self._items))
+
+    def close(self) -> None:
+        self._closed = True
+        for info in list(self._items.keys()):
+            item = self._items.pop(info)
+            if item.timer is not None:
+                item.timer.cancel()
+            self._size_bytes -= len(item.request)
+            self._move_to_del(info)
+        for fut in self._space_waiters:
+            if not fut.done():
+                fut.set_exception(PoolClosedError("pool closed"))
+        self._space_waiters.clear()

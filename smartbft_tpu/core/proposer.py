@@ -1,0 +1,109 @@
+"""ProposalMaker: builds a fresh View per view-start, restoring from the WAL
+exactly once (re-design of /root/reference/internal/bft/util.go:250-331)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..api import Logger, MembershipNotifier, Signer, Verifier
+from ..metrics import BlacklistMetrics, ViewMetrics
+from ..types import Checkpoint
+from .view import View, ViewSequence, ViewSequencesHolder
+
+
+class ProposalMaker:
+    def __init__(
+        self,
+        *,
+        decisions_per_leader: int,
+        n: int,
+        nodes_list: list[int],
+        self_id: int,
+        decider,
+        failure_detector,
+        synchronizer,
+        logger: Logger,
+        comm,
+        verifier: Verifier,
+        signer: Signer,
+        membership_notifier: Optional[MembershipNotifier],
+        state,
+        in_msg_q_size: int,
+        view_sequences: ViewSequencesHolder,
+        checkpoint: Checkpoint,
+        metrics_view: Optional[ViewMetrics] = None,
+        metrics_blacklist: Optional[BlacklistMetrics] = None,
+    ):
+        self.decisions_per_leader = decisions_per_leader
+        self.n = n
+        self.nodes_list = nodes_list
+        self.self_id = self_id
+        self.decider = decider
+        self.failure_detector = failure_detector
+        self.synchronizer = synchronizer
+        self.logger = logger
+        self.comm = comm
+        self.verifier = verifier
+        self.signer = signer
+        self.membership_notifier = membership_notifier
+        self.state = state
+        self.in_msg_q_size = in_msg_q_size
+        self.view_sequences = view_sequences
+        self.checkpoint = checkpoint
+        self.metrics_view = metrics_view
+        self.metrics_blacklist = metrics_blacklist
+        self._restored_from_wal = False
+
+    def new_proposer(
+        self,
+        leader: int,
+        proposal_sequence: int,
+        view_num: int,
+        decisions_in_view: int,
+        quorum_size: int,
+    ) -> tuple[View, int]:
+        """util.go:273-329 — returns (view, initial_phase)."""
+        view = View(
+            retrieve_checkpoint=self.checkpoint.get,
+            decisions_per_leader=self.decisions_per_leader,
+            n=self.n,
+            nodes_list=self.nodes_list,
+            leader_id=leader,
+            self_id=self.self_id,
+            quorum=quorum_size,
+            number=view_num,
+            decider=self.decider,
+            failure_detector=self.failure_detector,
+            synchronizer=self.synchronizer,
+            logger=self.logger,
+            comm=self.comm,
+            verifier=self.verifier,
+            signer=self.signer,
+            membership_notifier=self.membership_notifier,
+            proposal_sequence=proposal_sequence,
+            decisions_in_view=decisions_in_view,
+            state=self.state,
+            in_msg_q_size=self.in_msg_q_size,
+            view_sequences=self.view_sequences,
+            metrics_view=self.metrics_view,
+            metrics_blacklist=self.metrics_blacklist,
+        )
+        view.view_sequences.store(
+            ViewSequence(view_active=True, proposal_seq=proposal_sequence)
+        )
+        if not self._restored_from_wal:
+            self._restored_from_wal = True
+            self.state.restore(view)
+        if proposal_sequence > view.proposal_sequence:
+            view.proposal_sequence = proposal_sequence
+            view.decisions_in_view = decisions_in_view
+        if view_num > view.number:
+            view.number = view_num
+            view.decisions_in_view = decisions_in_view
+        if self.metrics_view:
+            self.metrics_view.view_number.set(view.number)
+            self.metrics_view.leader_id.set(view.leader_id)
+            self.metrics_view.proposal_sequence.set(view.proposal_sequence)
+            self.metrics_view.decisions_in_view.set(view.decisions_in_view)
+            self.metrics_view.phase.set(view.phase)
+        return view, view.phase

@@ -1,0 +1,181 @@
+"""Protocol-state persistence over the WAL.
+
+Re-design of /root/reference/internal/bft/state.go.  ``PersistedState.save``
+appends a SavedMessage record at each phase transition (truncating on new
+proposals — the previous decision is then stable); ``restore`` rebuilds the
+View's phase, in-flight proposal, and last broadcast from the final one or
+two WAL entries after a crash (state.go:115-247).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..api import Logger, WriteAheadLog
+from ..codec import decode
+from ..messages import (
+    Commit,
+    CommitRecord,
+    NewViewRecord,
+    Prepare,
+    ProposedRecord,
+    Signature,
+    ViewChange,
+    ViewChangeRecord,
+    ViewMetadata,
+    marshal,
+    unmarshal,
+)
+from ..types import ViewAndSeq
+from .util import InFlightData
+
+# Phase constants (view.go:22-31)
+COMMITTED = 0
+PROPOSED = 1
+PREPARED = 2
+ABORT = 3
+
+PHASE_NAMES = {COMMITTED: "COMMITTED", PROPOSED: "PROPOSED", PREPARED: "PREPARED", ABORT: "ABORT"}
+
+
+class StateRecorder:
+    """In-memory State double for unit tests (state.go:18-29)."""
+
+    def __init__(self) -> None:
+        self.saved_messages: list = []
+
+    def save(self, msg) -> None:
+        self.saved_messages.append(msg)
+
+    def restore(self, view) -> None:
+        raise RuntimeError("should not be used")
+
+
+class PersistedState:
+    def __init__(
+        self,
+        in_flight: InFlightData,
+        entries: list[bytes],
+        logger: Logger,
+        wal: WriteAheadLog,
+    ):
+        self.in_flight = in_flight
+        self.entries = entries
+        self.logger = logger
+        self.wal = wal
+
+    def save(self, msg) -> None:
+        """Append a SavedMessage; only ProposedRecord truncates
+        (state.go:38-59): a new proposal implies the previous decision is a
+        stable checkpoint."""
+        if isinstance(msg, ProposedRecord):
+            self._store_proposal(msg)
+        elif isinstance(msg, CommitRecord):
+            self._store_prepared(msg.commit)
+        data = marshal(msg)
+        is_new_proposal = isinstance(msg, ProposedRecord)
+        self.wal.append(data, truncate_to=is_new_proposal)
+
+    def _store_proposal(self, proposed: ProposedRecord) -> None:
+        self.in_flight.store_proposal(proposed.pre_prepare.proposal)
+
+    def _store_prepared(self, commit: Commit) -> None:
+        self.in_flight.store_prepares(commit.view, commit.seq)
+
+    def _last_entry(self):
+        if not self.entries:
+            return None
+        try:
+            return unmarshal(self.entries[-1])
+        except Exception as e:
+            self.logger.errorf("Failed unmarshaling last entry from WAL: %s", e)
+            raise
+
+    def load_new_view_if_applicable(self) -> Optional[ViewAndSeq]:
+        """If the last WAL entry is a NewView record, adopt its view/seq
+        (state.go:77-95)."""
+        last = self._last_entry()
+        if isinstance(last, NewViewRecord):
+            md = last.metadata
+            self.logger.infof("last entry in WAL is a newView record")
+            return ViewAndSeq(view=md.view_id, seq=md.latest_sequence)
+        return None
+
+    def load_view_change_if_applicable(self) -> Optional[ViewChange]:
+        """If the last WAL entry is a ViewChange, resume it (state.go:97-113)."""
+        last = self._last_entry()
+        if isinstance(last, ViewChangeRecord):
+            self.logger.infof("last entry in WAL is a viewChange message")
+            return last.view_change
+        return None
+
+    def restore(self, view) -> None:
+        """Rebuild View runtime state from the last WAL entries
+        (state.go:115-247)."""
+        view.phase = COMMITTED
+        if not self.entries:
+            self.logger.infof("Nothing to restore")
+            return
+        self.logger.infof("WAL contains %d entries", len(self.entries))
+        last = self._last_entry()
+        if isinstance(last, ProposedRecord):
+            self._recover_proposed(last, view)
+        elif isinstance(last, CommitRecord):
+            self._recover_prepared(last, view)
+        elif isinstance(last, (NewViewRecord, ViewChangeRecord)):
+            self.logger.infof("last entry in WAL is a %s", type(last).__name__)
+        else:
+            raise ValueError(f"unrecognized record: {last!r}")
+
+    def _recover_proposed(self, rec: ProposedRecord, view) -> None:
+        """Crash after saving the pre-prepare: re-enter PROPOSED and
+        re-broadcast our prepare (state.go:155-182)."""
+        pp = rec.pre_prepare
+        view.in_flight_proposal = pp.proposal
+        self.in_flight.store_proposal(pp.proposal)
+        view.last_broadcast_sent = rec.prepare
+        view.phase = PROPOSED
+        view.number = pp.view
+        view.proposal_sequence = pp.seq
+        md = decode(ViewMetadata, pp.proposal.metadata)
+        view.decisions_in_view = md.decisions_in_view
+        self.logger.infof("Restored proposal with sequence %d", pp.seq)
+
+    def _recover_prepared(self, rec: CommitRecord, view) -> None:
+        """Crash after saving our commit: the matching pre-prepare must be
+        the second-to-last entry; re-enter PREPARED and re-broadcast the
+        commit (state.go:184-247)."""
+        if len(self.entries) < 2:
+            raise ValueError(
+                "last message is a commit, but expected to also have a matching pre-prepare"
+            )
+        prev = unmarshal(self.entries[-2])
+        if not isinstance(prev, ProposedRecord) or prev.pre_prepare is None:
+            raise ValueError(
+                f"expected second last message to be a pre-prepare, got {type(prev).__name__}"
+            )
+        pp = prev.pre_prepare
+        if view.proposal_sequence < pp.seq:
+            raise ValueError(
+                f"last proposal sequence persisted into WAL is {pp.seq} which is greater "
+                f"than last committed sequence {view.proposal_sequence}"
+            )
+        if view.proposal_sequence > pp.seq:
+            self.logger.infof(
+                "Last proposal with sequence %d has been safely committed",
+                view.proposal_sequence,
+            )
+            return
+        commit = rec.commit
+        view.in_flight_proposal = pp.proposal
+        self.in_flight.store_proposal(pp.proposal)
+        self.in_flight.store_prepares(commit.view, commit.seq)
+        view.last_broadcast_sent = commit
+        view.phase = PREPARED
+        view.number = pp.view
+        view.proposal_sequence = pp.seq
+        md = decode(ViewMetadata, pp.proposal.metadata)
+        view.decisions_in_view = md.decisions_in_view
+        sig = commit.signature
+        view.my_proposal_sig = Signature(signer=sig.signer, value=sig.value, msg=sig.msg)
+        self.logger.infof("Restored proposal with sequence %d", pp.seq)

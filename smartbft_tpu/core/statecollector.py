@@ -1,0 +1,110 @@
+"""State collector: aggregate StateTransferResponse votes during sync.
+
+Re-design of /root/reference/internal/bft/statecollector.go:18-147.  The
+Controller broadcasts a StateTransferRequest and awaits >f identical
+{view, seq} responses or the collect timeout.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+from ..api import Logger
+from ..messages import Message, StateTransferResponse
+from ..types import ViewAndSeq
+from ..utils.clock import Scheduler
+from .util import VoteSet, compute_quorum
+
+
+class StateCollector:
+    def __init__(
+        self,
+        self_id: int,
+        n: int,
+        logger: Logger,
+        collect_timeout: float,
+        scheduler: Scheduler,
+    ):
+        self.self_id = self_id
+        self.n = n
+        self._log = logger
+        self._collect_timeout = collect_timeout
+        self._scheduler = scheduler
+        self._quorum, self._f = compute_quorum(n)
+        self._responses = VoteSet(
+            lambda _s, m: isinstance(m, StateTransferResponse)
+        )
+        self._pending: list[tuple[int, Message]] = []
+        self._wakeup: Optional[asyncio.Future] = None
+        self._stopped = False
+
+    def start(self) -> None:
+        self._quorum, self._f = compute_quorum(self.n)
+        self._stopped = False
+
+    def stop(self) -> None:
+        self._stopped = True
+        if self._wakeup is not None and not self._wakeup.done():
+            self._wakeup.set_result("stop")
+
+    def handle_message(self, sender: int, msg: Message) -> None:
+        if self._stopped or not isinstance(msg, StateTransferResponse):
+            return
+        if len(self._pending) >= self.n:
+            return  # bounded inbox, drop on overflow (statecollector.go:61-64)
+        self._pending.append((sender, msg))
+        if self._wakeup is not None and not self._wakeup.done():
+            self._wakeup.set_result("msg")
+
+    def clear_collected(self) -> None:
+        self._pending.clear()
+
+    async def collect_state_responses(self) -> Optional[ViewAndSeq]:
+        """Await >f identical {view,seq} votes or timeout
+        (statecollector.go:77-129)."""
+        self._responses.clear()
+        timer = self._scheduler.schedule(self._collect_timeout, self._on_timeout)
+        self._log.debugf("Node %d started collecting state responses", self.self_id)
+        try:
+            while True:
+                while self._pending:
+                    sender, msg = self._pending.pop(0)
+                    self._responses.register_vote(sender, msg)
+                result = self._collected_enough_equal_votes()
+                if result is not None:
+                    self._log.infof(
+                        "Node %d collected a valid state: view - %d and seq - %d",
+                        self.self_id, result.view, result.seq,
+                    )
+                    return result
+                if self._stopped:
+                    return None
+                self._wakeup = asyncio.get_running_loop().create_future()
+                reason = await self._wakeup
+                self._wakeup = None
+                if reason == "timeout":
+                    self._log.infof("Node %d reached the state collector timeout", self.self_id)
+                    return None
+                if reason == "stop":
+                    return None
+        finally:
+            timer.cancel()
+            self._wakeup = None
+
+    def _on_timeout(self) -> None:
+        if self._wakeup is not None and not self._wakeup.done():
+            self._wakeup.set_result("timeout")
+
+    def _collected_enough_equal_votes(self) -> Optional[ViewAndSeq]:
+        if len(self._responses.voted) <= self._f:
+            return None
+        counts: dict[ViewAndSeq, int] = {}
+        for vote in self._responses.votes:
+            resp: StateTransferResponse = vote.msg
+            vs = ViewAndSeq(view=resp.view_num, seq=resp.sequence)
+            counts[vs] = counts.get(vs, 0) + 1
+        for vs, count in counts.items():
+            if count > self._f:
+                return vs
+        return None

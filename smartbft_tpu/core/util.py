@@ -1,0 +1,237 @@
+"""Core protocol utilities: leader election, quorum, votes, blacklist.
+
+Re-design of /root/reference/internal/bft/util.go.  The reference's
+channel-backed ``voteSet`` (util.go:107-136) becomes a plain event-driven
+accumulator — the asyncio core is single-owner per component, so no
+channel machinery is needed.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from ..api import Logger
+from ..messages import Message, PreparesFrom, ViewMetadata
+from ..metrics import BlacklistMetrics
+from ..types import Decision
+
+
+def compute_quorum(n: int) -> tuple[int, int]:
+    """Return (Q, f) for cluster size n (util.go:176-180).
+
+    f = ⌊(n−1)/3⌋;  Q = ⌈(n+f+1)/2⌉ — any two Q-subsets intersect in ≥ f+1.
+    """
+    f = (n - 1) // 3
+    q = int(math.ceil((n + f + 1) / 2.0))
+    return q, f
+
+
+def get_leader_id(
+    view: int,
+    n: int,
+    nodes: list[int],
+    leader_rotation: bool,
+    decisions_in_view: int,
+    decisions_per_leader: int,
+    blacklist: list[int],
+) -> int:
+    """Deterministic leader for (view, decisions_in_view) (util.go:72-100).
+
+    Static mode: nodes[view % n].  Rotation: offset the view by completed
+    leader terms and skip blacklisted nodes.
+    """
+    if not leader_rotation:
+        return nodes[view % n]
+    blacklisted = set(blacklist)
+    for i in range(len(nodes)):
+        index = view + (decisions_in_view // decisions_per_leader) + i
+        node = nodes[index % n]
+        if node not in blacklisted:
+            return node
+    raise RuntimeError(f"all {len(nodes)} nodes are blacklisted")
+
+
+@dataclass
+class Vote:
+    msg: Message
+    sender: int
+
+
+class VoteSet:
+    """Dedup'd per-sender vote accumulator (util.go:102-136, event-driven)."""
+
+    def __init__(self, valid_vote: Callable[[int, Message], bool]):
+        self._valid_vote = valid_vote
+        self.voted: set[int] = set()
+        self.votes: list[Vote] = []
+
+    def clear(self) -> None:
+        self.voted.clear()
+        self.votes.clear()
+
+    def register_vote(self, voter: int, msg: Message) -> Optional[Vote]:
+        """Returns the registered Vote, or None if invalid/duplicate."""
+        if not self._valid_vote(voter, msg):
+            return None
+        if voter in self.voted:
+            return None  # double vote
+        self.voted.add(voter)
+        v = Vote(msg=msg, sender=voter)
+        self.votes.append(v)
+        return v
+
+    def __len__(self) -> int:
+        return len(self.votes)
+
+
+class NextViews:
+    """Latest next-view announced per sender (util.go:138-156)."""
+
+    def __init__(self) -> None:
+        self._n: dict[int, int] = {}
+
+    def clear(self) -> None:
+        self._n.clear()
+
+    def register_next(self, next_view: int, sender: int) -> None:
+        if next_view <= self._n.get(sender, 0):
+            return
+        self._n[sender] = next_view
+
+    def send_recv(self, next_view: int, sender: int) -> bool:
+        return self._n.get(sender) == next_view
+
+
+class InFlightData:
+    """The proposal currently being agreed on + its prepared flag
+    (util.go:184-247).  Read by the ViewChanger when building ViewData."""
+
+    def __init__(self) -> None:
+        self._proposal = None
+        self._prepared = False
+
+    def in_flight_proposal(self):
+        return self._proposal
+
+    def is_in_flight_prepared(self) -> bool:
+        return self._prepared
+
+    def store_proposal(self, proposal) -> None:
+        self._proposal = proposal
+        self._prepared = False
+
+    def store_prepares(self, view: int, seq: int) -> None:
+        if self._proposal is None:
+            raise RuntimeError("stored prepares but proposal is not initialized")
+        self._prepared = True
+
+    def clear(self) -> None:
+        self._proposal = None
+        self._prepared = False
+
+
+def compute_blacklist_update(
+    *,
+    current_leader: int,
+    leader_rotation: bool,
+    prev_md: ViewMetadata,
+    n: int,
+    nodes: list[int],
+    curr_view: int,
+    prepares_from: dict[int, PreparesFrom],
+    f: int,
+    decisions_per_leader: int,
+    logger: Logger,
+    metrics: Optional[BlacklistMetrics] = None,
+) -> list[int]:
+    """Deterministic blacklist update, recomputed independently by every
+    replica at proposal time and re-verified by followers (util.go:415-495).
+
+    After a view change: blacklist every leader of the skipped views.  Within
+    a view: prune nodes attested alive by > f prepare-acknowledgement
+    witnesses.  Cap the list at f by dropping from the front.
+    """
+    new_blacklist = list(prev_md.black_list)
+    view_before = prev_md.view_id
+
+    if view_before != curr_view:
+        # A view change happened: blacklist the leaders of skipped views.
+        # Offset matches the reference: past the first proposal, the previous
+        # leader's ID was computed with decisions_in_view+1 (util.go:437-443).
+        offset = 0 if prev_md.latest_sequence == 0 else 1
+        for prev_view in range(view_before, curr_view):
+            leader_id = get_leader_id(
+                prev_view, n, nodes, leader_rotation,
+                prev_md.decisions_in_view + offset, decisions_per_leader,
+                list(prev_md.black_list),
+            )
+            if leader_id == current_leader:
+                logger.debugf("Skipping blacklisting current node (%d)", leader_id)
+                continue
+            new_blacklist.append(leader_id)
+            logger.infof("Blacklisting %d", leader_id)
+    else:
+        new_blacklist = prune_blacklist(new_blacklist, prepares_from, f, nodes, logger)
+
+    while len(new_blacklist) > f:
+        logger.infof(
+            "Removing %d from %d sized blacklist due to size constraint",
+            new_blacklist[0], len(new_blacklist),
+        )
+        new_blacklist = new_blacklist[1:]
+
+    if len(prev_md.black_list) != len(new_blacklist):
+        logger.infof("Blacklist changed: %s --> %s", prev_md.black_list, new_blacklist)
+
+    if metrics is not None:
+        in_bl = set(new_blacklist)
+        for node in nodes:
+            metrics.nodes_in_black_list.with_labels(str(node)).set(1.0 if node in in_bl else 0.0)
+        metrics.count_black_list.set(len(new_blacklist))
+
+    return new_blacklist
+
+
+def prune_blacklist(
+    prev_blacklist: list[int],
+    prepares_from: dict[int, PreparesFrom],
+    f: int,
+    nodes: list[int],
+    logger: Logger,
+) -> list[int]:
+    """Remove blacklisted nodes attested alive by > f witnesses, and nodes
+    that left the membership (util.go:502-541)."""
+    if not prev_blacklist:
+        return prev_blacklist
+    current = set(nodes)
+    acks: dict[int, int] = {}
+    for sender, got in prepares_from.items():
+        for prepare_sender in got.ids:
+            acks[prepare_sender] = acks.get(prepare_sender, 0) + 1
+    out = []
+    for node in prev_blacklist:
+        if node not in current:
+            logger.infof("Node %d no longer exists, removing it from the blacklist", node)
+            continue
+        if acks.get(node, 0) > f:
+            logger.infof(
+                "Node %d was observed sending a prepare by %d nodes, removing it from blacklist",
+                node, acks[node],
+            )
+            continue
+        out.append(node)
+    return out
+
+
+def msg_type_name(msg: Message) -> str:
+    return type(msg).__name__
+
+
+def view_number_of(msg: Message) -> Optional[int]:
+    """The view a message refers to, for routing (util.go:338-413 analogue)."""
+    for attr in ("view", "next_view", "view_num"):
+        if hasattr(msg, attr):
+            return getattr(msg, attr)
+    return None

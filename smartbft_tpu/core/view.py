@@ -1,0 +1,826 @@
+"""The View: one (view-number, leader) instance of the three-phase protocol.
+
+Re-design of /root/reference/internal/bft/view.go:68-1088.  The reference
+runs a goroutine that drains an inbox channel and then blocks inside
+phase-specific selects; here the same control flow is an asyncio task that
+pumps one inbox and awaits phase predicates.  Three deliberate divergences,
+all TPU-motivated:
+
+1. **Batched commit verification** — the reference spawns a goroutine per
+   commit vote calling ``VerifyConsenterSig`` (view.go:537-541); here commit
+   votes accumulate between event-loop turns and are flushed through
+   ``Verifier.verify_consenter_sigs_batch`` in one call, which the TPU
+   verifier maps to a single vmap'd kernel launch.  Under load the batch
+   grows automatically: while one batch is in flight on the device, newly
+   arriving votes queue up for the next flush.
+2. **Batched prev-commit-signature verification** in proposal validation
+   (view.go:606-647) — a quorum-sized batch per pre-prepare.
+3. Vote sets / pre-prepare slots are plain data, not channels — the view
+   task is the single owner (SURVEY §2.4).
+
+Pipelining is preserved: messages for sequence s+1 land in ``next_*`` sets
+and are swapped in at ``_start_next_seq`` (view.go:107-113,860-894).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, replace
+from typing import Optional, Sequence
+
+from ..api import Logger, MembershipNotifier, Signer, Verifier
+from ..codec import decode, encode
+from ..messages import (
+    Commit,
+    CommitRecord,
+    Message,
+    PreparesFrom,
+    PrePrepare,
+    Prepare,
+    Proposal,
+    ProposedRecord,
+    Signature,
+    ViewMetadata,
+)
+from ..metrics import BlacklistMetrics, ViewMetrics
+from ..types import commit_signatures_digest, proposal_digest
+from .state import ABORT, COMMITTED, PREPARED, PROPOSED
+from .util import VoteSet, compute_blacklist_update, compute_quorum
+
+_MAX_U64 = 2**64 - 1
+
+
+def view_number_of_msg(msg: Message) -> int:
+    """util.go:31-45 — view of a pre-prepare/prepare/commit, else MaxUint64."""
+    if isinstance(msg, (PrePrepare, Prepare, Commit)):
+        return msg.view
+    return _MAX_U64
+
+
+def proposal_sequence_of_msg(msg: Message) -> int:
+    if isinstance(msg, (PrePrepare, Prepare, Commit)):
+        return msg.seq
+    return _MAX_U64
+
+
+class ViewAborted(Exception):
+    pass
+
+
+@dataclass(frozen=True)
+class ViewSequence:
+    """view.go's ViewSequence (util.go:333-336)."""
+
+    view_active: bool = False
+    proposal_seq: int = 0
+
+
+class ViewSequencesHolder:
+    """Shared mutable slot replacing the reference's atomic.Value."""
+
+    def __init__(self) -> None:
+        self._v: Optional[ViewSequence] = None
+
+    def store(self, vs: ViewSequence) -> None:
+        self._v = vs
+
+    def load(self) -> Optional[ViewSequence]:
+        return self._v
+
+
+@dataclass(frozen=True)
+class _ProposalInfo:
+    digest: str
+    view: int
+    seq: int
+
+
+_ABORT = object()  # inbox sentinel
+
+
+class View:
+    """One protocol instance.  Constructed by ProposalMaker, owned by the
+    Controller; communicates upward through Decider/FailureDetector/Sync."""
+
+    def __init__(
+        self,
+        *,
+        self_id: int,
+        n: int,
+        nodes_list: list[int],
+        leader_id: int,
+        quorum: int,
+        number: int,
+        decider,
+        failure_detector,
+        synchronizer,
+        logger: Logger,
+        comm,
+        verifier: Verifier,
+        signer: Signer,
+        membership_notifier: Optional[MembershipNotifier],
+        proposal_sequence: int,
+        decisions_in_view: int,
+        state,
+        retrieve_checkpoint,
+        decisions_per_leader: int,
+        view_sequences: ViewSequencesHolder,
+        metrics_view: Optional[ViewMetrics] = None,
+        metrics_blacklist: Optional[BlacklistMetrics] = None,
+        in_msg_q_size: int = 200,
+    ):
+        self.self_id = self_id
+        self.n = n
+        self.nodes_list = nodes_list
+        self.leader_id = leader_id
+        self.quorum = quorum
+        self.number = number
+        self.decider = decider
+        self.failure_detector = failure_detector
+        self.synchronizer = synchronizer
+        self.logger = logger
+        self.comm = comm
+        self.verifier = verifier
+        self.signer = signer
+        self.membership_notifier = membership_notifier
+        self.proposal_sequence = proposal_sequence
+        self.decisions_in_view = decisions_in_view
+        self.state = state
+        self.retrieve_checkpoint = retrieve_checkpoint
+        self.decisions_per_leader = decisions_per_leader
+        self.view_sequences = view_sequences
+        self.metrics = metrics_view
+        self.metrics_blacklist = metrics_blacklist
+        self.in_msg_q_size = in_msg_q_size
+
+        self.phase = COMMITTED
+        # runtime
+        self.my_proposal_sig: Optional[Signature] = None
+        self.in_flight_proposal: Optional[Proposal] = None
+        self.in_flight_requests: list = []
+        self.last_broadcast_sent: Optional[Message] = None
+        self._curr_prepare_sent: Optional[Prepare] = None
+        self._curr_commit_sent: Optional[Commit] = None
+        self._prev_prepare_sent: Optional[Prepare] = None
+        self._prev_commit_sent: Optional[Commit] = None
+        self._last_voted_proposal_by_id: dict[int, Commit] = {}
+        self._blacklist_supported = False
+
+        self._inbox: asyncio.Queue = asyncio.Queue()
+        self._aborted = False
+        self._task: Optional[asyncio.Task] = None
+        # 1-slot pre-prepare stashes (view.go:105-111)
+        self._pre_prepare: Optional[PrePrepare] = None
+        self._next_pre_prepare: Optional[PrePrepare] = None
+        self._setup_votes()
+
+    # ------------------------------------------------------------------ votes
+
+    def _setup_votes(self) -> None:
+        def accept_prepares(_sender: int, m: Message) -> bool:
+            return isinstance(m, Prepare)
+
+        def accept_commits(sender: int, m: Message) -> bool:
+            if not isinstance(m, Commit) or m.signature is None:
+                return False
+            return m.signature.signer == sender  # view.go:160-171
+
+        self.prepares = VoteSet(accept_prepares)
+        self.next_prepares = VoteSet(accept_prepares)
+        self.commits = VoteSet(accept_commits)
+        self.next_commits = VoteSet(accept_commits)
+
+    # ------------------------------------------------------------------ life
+
+    def start(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(
+            self._run(), name=f"view-{self.self_id}-{self.number}"
+        )
+
+    def stopped(self) -> bool:
+        return self._aborted
+
+    def _stop(self) -> None:
+        if not self._aborted:
+            self._aborted = True
+            self._inbox.put_nowait(_ABORT)
+
+    async def abort(self) -> None:
+        """Force the view to end and wait for its task (view.go:1000-1010)."""
+        self._stop()
+        if self._task is not None:
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+
+    def get_leader_id(self) -> int:
+        return self.leader_id
+
+    def handle_message(self, sender: int, msg: Message) -> None:
+        if self._aborted:
+            return
+        self._inbox.put_nowait((sender, msg))
+
+    # ------------------------------------------------------------------ loop
+
+    async def _run(self) -> None:
+        try:
+            while True:
+                if self.phase == COMMITTED:
+                    await self._process_proposal()
+                elif self.phase == PROPOSED:
+                    self.comm.broadcast_consensus(self.last_broadcast_sent)
+                    await self._process_prepares()
+                elif self.phase == PREPARED:
+                    self.comm.broadcast_consensus(self.last_broadcast_sent)
+                    await self._prepared()
+                elif self.phase == ABORT:
+                    return
+                if self.metrics:
+                    self.metrics.phase.set(self.phase)
+        except ViewAborted:
+            pass
+        except Exception as e:  # pragma: no cover - defensive
+            self.logger.errorf("View %d crashed: %r", self.number, e)
+            raise
+        finally:
+            self.view_sequences.store(
+                ViewSequence(view_active=False, proposal_seq=self.proposal_sequence)
+            )
+
+    async def _next_event(self) -> None:
+        """Await and process exactly one inbound message (or abort)."""
+        item = await self._inbox.get()
+        if item is _ABORT or self._aborted:
+            raise ViewAborted()
+        sender, msg = item
+        self._process_msg(sender, msg)
+
+    def _drain_inbox(self) -> None:
+        """Process everything already queued without awaiting — lets votes
+        coalesce ahead of a batched verify."""
+        while True:
+            try:
+                item = self._inbox.get_nowait()
+            except asyncio.QueueEmpty:
+                return
+            if item is _ABORT or self._aborted:
+                raise ViewAborted()
+            sender, msg = item
+            self._process_msg(sender, msg)
+
+    # ------------------------------------------------------------------ routing
+
+    def _process_msg(self, sender: int, m: Message) -> None:
+        """view.go:194-261 — route one message into slots/vote-sets."""
+        if self._aborted:
+            return
+        msg_view = view_number_of_msg(m)
+        msg_seq = proposal_sequence_of_msg(m)
+
+        if msg_view != self.number:
+            if sender != self.leader_id:
+                self._discover_if_sync_needed(sender, m)
+                return
+            self.failure_detector.complain(self.number, False)
+            if msg_view > self.number:
+                self.synchronizer.sync()
+            self._stop()
+            return
+
+        if msg_seq == self.proposal_sequence - 1 and self.proposal_sequence > 0:
+            self._handle_prev_seq_message(msg_seq, sender, m)
+            return
+
+        if msg_seq != self.proposal_sequence and msg_seq != self.proposal_sequence + 1:
+            self.logger.warnf(
+                "%d got message from %d with sequence %d but our sequence is %d",
+                self.self_id, sender, msg_seq, self.proposal_sequence,
+            )
+            self._discover_if_sync_needed(sender, m)
+            return
+
+        for_next = msg_seq == self.proposal_sequence + 1
+
+        if isinstance(m, PrePrepare):
+            self._process_pre_prepare(m, for_next, sender)
+            return
+
+        if sender == self.self_id:
+            return  # ignore own votes (view.go:238-241)
+
+        if isinstance(m, Prepare):
+            (self.next_prepares if for_next else self.prepares).register_vote(sender, m)
+            return
+
+        if isinstance(m, Commit):
+            (self.next_commits if for_next else self.commits).register_vote(sender, m)
+            return
+
+    def _process_pre_prepare(self, pp: PrePrepare, for_next: bool, sender: int) -> None:
+        """view.go:301-324 — stash into the 1-slot (current or next)."""
+        if pp.proposal is None:
+            self.logger.warnf("%d got pre-prepare from %d with empty proposal", self.self_id, sender)
+            return
+        if sender != self.leader_id:
+            self.logger.warnf(
+                "%d got pre-prepare from %d but the leader is %d",
+                self.self_id, sender, self.leader_id,
+            )
+            return
+        if for_next:
+            if self._next_pre_prepare is None:
+                self._next_pre_prepare = pp
+            else:
+                self.logger.warnf("Got a pre-prepare for next sequence without processing previous one, dropping message")
+        else:
+            if self._pre_prepare is None:
+                self._pre_prepare = pp
+            else:
+                self.logger.warnf("Got a pre-prepare for current sequence without processing previous one, dropping message")
+
+    # ------------------------------------------------------------------ phases
+
+    async def _process_proposal(self) -> None:
+        """COMMITTED -> PROPOSED (view.go:351-427)."""
+        self._prev_prepare_sent = self._curr_prepare_sent
+        self._prev_commit_sent = self._curr_commit_sent
+        self._curr_prepare_sent = None
+        self._curr_commit_sent = None
+        self.in_flight_proposal = None
+        self.in_flight_requests = []
+        self.last_broadcast_sent = None
+
+        while self._pre_prepare is None:
+            await self._next_event()
+        pp = self._pre_prepare
+        self._pre_prepare = None
+        proposal = pp.proposal
+        prev_commits = list(pp.prev_commit_signatures)
+
+        try:
+            requests = await self._verify_proposal(proposal, prev_commits)
+        except Exception as e:
+            self.logger.warnf(
+                "%d received bad proposal from %d: %s", self.self_id, self.leader_id, e
+            )
+            self.failure_detector.complain(self.number, False)
+            self.synchronizer.sync()
+            self._stop()
+            raise ViewAborted() from e
+
+        if self.metrics:
+            self.metrics.count_txs_in_batch.set(len(requests))
+        self._begin_pre_prepare = self._now()
+
+        seq = self.proposal_sequence
+        prepare = Prepare(view=self.number, seq=seq, digest=proposal_digest(proposal))
+
+        # Record the pre-prepare before sending our prepare (WAL-first).
+        self.state.save(ProposedRecord(pre_prepare=pp, prepare=prepare))
+        self.last_broadcast_sent = prepare
+        self._curr_prepare_sent = replace(prepare, assist=True)
+        self.in_flight_proposal = proposal
+        self.in_flight_requests = requests
+
+        # The leader broadcasts the pre-prepare only after persisting it
+        # (view.go:421-423): WAL-first ordering.
+        if self.self_id == self.leader_id:
+            self.comm.broadcast_consensus(pp)
+
+        self.logger.infof("Processed proposal with seq %d", seq)
+        self.phase = PROPOSED
+
+    async def _process_prepares(self) -> None:
+        """PROPOSED -> PREPARED (view.go:441-517)."""
+        proposal = self.in_flight_proposal
+        expected_digest = proposal_digest(proposal)
+        voter_ids: list[int] = []
+        taken = 0
+
+        while len(voter_ids) < self.quorum - 1:
+            while taken < len(self.prepares.votes):
+                vote = self.prepares.votes[taken]
+                taken += 1
+                prepare: Prepare = vote.msg
+                if prepare.digest != expected_digest:
+                    self.logger.warnf(
+                        "Got wrong digest at processPrepares for prepare with seq %d",
+                        prepare.seq,
+                    )
+                    continue
+                voter_ids.append(vote.sender)
+            if len(voter_ids) >= self.quorum - 1:
+                break
+            await self._next_event()
+
+        self.logger.infof(
+            "%d collected %d prepares from %s", self.self_id, len(voter_ids), voter_ids
+        )
+
+        prp_from = encode(PreparesFrom(ids=voter_ids))
+        self.my_proposal_sig = self.signer.sign_proposal(proposal, prp_from)
+
+        seq = self.proposal_sequence
+        commit = Commit(
+            view=self.number,
+            seq=seq,
+            digest=expected_digest,
+            signature=Signature(
+                signer=self.my_proposal_sig.signer,
+                value=self.my_proposal_sig.value,
+                msg=self.my_proposal_sig.msg,
+            ),
+        )
+        # Save our commit before broadcasting it.
+        self.state.save(CommitRecord(commit=commit))
+        self._curr_commit_sent = replace(commit, assist=True)
+        self.last_broadcast_sent = commit
+        self.logger.infof("Processed prepares for proposal with seq %d", seq)
+        self.phase = PREPARED
+
+    async def _prepared(self) -> None:
+        """PREPARED -> COMMITTED via quorum of verified commits
+        (view.go:326-349,519-551)."""
+        proposal = self.in_flight_proposal
+        signatures = await self._process_commits(proposal)
+
+        seq = self.proposal_sequence
+        self.logger.infof("%d processed commits for proposal with seq %d", self.self_id, seq)
+        if self.metrics:
+            self.metrics.count_batch_all.add(1)
+            self.metrics.count_txs_all.add(len(self.in_flight_requests))
+            size = len(proposal.metadata) + len(proposal.header) + len(proposal.payload)
+            for s in signatures:
+                size += len(s.value) + len(s.msg)
+            self.metrics.size_of_batch.add(size)
+            self.metrics.latency_batch_processing.observe(self._now() - self._begin_pre_prepare)
+
+        await self._decide(proposal, signatures, self.in_flight_requests)
+        self.phase = COMMITTED
+
+    async def _process_commits(self, proposal: Proposal) -> list[Signature]:
+        """Collect Q-1 valid commit signatures, verifying in batches."""
+        expected_digest = proposal_digest(proposal)
+        valid: list[Signature] = []
+        seen: set[int] = set()
+        taken = 0
+
+        while len(valid) < self.quorum - 1:
+            # gather every pending, digest-matching vote not yet verified
+            pending: list[Signature] = []
+            while taken < len(self.commits.votes):
+                vote = self.commits.votes[taken]
+                taken += 1
+                commit: Commit = vote.msg
+                if commit.digest != expected_digest:
+                    self.logger.warnf("Got wrong digest at processCommits for seq %d", commit.seq)
+                    continue
+                sig = commit.signature
+                if sig.signer in seen:
+                    continue
+                pending.append(sig)
+            if pending:
+                results = await self._verify_consenter_sigs_batch(pending, proposal)
+                for sig, aux in zip(pending, results):
+                    if aux is None:
+                        self.logger.warnf("Couldn't verify %d's signature", sig.signer)
+                        continue
+                    if sig.signer in seen:
+                        continue
+                    seen.add(sig.signer)
+                    valid.append(sig)
+                # more votes may have queued while verifying — drain w/o await
+                self._drain_inbox()
+                continue
+            if len(valid) >= self.quorum - 1:
+                break
+            await self._next_event()
+
+        self.logger.infof(
+            "%d collected %d commits from %s",
+            self.self_id, len(valid), sorted(s.signer for s in valid),
+        )
+        return valid
+
+    async def _verify_consenter_sigs_batch(
+        self, sigs: Sequence[Signature], proposal: Proposal
+    ) -> list:
+        batch_async = getattr(self.verifier, "verify_consenter_sigs_batch_async", None)
+        if batch_async is not None:
+            return await batch_async(sigs, proposal)
+        return self.verifier.verify_consenter_sigs_batch(sigs, proposal)
+
+    async def _decide(self, proposal, signatures, requests) -> None:
+        """view.go:851-858: prepare next sequence, then hand the decision to
+        the Controller and wait for delivery."""
+        self.logger.infof("Deciding on seq %d", self.proposal_sequence)
+        self.view_sequences.store(
+            ViewSequence(view_active=True, proposal_seq=self.proposal_sequence)
+        )
+        self._start_next_seq()
+        signatures = list(signatures) + [self.my_proposal_sig]
+        await self.decider.decide(proposal, signatures, requests)
+
+    def _start_next_seq(self) -> None:
+        """Pipeline swap: next-* become current (view.go:860-894)."""
+        prev_seq = self.proposal_sequence
+        self.proposal_sequence += 1
+        self.decisions_in_view += 1
+        if self.metrics:
+            self.metrics.proposal_sequence.set(self.proposal_sequence)
+            self.metrics.decisions_in_view.set(self.decisions_in_view)
+        self.logger.infof("Sequence: %d-->%d", prev_seq, self.proposal_sequence)
+
+        self._pre_prepare = self._next_pre_prepare
+        self._next_pre_prepare = None
+
+        self.prepares, self.next_prepares = self.next_prepares, self.prepares
+        self.next_prepares.clear()
+
+        self.commits, self.next_commits = self.next_commits, self.commits
+        self.next_commits.clear()
+
+    # ------------------------------------------------------------------ verify
+
+    async def _verify_proposal(
+        self, proposal: Proposal, prev_commits: list[Signature]
+    ) -> list:
+        """view.go:553-607 — structural, metadata, verification-sequence,
+        prev-commit-signature, and blacklist checks."""
+        requests = self.verifier.verify_proposal(proposal)
+
+        md = decode(ViewMetadata, proposal.metadata)
+
+        if md.view_id != self.number:
+            raise ValueError(f"invalid view number: expected {self.number} got {md.view_id}")
+        if md.latest_sequence != self.proposal_sequence:
+            raise ValueError(
+                f"invalid proposal sequence: expected {self.proposal_sequence} got {md.latest_sequence}"
+            )
+        if md.decisions_in_view != self.decisions_in_view:
+            raise ValueError(
+                f"invalid decisions in view: expected {self.decisions_in_view} got {md.decisions_in_view}"
+            )
+        expected_seq = self.verifier.verification_sequence()
+        if proposal.verification_sequence != expected_seq:
+            raise ValueError(
+                f"verification sequence mismatch: expected {expected_seq} got {proposal.verification_sequence}"
+            )
+
+        prepare_acks = await self._verify_prev_commit_signatures(prev_commits, expected_seq)
+        self._verify_blacklist(prev_commits, expected_seq, list(md.black_list), prepare_acks)
+
+        prev_commit_digest = commit_signatures_digest(prev_commits)
+        if prev_commit_digest != md.prev_commit_signature_digest and self.decisions_per_leader > 0:
+            raise ValueError("prev commit signatures received from leader mismatches the metadata digest")
+
+        return requests
+
+    async def _verify_prev_commit_signatures(
+        self, prev_commit_signatures: list[Signature], curr_verification_seq: int
+    ) -> Optional[dict[int, PreparesFrom]]:
+        """view.go:609-647 — batched here (one quorum-sized batch)."""
+        prev_prop_raw, _ = self.retrieve_checkpoint()
+        if prev_prop_raw.verification_sequence != curr_verification_seq:
+            self.logger.infof(
+                "Skipping verifying prev commit signatures due to verification sequence advancing from %d to %d",
+                prev_prop_raw.verification_sequence, curr_verification_seq,
+            )
+            return None
+
+        if not prev_commit_signatures:
+            return {}
+
+        results = await self._verify_consenter_sigs_batch(prev_commit_signatures, prev_prop_raw)
+        prepare_acks: dict[int, PreparesFrom] = {}
+        for sig, aux in zip(prev_commit_signatures, results):
+            if aux is None:
+                raise ValueError(f"failed verifying consenter signature of {sig.signer}")
+            prepare_acks[sig.signer] = decode(PreparesFrom, aux)
+        return prepare_acks
+
+    def _verify_blacklist(
+        self,
+        prev_commit_signatures: list[Signature],
+        curr_verification_seq: int,
+        pending_blacklist: list[int],
+        prepare_acks: Optional[dict[int, PreparesFrom]],
+    ) -> None:
+        """view.go:649-716 — recompute the deterministic blacklist update and
+        require byte-equality with the leader's."""
+        if self.decisions_per_leader == 0:
+            if pending_blacklist:
+                raise ValueError(
+                    f"rotation is inactive but blacklist is not empty: {pending_blacklist}"
+                )
+            return
+
+        prev_prop_raw, my_last_commit_sigs = self.retrieve_checkpoint()
+        prev_md = decode(ViewMetadata, prev_prop_raw.metadata) if prev_prop_raw.metadata else ViewMetadata()
+
+        if prev_prop_raw.verification_sequence != curr_verification_seq:
+            if list(prev_md.black_list) != pending_blacklist:
+                raise ValueError(
+                    f"blacklist changed ({prev_md.black_list} --> {pending_blacklist}) during reconfiguration"
+                )
+            self.logger.infof("Skipping verifying prev commits due to verification sequence advancing")
+            return
+
+        if self.membership_notifier is not None and self.membership_notifier.membership_change():
+            if list(prev_md.black_list) != pending_blacklist:
+                raise ValueError(
+                    f"blacklist changed ({prev_md.black_list} --> {pending_blacklist}) during membership change"
+                )
+            self.logger.infof("Skipping verifying prev commits due to membership change")
+            return
+
+        _, f = compute_quorum(self.n)
+
+        if self._blacklisting_supported(f, my_last_commit_sigs) and len(
+            prev_commit_signatures
+        ) < len(my_last_commit_sigs):
+            raise ValueError(
+                f"only {len(prev_commit_signatures)} out of {len(my_last_commit_sigs)} "
+                "required previous commits is included in pre-prepare"
+            )
+
+        expected = compute_blacklist_update(
+            current_leader=self.leader_id,
+            leader_rotation=self.decisions_per_leader > 0,
+            prev_md=prev_md,
+            n=self.n,
+            nodes=self.nodes_list,
+            curr_view=self.number,
+            prepares_from=prepare_acks or {},
+            f=f,
+            decisions_per_leader=self.decisions_per_leader,
+            logger=self.logger,
+            metrics=self.metrics_blacklist,
+        )
+        if pending_blacklist != expected:
+            raise ValueError(
+                f"proposed blacklist {pending_blacklist} differs from expected {expected} blacklist"
+            )
+
+    def _blacklisting_supported(self, f: int, my_last_commit_sigs: list[Signature]) -> bool:
+        """view.go:1064-1088 — f+1 witnesses of aux data activate blacklisting."""
+        if self._blacklist_supported:
+            return True
+        count = 0
+        for sig in my_last_commit_sigs:
+            aux = self.verifier.auxiliary_data(sig.msg)
+            if aux:
+                count += 1
+        supported = count > f
+        self._blacklist_supported = self._blacklist_supported or supported
+        return supported
+
+    # ------------------------------------------------------------------ assists
+
+    def _handle_prev_seq_message(self, msg_seq: int, sender: int, m: Message) -> None:
+        """Resend our previous prepare/commit to a lagging replica
+        (view.go:718-756)."""
+        if isinstance(m, PrePrepare):
+            self.logger.warnf(
+                "Got pre-prepare for sequence %d but we're in sequence %d",
+                msg_seq, self.proposal_sequence,
+            )
+            return
+        if isinstance(m, Prepare):
+            if m.assist:
+                return
+            if self._prev_prepare_sent is not None:
+                self.comm.send_consensus(sender, self._prev_prepare_sent)
+        elif isinstance(m, Commit):
+            if m.assist:
+                return
+            if self._prev_commit_sent is not None:
+                self.comm.send_consensus(sender, self._prev_commit_sent)
+
+    def _discover_if_sync_needed(self, sender: int, m: Message) -> None:
+        """f+1 matching future commit votes trigger a sync (view.go:758-818)."""
+        if not isinstance(m, Commit):
+            return
+        _, f = compute_quorum(self.n)
+        threshold = f + 1
+        self._last_voted_proposal_by_id[sender] = m
+        if len(self._last_voted_proposal_by_id) < threshold:
+            return
+        counts: dict[_ProposalInfo, int] = {}
+        for vote in self._last_voted_proposal_by_id.values():
+            info = _ProposalInfo(digest=vote.digest, view=vote.view, seq=vote.seq)
+            counts[info] = counts.get(info, 0) + 1
+        for info, count in counts.items():
+            if count < threshold:
+                continue
+            if info.view < self.number:
+                continue
+            if info.seq <= self.proposal_sequence and info.view == self.number:
+                continue
+            self.logger.warnf(
+                "Seen %d votes for digest %s in view %d, sequence %d but I am in view %d and seq %d",
+                count, info.digest, info.view, info.seq, self.number, self.proposal_sequence,
+            )
+            self._stop()
+            self.synchronizer.sync()
+            return
+
+    # ------------------------------------------------------------------ leader
+
+    def get_metadata(self) -> bytes:
+        """Build the next proposal's ViewMetadata incl. blacklist update and
+        prev-commit-signature digest (view.go:896-948)."""
+        metadata = ViewMetadata(
+            view_id=self.number,
+            latest_sequence=self.proposal_sequence,
+            decisions_in_view=self.decisions_in_view,
+        )
+        verification_seq = self.verifier.verification_sequence()
+        prev_prop, prev_sigs = self.retrieve_checkpoint()
+        prev_md = decode(ViewMetadata, prev_prop.metadata) if prev_prop.metadata else ViewMetadata()
+        metadata = replace(metadata, black_list=list(prev_md.black_list))
+        metadata = self._metadata_with_updated_blacklist(
+            metadata, verification_seq, prev_prop, prev_sigs
+        )
+        metadata = self._bind_commit_signatures(metadata, prev_sigs)
+        return encode(metadata)
+
+    def _metadata_with_updated_blacklist(
+        self, metadata: ViewMetadata, verification_seq: int, prev_prop, prev_sigs
+    ) -> ViewMetadata:
+        membership_change = (
+            self.membership_notifier.membership_change()
+            if self.membership_notifier is not None
+            else False
+        )
+        if verification_seq == prev_prop.verification_sequence and not membership_change:
+            return self._update_blacklist_metadata(metadata, prev_sigs, prev_prop.metadata)
+        if verification_seq != prev_prop.verification_sequence:
+            self.logger.infof(
+                "Skipping updating blacklist due to verification sequence changing from %d to %d",
+                prev_prop.verification_sequence, verification_seq,
+            )
+        if membership_change:
+            self.logger.infof("Skipping updating blacklist due to membership change")
+        return metadata
+
+    def _update_blacklist_metadata(
+        self, metadata: ViewMetadata, prev_sigs, prev_metadata: bytes
+    ) -> ViewMetadata:
+        """view.go:1022-1062."""
+        if self.decisions_per_leader == 0:
+            return replace(metadata, black_list=[])
+        prepares_from: dict[int, PreparesFrom] = {}
+        for sig in prev_sigs:
+            aux = self.verifier.auxiliary_data(sig.msg)
+            prepares_from[sig.signer] = decode(PreparesFrom, aux)
+        prev_md = decode(ViewMetadata, prev_metadata) if prev_metadata else ViewMetadata()
+        _, f = compute_quorum(self.n)
+        black_list = compute_blacklist_update(
+            current_leader=self.leader_id,
+            leader_rotation=self.decisions_per_leader > 0,
+            prev_md=prev_md,
+            n=self.n,
+            nodes=self.nodes_list,
+            curr_view=metadata.view_id,
+            prepares_from=prepares_from,
+            f=f,
+            decisions_per_leader=self.decisions_per_leader,
+            logger=self.logger,
+            metrics=self.metrics_blacklist,
+        )
+        return replace(metadata, black_list=black_list)
+
+    def _bind_commit_signatures(self, metadata: ViewMetadata, prev_sigs) -> ViewMetadata:
+        """view.go:979-998."""
+        if self.decisions_per_leader == 0:
+            return metadata
+        return replace(
+            metadata, prev_commit_signature_digest=commit_signatures_digest(prev_sigs)
+        )
+
+    def propose(self, proposal: Proposal) -> None:
+        """Leader: wrap as pre-prepare and self-deliver first so the WAL
+        records it before the broadcast (view.go:951-977)."""
+        prev_sigs: list[Signature] = []
+        if self.decisions_per_leader > 0:
+            _, prev_sigs = self.retrieve_checkpoint()
+        pp = PrePrepare(
+            view=self.number,
+            seq=self.proposal_sequence,
+            proposal=proposal,
+            prev_commit_signatures=list(prev_sigs),
+        )
+        self.handle_message(self.leader_id, pp)
+        self.logger.debugf(
+            "Proposing proposal sequence %d in view %d", self.proposal_sequence, self.number
+        )
+
+    # ------------------------------------------------------------------ misc
+
+    def _now(self) -> float:
+        import time
+
+        return time.monotonic()

@@ -1,0 +1,1041 @@
+"""The view-change sub-protocol: ViewChange -> ViewData -> NewView.
+
+Re-design of /root/reference/internal/bft/viewchanger.go:52-1363 — the most
+intricate component of the protocol.  Structure:
+
+- Nodes broadcast ``ViewChange{next_view}``; at f+1 (SpeedUpViewChange) or
+  quorum-1 they join, persist a ViewChange record, abort the current view,
+  and send signed ``ViewData`` (checkpoint + in-flight proposal) to the new
+  leader (viewchanger.go:364-456).
+- The new leader validates each ViewData — including delivering a last
+  decision it is one behind on (checkLastDecision ladder, :501-666) — and at
+  quorum runs ``check_in_flight`` (the agreed-in-flight decision rule,
+  :813-908) before broadcasting ``NewView``.
+- Every node validates the NewView's quorum of ViewData (:931-1095), commits
+  an agreed in-flight proposal by spinning up a special View with itself as
+  leader pre-seeded in PREPARED (:1186-1306), persists a NewView record, and
+  informs the Controller.
+
+Quorum signature checks on last decisions (``validate_last_decision``,
+:681-727) are batched through the Verifier — the second TPU batching target
+after commit processing.
+
+Timing (resend interval, view-change timeout with exponential backoff) is
+tick-driven from the shared Scheduler.  Ticks are delivered as events to the
+main loop, except during the in-flight wait where a live tick callback
+drives the timeout — mirroring the reference's two select sites.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+from ..api import Logger, Signer, Verifier
+from ..codec import decode, encode
+from ..messages import (
+    Commit,
+    Message,
+    NewView,
+    NewViewRecord,
+    Proposal,
+    Signature,
+    SignedViewData,
+    ViewChange,
+    ViewChangeRecord,
+    ViewData,
+    ViewMetadata,
+)
+from ..metrics import BlacklistMetrics, ViewChangeMetrics, ViewMetrics
+from ..types import Checkpoint, proposal_digest
+from .state import PREPARED
+from .util import InFlightData, NextViews, VoteSet, compute_quorum, get_leader_id
+from .view import View, ViewSequencesHolder
+
+
+def validate_in_flight(in_flight_proposal: Optional[Proposal], last_sequence: int) -> None:
+    """viewchanger.go:788-806 — raises if invalid."""
+    if in_flight_proposal is None:
+        return
+    if not in_flight_proposal.metadata:
+        raise ValueError("in flight proposal metadata is nil")
+    md = decode(ViewMetadata, in_flight_proposal.metadata)
+    if md.latest_sequence != last_sequence + 1:
+        raise ValueError(
+            f"the in flight proposal sequence is {md.latest_sequence} while the last "
+            f"decision sequence is {last_sequence}"
+        )
+
+
+async def validate_last_decision(
+    vd: ViewData, quorum: int, n: int, verifier: Verifier
+) -> int:
+    """viewchanger.go:681-727 — verify a quorum of consenter signatures on
+    the last decision (batched); returns its sequence.  Raises if invalid."""
+    if vd.last_decision is None:
+        raise ValueError("the last decision is not set")
+    if not vd.last_decision.metadata:
+        return 0  # genesis proposal: nothing to validate
+    md = decode(ViewMetadata, vd.last_decision.metadata)
+    if md.view_id >= vd.next_view:
+        raise ValueError(
+            f"last decision view {md.view_id} is greater or equal to requested next view {vd.next_view}"
+        )
+    num_sigs = len(vd.last_decision_signatures)
+    if num_sigs < quorum:
+        raise ValueError(f"there are only {num_sigs} last decision signatures")
+    seen: set[int] = set()
+    unique_sigs = []
+    for sig in vd.last_decision_signatures:
+        if sig.signer in seen:
+            continue
+        seen.add(sig.signer)
+        unique_sigs.append(sig)
+    batch_async = getattr(verifier, "verify_consenter_sigs_batch_async", None)
+    if batch_async is not None:
+        results = await batch_async(unique_sigs, vd.last_decision)
+    else:
+        results = verifier.verify_consenter_sigs_batch(unique_sigs, vd.last_decision)
+    valid = sum(1 for r in results if r is not None)
+    if any(r is None for r in results):
+        raise ValueError("last decision signature is invalid")
+    if valid < quorum:
+        raise ValueError(f"there are only {valid} valid last decision signatures")
+    return md.latest_sequence
+
+
+def max_last_decision_sequence(messages: list[ViewData]) -> int:
+    """viewchanger.go:910-929."""
+    mx = 0
+    for vd in messages:
+        if vd.last_decision is None:
+            raise ValueError("The last decision is not set")
+        if not vd.last_decision.metadata:
+            continue
+        md = decode(ViewMetadata, vd.last_decision.metadata)
+        mx = max(mx, md.latest_sequence)
+    return mx
+
+
+def check_in_flight(
+    messages: list[ViewData], f: int, quorum: int, n: int, verifier: Verifier
+) -> tuple[bool, bool, Optional[Proposal]]:
+    """The agreed-in-flight-proposal decision rule (viewchanger.go:813-908).
+
+    Returns (ok, no_in_flight, proposal):
+      condition A — some prepared proposal at the expected sequence has >=f+1
+        pre-prepare witnesses (A2) and >=quorum no-argument votes (A1);
+      condition B — >=quorum of messages support that nothing is in flight.
+    """
+    expected_sequence = max_last_decision_sequence(messages) + 1
+    possible: list[dict] = []
+    props_and_md: list[tuple[Optional[Proposal], Optional[ViewMetadata]]] = []
+    no_in_flight_count = 0
+
+    for vd in messages:
+        if vd.in_flight_proposal is None:
+            no_in_flight_count += 1
+            props_and_md.append((None, None))
+            continue
+        if not vd.in_flight_proposal.metadata:
+            raise ValueError("view data message has in flight proposal with nil metadata")
+        md = decode(ViewMetadata, vd.in_flight_proposal.metadata)
+        props_and_md.append((vd.in_flight_proposal, md))
+        if md.latest_sequence != expected_sequence:
+            no_in_flight_count += 1
+            continue
+        if not vd.in_flight_prepared:
+            no_in_flight_count += 1
+            continue
+        if not any(p["proposal"] == vd.in_flight_proposal for p in possible):
+            possible.append({"proposal": vd.in_flight_proposal, "preprepared": 0, "no_argument": 0})
+
+    for prop, md in props_and_md:
+        for p in possible:
+            if prop is None:
+                p["no_argument"] += 1
+                continue
+            if md.latest_sequence != expected_sequence:
+                p["no_argument"] += 1
+                continue
+            if prop == p["proposal"]:
+                p["no_argument"] += 1
+                p["preprepared"] += 1
+
+    for p in possible:
+        if p["preprepared"] < f + 1:
+            continue  # condition A2 fails
+        if p["no_argument"] < quorum:
+            continue  # condition A1 fails
+        return True, False, p["proposal"]
+
+    if no_in_flight_count >= quorum:
+        return True, True, None
+
+    return False, False, None
+
+
+class _InFlightDecider:
+    """Decider/FailureDetector/Synchronizer facade handed to the special
+    in-flight View (viewchanger.go:1308-1345)."""
+
+    def __init__(self, vc: "ViewChanger"):
+        self.vc = vc
+
+    async def decide(self, proposal, signatures, requests) -> None:
+        vc = self.vc
+        if vc._in_flight_view is not None:
+            vc._in_flight_view._stop()
+        vc.logger.debugf("Delivering to app from in-flight Decide the last decision proposal")
+        reconfig = await vc.application.deliver(proposal, signatures)
+        if reconfig.in_latest_decision:
+            vc.close()
+        for info in requests:
+            try:
+                vc.requests_timer.remove_request(info)
+            except Exception:
+                pass
+        vc.pruner.maybe_prune_revoked_requests()
+        if vc._in_flight_decide is not None and not vc._in_flight_decide.done():
+            vc._in_flight_decide.set_result(True)
+
+    def complain(self, view_num: int, stop_view: bool) -> None:
+        self.vc.logger.panicf(
+            "Node %d has complained while in the view for the in flight proposal",
+            self.vc.self_id,
+        )
+
+    def sync(self) -> None:
+        vc = self.vc
+        vc.logger.debugf(
+            "Node %d is calling sync because the in flight proposal view has asked to sync",
+            vc.self_id,
+        )
+        vc.synchronizer.sync()
+        if vc._in_flight_sync is not None and not vc._in_flight_sync.done():
+            vc._in_flight_sync.set_result(True)
+
+
+class ViewChanger:
+    def __init__(
+        self,
+        *,
+        self_id: int,
+        n: int,
+        nodes_list: list[int],
+        leader_rotation: bool,
+        decisions_per_leader: int,
+        speed_up_view_change: bool,
+        logger: Logger,
+        signer: Signer,
+        verifier: Verifier,
+        checkpoint: Checkpoint,
+        in_flight: InFlightData,
+        state,
+        resend_timeout: float,
+        view_change_timeout: float,
+        in_msg_q_size: int,
+        metrics_view_change: Optional[ViewChangeMetrics] = None,
+        metrics_blacklist: Optional[BlacklistMetrics] = None,
+        metrics_view: Optional[ViewMetrics] = None,
+    ):
+        self.self_id = self_id
+        self.n = n
+        self.nodes_list = nodes_list
+        self.leader_rotation = leader_rotation
+        self.decisions_per_leader = decisions_per_leader
+        self.speed_up_view_change = speed_up_view_change
+        self.logger = logger
+        self.signer = signer
+        self.verifier = verifier
+        self.checkpoint = checkpoint
+        self.in_flight = in_flight
+        self.state = state
+        self.resend_timeout = resend_timeout
+        self.view_change_timeout = view_change_timeout
+        self.in_msg_q_size = in_msg_q_size
+        self.metrics = metrics_view_change
+        self.metrics_blacklist = metrics_blacklist
+        self.metrics_view = metrics_view
+
+        # wired later by the Consensus facade (consensus.go:445-450,466-470)
+        self.comm = None  # Controller (broadcast + send)
+        self.synchronizer = None  # Controller (sync trigger)
+        self.application = None  # MutuallyExclusiveDeliver
+        self.controller = None  # ViewController: view_changed / abort_view
+        self.requests_timer = None  # Pool
+        self.pruner = None  # Controller
+        self.view_sequences: Optional[ViewSequencesHolder] = None
+
+        self.quorum = 0
+        self.f = 0
+        self.curr_view = 0
+        self.real_view = 0
+        self.next_view = 0
+        self._events: asyncio.Queue = asyncio.Queue()
+        self._stopped = False
+        self._task: Optional[asyncio.Task] = None
+        self._restore_on_start = False
+
+        self.view_change_msgs = VoteSet(lambda _s, m: isinstance(m, ViewChange))
+        self.view_data_msgs = VoteSet(lambda _s, m: isinstance(m, SignedViewData))
+        self.nvs = NextViews()
+
+        self._last_tick = 0.0
+        self._last_resend = 0.0
+        self._start_view_change_time = 0.0
+        self._check_timeout = False
+        self._back_off_factor = 1
+        self._committed_during_view_change: Optional[ViewMetadata] = None
+        self._pending_changes = 0
+
+        self._in_flight_view: Optional[View] = None
+        self._in_flight_decide: Optional[asyncio.Future] = None
+        self._in_flight_sync: Optional[asyncio.Future] = None
+        self._in_flight_tick_cb = None
+
+    # ------------------------------------------------------------------ life
+
+    def start(self, start_view_number: int) -> None:
+        """viewchanger.go:119-159."""
+        self.quorum, self.f = compute_quorum(self.n)
+        self.curr_view = start_view_number
+        self.real_view = self.curr_view
+        self.next_view = self.curr_view
+        self._set_view_metrics()
+        self.nvs.clear()
+        self.view_change_msgs.clear()
+        self.view_data_msgs.clear()
+        self._back_off_factor = 1
+        self._stopped = False
+        self._task = asyncio.get_running_loop().create_task(
+            self._run(), name=f"viewchanger-{self.self_id}"
+        )
+
+    def _set_view_metrics(self) -> None:
+        if self.metrics:
+            self.metrics.current_view.set(self.curr_view)
+            self.metrics.real_view.set(self.real_view)
+            self.metrics.next_view.set(self.next_view)
+
+    def close(self) -> None:
+        if not self._stopped:
+            self._stopped = True
+            self._events.put_nowait(("stop",))
+            for fut in (self._in_flight_decide, self._in_flight_sync):
+                if fut is not None and not fut.done():
+                    fut.set_result(False)
+
+    async def stop(self) -> None:
+        self.close()
+        if self._task is not None:
+            await self._task
+            self._task = None
+
+    # ------------------------------------------------------------------ inputs
+
+    def handle_message(self, sender: int, m: Message) -> None:
+        if self._stopped:
+            return
+        self._events.put_nowait(("msg", sender, m))
+
+    def handle_view_message(self, sender: int, m: Message) -> None:
+        """Pass view messages to the in-flight view (viewchanger.go:1347-1356)."""
+        view = self._in_flight_view
+        if view is not None:
+            self.logger.debugf("Node %d is passing a message to the in flight view", self.self_id)
+            view.handle_message(sender, m)
+
+    def start_view_change(self, view: int, stop_view: bool) -> None:
+        """External trigger (viewchanger.go:356-361); 2-slot like the
+        reference's buffered channel."""
+        if self._stopped or self._pending_changes >= 2:
+            return
+        self._pending_changes += 1
+        self._events.put_nowait(("change", view, stop_view))
+
+    def inform_new_view(self, view: int) -> None:
+        if self._stopped:
+            return
+        self._events.put_nowait(("inform", view))
+
+    def restore_trigger(self) -> None:
+        """Restore a persisted ViewChange on startup (consensus.go:487-494)."""
+        self._events.put_nowait(("restore",))
+
+    def tick(self, now: float) -> None:
+        """Driven by the shared scheduler Ticker."""
+        if self._stopped:
+            return
+        if self._in_flight_tick_cb is not None:
+            self._in_flight_tick_cb(now)
+            return
+        self._events.put_nowait(("tick", now))
+
+    # ------------------------------------------------------------------ loop
+
+    async def _run(self) -> None:
+        while True:
+            evt = await self._events.get()
+            kind = evt[0]
+            if kind == "stop":
+                return
+            try:
+                if kind == "msg":
+                    await self._process_msg(evt[1], evt[2])
+                elif kind == "change":
+                    self._pending_changes -= 1
+                    self._start_view_change(evt[1], evt[2])
+                elif kind == "tick":
+                    self._last_tick = evt[1]
+                    self._check_if_resend_view_change(evt[1])
+                    self._check_if_timeout(evt[1])
+                elif kind == "inform":
+                    self._inform_new_view(evt[1])
+                elif kind == "restore":
+                    await self._process_view_change_msg(restore=True)
+            except Exception as e:
+                self.logger.errorf("ViewChanger %d event %s failed: %r", self.self_id, kind, e)
+                raise
+
+    # ------------------------------------------------------------------ timing
+
+    def get_leader(self) -> int:
+        return get_leader_id(
+            self.curr_view, self.n, self.nodes_list, self.leader_rotation,
+            0, self.decisions_per_leader, self._blacklist(),
+        )
+
+    def _blacklist(self) -> list[int]:
+        prop, _ = self.checkpoint.get()
+        if not prop.metadata:
+            return []
+        return list(decode(ViewMetadata, prop.metadata).black_list)
+
+    def _check_if_resend_view_change(self, now: float) -> None:
+        """viewchanger.go:232-252."""
+        if self._last_resend + self.resend_timeout > now:
+            return
+        if self._check_timeout:
+            self.comm.broadcast_consensus(ViewChange(next_view=self.next_view))
+            self.logger.debugf(
+                "Node %d resent a view change message with next view %d",
+                self.self_id, self.next_view,
+            )
+        self._last_resend = now
+
+    def _check_if_timeout(self, now: float) -> bool:
+        """viewchanger.go:254-270 — exponential backoff."""
+        if not self._check_timeout:
+            return False
+        if self._start_view_change_time + self.view_change_timeout * self._back_off_factor > now:
+            return False
+        self.logger.debugf(
+            "Node %d got a view change timeout, the current view is %d",
+            self.self_id, self.curr_view,
+        )
+        self._check_timeout = False
+        self._back_off_factor += 1
+        self.synchronizer.sync()
+        self.start_view_change(self.curr_view, False)
+        return True
+
+    # ------------------------------------------------------------------ msgs
+
+    async def _process_msg(self, sender: int, m: Message) -> None:
+        """viewchanger.go:272-326."""
+        if isinstance(m, ViewChange):
+            self.nvs.register_next(m.next_view, sender)
+            if m.next_view == self.curr_view + 1:
+                self.view_change_msgs.register_vote(sender, m)
+                await self._process_view_change_msg(restore=False)
+                return
+            if (
+                self.next_view == self.curr_view + 1
+                and m.next_view > self.real_view
+                and m.next_view < self.curr_view + 1
+                and self.nvs.send_recv(m.next_view, sender)
+            ):
+                # help the lagging nodes
+                self.comm.broadcast_consensus(ViewChange(next_view=m.next_view))
+                self.logger.warnf(
+                    "Node %d got viewChange from %d with view %d, expected view %d, helping lagging nodes",
+                    self.self_id, sender, m.next_view, self.curr_view + 1,
+                )
+                return
+            self.logger.warnf(
+                "Node %d got viewChange from %d with view %d, expected view %d",
+                self.self_id, sender, m.next_view, self.curr_view + 1,
+            )
+            return
+
+        if isinstance(m, SignedViewData):
+            if not await self._validate_view_data_msg(m, sender):
+                return
+            self.view_data_msgs.register_vote(sender, m)
+            await self._process_view_data_msg()
+            return
+
+        if isinstance(m, NewView):
+            leader = self.get_leader()
+            if sender != leader:
+                self.logger.warnf(
+                    "Node %d got newView from %d, expected sender to be %d the next leader",
+                    self.self_id, sender, leader,
+                )
+                return
+            await self._process_new_view_msg(m)
+
+    def _inform_new_view(self, view: int) -> None:
+        """viewchanger.go:335-353."""
+        if view < self.curr_view:
+            return
+        self.logger.debugf("Node %d was informed of a new view %d", self.self_id, view)
+        self.curr_view = view
+        self.real_view = view
+        self.next_view = view
+        self._set_view_metrics()
+        self.nvs.clear()
+        self.view_change_msgs.clear()
+        self.view_data_msgs.clear()
+        self._check_timeout = False
+        self._back_off_factor = 1
+        self.requests_timer.restart_timers()
+
+    def _start_view_change(self, view: int, stop_view: bool) -> None:
+        """viewchanger.go:364-391."""
+        if view < self.curr_view:
+            return
+        if self.next_view == self.curr_view + 1:
+            self.logger.debugf(
+                "Node %d has already started view change with last view %d",
+                self.self_id, self.curr_view,
+            )
+            self._check_timeout = True
+            return
+        self.next_view = self.curr_view + 1
+        if self.metrics:
+            self.metrics.next_view.set(self.next_view)
+        self.requests_timer.stop_timers()
+        self.comm.broadcast_consensus(ViewChange(next_view=self.next_view))
+        self.logger.debugf(
+            "Node %d started view change, last view is %d", self.self_id, self.curr_view
+        )
+        if stop_view:
+            self.controller.abort_view(self.curr_view)
+        self._start_view_change_time = self._last_tick
+        self._check_timeout = True
+
+    async def _process_view_change_msg(self, restore: bool) -> None:
+        """viewchanger.go:393-431."""
+        if (len(self.view_change_msgs.voted) == self.f + 1 and self.speed_up_view_change) or restore:
+            self.logger.debugf(
+                "Node %d is joining view change, last view is %d", self.self_id, self.curr_view
+            )
+            self._start_view_change(self.curr_view, True)
+        if len(self.view_change_msgs.voted) < self.quorum - 1 and not restore:
+            return
+        if not self.speed_up_view_change:
+            self.logger.debugf(
+                "Node %d is joining view change (quorum), last view is %d",
+                self.self_id, self.curr_view,
+            )
+            self._start_view_change(self.curr_view, True)
+        if not restore:
+            self.state.save(ViewChangeRecord(view_change=ViewChange(next_view=self.curr_view)))
+        self.controller.abort_view(self.curr_view)
+        self.curr_view = self.next_view
+        if self.metrics:
+            self.metrics.current_view.set(self.curr_view)
+        self.view_change_msgs.clear()
+        self.view_data_msgs.clear()
+        msg = self._prepare_view_data_msg()
+        leader = self.get_leader()
+        if leader == self.self_id:
+            self.view_data_msgs.register_vote(self.self_id, msg)
+        else:
+            self.comm.send_consensus(leader, msg)
+        self.logger.debugf(
+            "Node %d sent view data msg, with next view %d, to the new leader %d",
+            self.self_id, self.curr_view, leader,
+        )
+
+    def _prepare_view_data_msg(self) -> SignedViewData:
+        """viewchanger.go:433-456."""
+        last_decision, last_decision_signatures = self.checkpoint.get()
+        in_flight = self._get_in_flight(last_decision)
+        prepared = self.in_flight.is_in_flight_prepared()
+        vd = ViewData(
+            next_view=self.curr_view,
+            last_decision=last_decision,
+            last_decision_signatures=list(last_decision_signatures),
+            in_flight_proposal=in_flight,
+            in_flight_prepared=prepared,
+        )
+        vd_bytes = encode(vd)
+        sig = self.signer.sign(vd_bytes)
+        return SignedViewData(raw_view_data=vd_bytes, signer=self.self_id, signature=sig)
+
+    def _get_in_flight(self, last_decision: Proposal) -> Optional[Proposal]:
+        """viewchanger.go:458-499."""
+        in_flight = self.in_flight.in_flight_proposal()
+        if in_flight is None:
+            return None
+        if not in_flight.metadata:
+            self.logger.panicf("Node %d's in flight proposal metadata is not set", self.self_id)
+        in_flight_md = decode(ViewMetadata, in_flight.metadata)
+        if last_decision is None:
+            self.logger.panicf("%d The given last decision is nil", self.self_id)
+        if not last_decision.metadata:
+            return in_flight  # first proposal after genesis
+        last_md = decode(ViewMetadata, last_decision.metadata)
+        if in_flight_md.latest_sequence == last_md.latest_sequence:
+            return None  # not an actual in-flight proposal
+        if (
+            in_flight_md.latest_sequence + 1 == last_md.latest_sequence
+            and self._committed_during_view_change is not None
+            and self._committed_during_view_change.latest_sequence == last_md.latest_sequence
+        ):
+            self.logger.infof(
+                "Node %d's in flight proposal sequence is %d while already committed decision %d "
+                "(committed during the view change)",
+                self.self_id, in_flight_md.latest_sequence, last_md.latest_sequence,
+            )
+            return None
+        return in_flight
+
+    # ------------------------------------------------------------------ viewdata (leader)
+
+    async def _validate_view_data_msg(self, svd: SignedViewData, sender: int) -> bool:
+        """viewchanger.go:501-533."""
+        if self.get_leader() != self.self_id:
+            self.logger.warnf(
+                "Node %d got viewData from %d, but is not the next leader of view %d",
+                self.self_id, sender, self.curr_view,
+            )
+            return False
+        try:
+            vd = decode(ViewData, svd.raw_view_data)
+        except Exception as e:
+            self.logger.errorf(
+                "Node %d was unable to decode viewData message from %d: %s",
+                self.self_id, sender, e,
+            )
+            return False
+        if vd.next_view != self.curr_view:
+            self.logger.warnf(
+                "Node %d got viewData from %d with next view %d, but is in view %d",
+                self.self_id, sender, vd.next_view, self.curr_view,
+            )
+            return False
+        valid, last_decision_sequence = await self._check_last_decision(svd, sender)
+        if not valid:
+            self.logger.warnf(
+                "Node %d got viewData from %d, but the check of the last decision didn't pass",
+                self.self_id, sender,
+            )
+            return False
+        try:
+            validate_in_flight(vd.in_flight_proposal, last_decision_sequence)
+        except ValueError as e:
+            self.logger.warnf(
+                "Node %d got viewData from %d, but the in flight proposal is invalid: %s",
+                self.self_id, sender, e,
+            )
+            return False
+        return True
+
+    def _extract_current_sequence(self) -> tuple[int, Proposal]:
+        """viewchanger.go:668-679."""
+        my_last_decision, _ = self.checkpoint.get()
+        if not my_last_decision.metadata:
+            return 0, my_last_decision
+        md = decode(ViewMetadata, my_last_decision.metadata)
+        return md.latest_sequence, my_last_decision
+
+    async def _check_last_decision(
+        self, svd: SignedViewData, sender: int
+    ) -> tuple[bool, int]:
+        """The checkLastDecision ladder (viewchanger.go:535-666)."""
+        try:
+            vd = decode(ViewData, svd.raw_view_data)
+        except Exception:
+            return False, 0
+        if vd.last_decision is None:
+            return False, 0
+
+        my_sequence, my_last_decision = self._extract_current_sequence()
+
+        if not vd.last_decision.metadata:  # genesis proposal
+            if my_sequence > 0:
+                return False, 0  # we are ahead
+            return True, 0
+
+        last_md = decode(ViewMetadata, vd.last_decision.metadata)
+        if last_md.view_id >= vd.next_view:
+            return False, 0
+        if last_md.latest_sequence > my_sequence + 1:
+            return False, 0  # future decision; might lack config to validate
+        if last_md.latest_sequence < my_sequence:
+            return False, 0  # past decision
+        if last_md.latest_sequence == my_sequence:
+            # same sequence: verify message signature + compare decisions
+            if svd.signer != sender:
+                return False, 0
+            try:
+                self.verifier.verify_signature(
+                    Signature(signer=svd.signer, value=svd.signature, msg=svd.raw_view_data)
+                )
+            except Exception as e:
+                self.logger.warnf(
+                    "Node %d got viewData from %d, but signature is invalid: %s",
+                    self.self_id, sender, e,
+                )
+                return False, 0
+            if vd.last_decision != my_last_decision:
+                self.logger.warnf(
+                    "Node %d got viewData from %d at same sequence but last decisions differ",
+                    self.self_id, sender,
+                )
+                return False, 0
+            return True, last_md.latest_sequence
+
+        if last_md.latest_sequence != my_sequence + 1:
+            return False, 0
+
+        # We are one behind: validate the decision and deliver it.
+        try:
+            await validate_last_decision(vd, self.quorum, self.n, self.verifier)
+        except ValueError as e:
+            self.logger.warnf(
+                "Node %d got viewData from %d, but the last decision is invalid: %s",
+                self.self_id, sender, e,
+            )
+            return False, 0
+
+        await self._deliver_decision(vd.last_decision, list(vd.last_decision_signatures))
+        md = decode(ViewMetadata, vd.last_decision.metadata)
+        self._committed_during_view_change = md
+
+        if self._stopped:  # a reconfig may have stopped us during delivery
+            return False, 0
+
+        if svd.signer != sender:
+            return False, 0
+        try:
+            self.verifier.verify_signature(
+                Signature(signer=svd.signer, value=svd.signature, msg=svd.raw_view_data)
+            )
+        except Exception:
+            return False, 0
+        return True, last_md.latest_sequence
+
+    async def _process_view_data_msg(self) -> None:
+        """Leader: quorum of ViewData -> NewView (viewchanger.go:747-785)."""
+        if len(self.view_data_msgs.voted) < self.quorum:
+            return
+        self.logger.debugf("Node %d got a quorum of viewData messages", self.self_id)
+        messages = [decode(ViewData, v.msg.raw_view_data) for v in self.view_data_msgs.votes]
+        ok, _, _ = check_in_flight(messages, self.f, self.quorum, self.n, self.verifier)
+        if not ok:
+            self.logger.debugf("Node %d checked the in flight and it was invalid", self.self_id)
+            return
+        my_msg = self._prepare_view_data_msg()  # it might have changed by now
+        signed_msgs = [my_msg]
+        for vote in self.view_data_msgs.votes:
+            if vote.sender == self.self_id:
+                continue
+            signed_msgs.append(vote.msg)
+        nv = NewView(signed_view_data=signed_msgs)
+        self.logger.debugf("Node %d is broadcasting a new view msg", self.self_id)
+        self.comm.broadcast_consensus(nv)
+        await self._process_msg(self.self_id, nv)  # also process at self
+        self.view_data_msgs.clear()
+
+    # ------------------------------------------------------------------ newview (all)
+
+    async def _validate_new_view_msg(self, msg: NewView) -> tuple[bool, bool, bool]:
+        """viewchanger.go:931-1095 — returns (valid, called_sync, called_deliver)."""
+        seen: set[int] = set()
+        valid_count = 0
+        my_sequence, my_last_decision = self._extract_current_sequence()
+
+        for svd in msg.signed_view_data:
+            if svd.signer in seen:
+                continue
+            seen.add(svd.signer)
+            try:
+                vd = decode(ViewData, svd.raw_view_data)
+            except Exception as e:
+                self.logger.errorf("Unable to decode viewData in newView: %s", e)
+                return False, False, False
+            if vd.next_view != self.curr_view:
+                self.logger.warnf(
+                    "Node %d processing newView: nextView is %d while currView is %d",
+                    self.self_id, vd.next_view, self.curr_view,
+                )
+                return False, False, False
+            if vd.last_decision is None:
+                return False, False, False
+
+            if not vd.last_decision.metadata:  # genesis
+                if my_sequence > 0:
+                    try:
+                        validate_in_flight(vd.in_flight_proposal, 0)
+                    except ValueError:
+                        return False, False, False
+                    valid_count += 1
+                    continue
+                try:
+                    self.verifier.verify_signature(
+                        Signature(signer=svd.signer, value=svd.signature, msg=svd.raw_view_data)
+                    )
+                    validate_in_flight(vd.in_flight_proposal, 0)
+                except Exception:
+                    return False, False, False
+                valid_count += 1
+                continue
+
+            last_md = decode(ViewMetadata, vd.last_decision.metadata)
+            if last_md.view_id >= vd.next_view:
+                return False, False, False
+
+            if last_md.latest_sequence > my_sequence + 1:
+                # future decision — sync
+                self.synchronizer.sync()
+                return True, True, False
+
+            if last_md.latest_sequence < my_sequence:
+                try:
+                    validate_in_flight(vd.in_flight_proposal, last_md.latest_sequence)
+                except ValueError:
+                    return False, False, False
+                valid_count += 1
+                continue
+
+            if last_md.latest_sequence == my_sequence:
+                try:
+                    self.verifier.verify_signature(
+                        Signature(signer=svd.signer, value=svd.signature, msg=svd.raw_view_data)
+                    )
+                except Exception:
+                    return False, False, False
+                if vd.last_decision != my_last_decision:
+                    return False, False, False
+                try:
+                    validate_in_flight(vd.in_flight_proposal, last_md.latest_sequence)
+                except ValueError:
+                    return False, False, False
+                valid_count += 1
+                continue
+
+            if last_md.latest_sequence != my_sequence + 1:
+                return False, False, False
+
+            # one behind — validate, deliver, then verify message sig
+            try:
+                await validate_last_decision(vd, self.quorum, self.n, self.verifier)
+            except ValueError as e:
+                self.logger.warnf("newView last decision invalid: %s", e)
+                return False, False, False
+            await self._deliver_decision(
+                vd.last_decision, list(vd.last_decision_signatures)
+            )
+            if self._stopped:
+                return False, False, False
+            try:
+                self.verifier.verify_signature(
+                    Signature(signer=svd.signer, value=svd.signature, msg=svd.raw_view_data)
+                )
+                validate_in_flight(vd.in_flight_proposal, last_md.latest_sequence)
+            except Exception:
+                return False, False, False
+            return True, False, True
+
+        if valid_count < self.quorum:
+            self.logger.warnf(
+                "Node %d processing newView: only %d valid view data messages (quorum %d)",
+                self.self_id, valid_count, self.quorum,
+            )
+            return False, False, False
+        return True, False, False
+
+    async def _process_new_view_msg(self, msg: NewView) -> None:
+        """viewchanger.go:1110-1167."""
+        valid, called_sync, called_deliver = await self._validate_new_view_msg(msg)
+        while called_deliver:
+            self.logger.debugf("Node %d processed newView and delivered a proposal", self.self_id)
+            valid, called_sync, called_deliver = await self._validate_new_view_msg(msg)
+        if not valid:
+            self.logger.warnf("Node %d processing newView: message invalid", self.self_id)
+            return
+        if called_sync:
+            return
+
+        messages = [
+            decode(ViewData, svd.raw_view_data) for svd in msg.signed_view_data
+        ]
+        ok, no_in_flight, in_flight_proposal = check_in_flight(
+            messages, self.f, self.quorum, self.n, self.verifier
+        )
+        if not ok:
+            self.logger.debugf("In flight check by node %d did not pass", self.self_id)
+            return
+        if not no_in_flight and not await self._commit_in_flight_proposal(in_flight_proposal):
+            self.logger.warnf(
+                "Node %d was unable to commit the in flight proposal, not changing the view",
+                self.self_id,
+            )
+            return
+
+        my_sequence, _ = self._extract_current_sequence()
+        self.state.save(
+            NewViewRecord(
+                metadata=ViewMetadata(view_id=self.curr_view, latest_sequence=my_sequence)
+            )
+        )
+        if self._stopped:
+            return
+        self.real_view = self.curr_view
+        if self.metrics:
+            self.metrics.real_view.set(self.real_view)
+        self.nvs.clear()
+        self.controller.view_changed(self.curr_view, my_sequence + 1)
+        self.requests_timer.restart_timers()
+        self._check_timeout = False
+        self._back_off_factor = 1
+
+    async def _deliver_decision(self, proposal: Proposal, signatures: list[Signature]) -> None:
+        """viewchanger.go:1169-1184."""
+        reconfig = await self.application.deliver(proposal, signatures)
+        if reconfig.in_latest_decision:
+            self.close()
+        for info in self.verifier.requests_from_proposal(proposal):
+            try:
+                self.requests_timer.remove_request(info)
+            except Exception:
+                pass
+        self.pruner.maybe_prune_revoked_requests()
+
+    # ------------------------------------------------------------------ in-flight commit
+
+    async def _commit_in_flight_proposal(self, proposal: Optional[Proposal]) -> bool:
+        """Spin up a special PREPARED View with self as leader to commit the
+        agreed in-flight proposal (viewchanger.go:1186-1306)."""
+        my_last_decision, _ = self.checkpoint.get()
+        if proposal is None:
+            self.logger.panicf("The in flight proposal is nil")
+        proposal_md = decode(ViewMetadata, proposal.metadata)
+
+        if my_last_decision.metadata:
+            last_md = decode(ViewMetadata, my_last_decision.metadata)
+            if last_md.latest_sequence == proposal_md.latest_sequence:
+                if my_last_decision != proposal:
+                    self.logger.warnf(
+                        "Node %d last decision differs from in-flight proposal at same sequence",
+                        self.self_id,
+                    )
+                    return False
+                return True  # already decided on it
+            if last_md.latest_sequence != proposal_md.latest_sequence - 1:
+                self.logger.panicf(
+                    "Node %d got in-flight proposal with sequence %d while last decision is %d",
+                    self.self_id, proposal_md.latest_sequence, last_md.latest_sequence,
+                )
+
+        decider = _InFlightDecider(self)
+        view = View(
+            retrieve_checkpoint=self.checkpoint.get,
+            decisions_per_leader=self.decisions_per_leader,
+            self_id=self.self_id,
+            n=self.n,
+            nodes_list=self.nodes_list,
+            number=proposal_md.view_id,
+            leader_id=self.self_id,  # so no byzantine leader causes a complain
+            quorum=self.quorum,
+            decider=decider,
+            failure_detector=decider,
+            synchronizer=decider,
+            logger=self.logger,
+            comm=self.comm,
+            verifier=self.verifier,
+            signer=self.signer,
+            membership_notifier=None,
+            proposal_sequence=proposal_md.latest_sequence,
+            decisions_in_view=0,
+            state=self.state,
+            in_msg_q_size=self.in_msg_q_size,
+            view_sequences=self.view_sequences,
+            metrics_view=self.metrics_view,
+            metrics_blacklist=self.metrics_blacklist,
+        )
+        view.phase = PREPARED
+        view.in_flight_proposal = proposal
+        view.my_proposal_sig = self.signer.sign_proposal(proposal, b"")
+        view.last_broadcast_sent = Commit(
+            view=view.number,
+            seq=view.proposal_sequence,
+            digest=proposal_digest(proposal),
+            signature=Signature(
+                signer=view.my_proposal_sig.signer,
+                value=view.my_proposal_sig.value,
+                msg=view.my_proposal_sig.msg,
+            ),
+        )
+
+        loop = asyncio.get_running_loop()
+        self._in_flight_decide = loop.create_future()
+        self._in_flight_sync = loop.create_future()
+        timeout_fut: asyncio.Future = loop.create_future()
+
+        # wait two ticks before starting (viewchanger.go:1262-1264)
+        ticks_before_start = 2
+        started = False
+
+        def on_tick(now: float) -> None:
+            nonlocal ticks_before_start, started
+            self._last_tick = now
+            if not started:
+                ticks_before_start -= 1
+                if ticks_before_start <= 0:
+                    started = True
+                    self._in_flight_view = view
+                    view.start()
+                    self.logger.debugf(
+                        "Node %d started a view %d for the in flight proposal",
+                        self.self_id, view.number,
+                    )
+                return
+            if self._check_if_timeout(now) and not timeout_fut.done():
+                timeout_fut.set_result(True)
+
+        self._in_flight_tick_cb = on_tick
+        try:
+            done, _ = await asyncio.wait(
+                [self._in_flight_decide, self._in_flight_sync, timeout_fut],
+                return_when=asyncio.FIRST_COMPLETED,
+            )
+            if self._in_flight_decide.done() and self._in_flight_decide.result():
+                self.logger.infof(
+                    "In-flight view %d with latest sequence %d has committed a decision",
+                    view.number, view.proposal_sequence,
+                )
+                return True
+            if self._in_flight_sync.done():
+                self.logger.infof(
+                    "In-flight view %d with latest sequence %d has asked to sync",
+                    view.number, view.proposal_sequence,
+                )
+                return False
+            self.logger.infof(
+                "Timeout expired waiting on in-flight view %d to commit %d",
+                view.number, view.proposal_sequence,
+            )
+            return False
+        finally:
+            self._in_flight_tick_cb = None
+            self._in_flight_decide = None
+            self._in_flight_sync = None
+            if self._in_flight_view is not None:
+                await self._in_flight_view.abort()
+                self._in_flight_view = None

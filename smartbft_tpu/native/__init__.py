@@ -47,14 +47,20 @@ def _stale(lib_path: str) -> bool:
         lib_mtime = os.path.getmtime(lib_path)
     except OSError:
         return True
-    return any(
-        os.path.getmtime(os.path.join(_DIR, s)) > lib_mtime for s in _SOURCES
-    )
+    for s in _SOURCES:
+        try:
+            if os.path.getmtime(os.path.join(_DIR, s)) > lib_mtime:
+                return True
+        except OSError:
+            pass  # source pruned from the deploy — the built lib stands
+    return False
 
 
 def load() -> Optional[ctypes.CDLL]:
     """Load (building if needed) the native library; None on failure."""
     global _lib, _load_attempted
+    if _lib is not None or _load_attempted:  # lock-free hot path
+        return _lib
     with _lock:
         if _lib is not None or _load_attempted:
             return _lib

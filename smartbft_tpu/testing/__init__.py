@@ -1,0 +1,4 @@
+from .network import Network, Node
+from .app import App, TestRequest, fast_config
+
+__all__ = ["Network", "Node", "App", "TestRequest", "fast_config"]

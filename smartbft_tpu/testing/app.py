@@ -1,0 +1,335 @@
+"""Test application: implements every SPI interface in-process.
+
+Re-design of /root/reference/test/test_app.go:28-494.  Trivial crypto
+(signature = node id, verification always succeeds, auxiliary data passes
+through), a shared in-memory ledger that doubles as the Synchronizer source,
+fault-injection hooks, restart with real per-node WAL dirs, and the fast
+test configuration.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .. import wal as walmod
+from ..api import (
+    Application,
+    Assembler,
+    Comm,
+    MembershipNotifier,
+    RequestInspector,
+    Signer,
+    Synchronizer,
+    Verifier,
+)
+from ..codec import decode, encode, wiremsg
+from ..config import Configuration
+from ..consensus import Consensus
+from ..messages import Proposal, Signature, ViewMetadata
+from ..metrics import InMemoryProvider, MetricsBundle
+from ..types import Decision, Reconfig, RequestInfo, SyncResponse
+from ..utils.clock import Scheduler
+from ..utils.logging import RecordingLogger
+from .network import Network
+
+
+@wiremsg
+class TestRequest:
+    """Mirrors the reference test Request{ClientID, ID} (test/test_app.go)."""
+
+    client_id: str = ""
+    request_id: str = ""
+    payload: bytes = b""
+
+
+@wiremsg
+class BatchPayload:
+    requests: list[bytes] = None  # type: ignore[assignment]
+
+    def __post_init__(self):
+        if self.requests is None:
+            object.__setattr__(self, "requests", [])
+
+
+def fast_config(self_id: int) -> Configuration:
+    """test_app.go:28-46 — tight timeouts for tests."""
+    return Configuration(
+        self_id=self_id,
+        request_batch_max_count=10,
+        request_batch_max_bytes=10 * 1024 * 1024,
+        request_batch_max_interval=0.05,
+        incoming_message_buffer_size=200,
+        request_pool_size=400,
+        request_forward_timeout=1.0,
+        request_complain_timeout=2.0,
+        request_auto_remove_timeout=30.0,
+        view_change_resend_interval=1.0,
+        view_change_timeout=10.0,
+        leader_heartbeat_timeout=15.0,
+        leader_heartbeat_count=10,
+        num_of_ticks_behind_before_syncing=10,
+        collect_timeout=0.5,
+        sync_on_start=False,
+        speed_up_view_change=False,
+        leader_rotation=False,
+        decisions_per_leader=0,
+    )
+
+
+class SharedLedgers:
+    """Shared view over every node's committed decisions — the Synchronizer
+    source (test_app.go:327-371)."""
+
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        self.ledgers: dict[int, list[Decision]] = {}
+
+    def register(self, node_id: int) -> None:
+        with self.lock:
+            self.ledgers.setdefault(node_id, [])
+
+    def append(self, node_id: int, decision: Decision) -> None:
+        with self.lock:
+            self.ledgers.setdefault(node_id, []).append(decision)
+
+    def height(self, node_id: int) -> int:
+        with self.lock:
+            return len(self.ledgers.get(node_id, []))
+
+    def longest(self, exclude: int) -> list[Decision]:
+        with self.lock:
+            best: list[Decision] = []
+            for nid, ledger in self.ledgers.items():
+                if nid == exclude:
+                    continue
+                if len(ledger) > len(best):
+                    best = list(ledger)
+            return best
+
+    def get(self, node_id: int) -> list[Decision]:
+        with self.lock:
+            return list(self.ledgers.get(node_id, []))
+
+
+class App(Application, Assembler, Comm, Signer, Verifier, RequestInspector,
+          Synchronizer, MembershipNotifier):
+    """One test node: SPI implementation + fault injection + lifecycle."""
+
+    def __init__(
+        self,
+        node_id: int,
+        network: Network,
+        shared: SharedLedgers,
+        scheduler: Scheduler,
+        wal_dir: Optional[str] = None,
+        config: Optional[Configuration] = None,
+        use_metrics: bool = False,
+    ):
+        self.id = node_id
+        self.network = network
+        self.shared = shared
+        self.scheduler = scheduler
+        self.wal_dir = wal_dir
+        self.config = config or fast_config(node_id)
+        self.logger = RecordingLogger(f"app-{node_id}")
+        self.lock = threading.Lock()
+        self.verification_seq = 0
+        self.delay_sync_by: float = 0.0
+        self.membership_changed = False
+        self.consensus: Optional[Consensus] = None
+        self._wal = None
+        self.node = network.add_node(node_id)
+        self.node.consensus = self
+        shared.register(node_id)
+        self.metrics = MetricsBundle(InMemoryProvider()) if use_metrics else None
+        self.clock = scheduler
+
+    # ------------------------------------------------------------------ app
+
+    def deliver(self, proposal: Proposal, signatures) -> Reconfig:
+        decision = Decision(proposal=proposal, signatures=tuple(signatures))
+        self.shared.append(self.id, decision)
+        return Reconfig(in_latest_decision=False)
+
+    # -- Assembler ---------------------------------------------------------
+
+    def assemble_proposal(self, metadata: bytes, requests) -> Proposal:
+        return Proposal(
+            header=b"",
+            payload=encode(BatchPayload(requests=list(requests))),
+            metadata=metadata,
+            verification_sequence=self.verification_seq,
+        )
+
+    # -- Comm --------------------------------------------------------------
+
+    def send_consensus(self, target_id: int, msg) -> None:
+        self.network.send_consensus(self.id, target_id, msg)
+
+    def send_transaction(self, target_id: int, request: bytes) -> None:
+        self.network.send_transaction(self.id, target_id, request)
+
+    def nodes(self) -> list[int]:
+        return self.network.node_ids()
+
+    # -- Signer ------------------------------------------------------------
+
+    def sign(self, data: bytes) -> bytes:
+        return b"sig-%d" % self.id
+
+    def sign_proposal(self, proposal: Proposal, auxiliary_input: bytes) -> Signature:
+        return Signature(signer=self.id, value=b"sig-%d" % self.id, msg=auxiliary_input)
+
+    # -- Verifier (trivial crypto, test_app.go:237-267) --------------------
+
+    def verify_proposal(self, proposal: Proposal) -> list[RequestInfo]:
+        return self.requests_from_proposal(proposal)
+
+    def verify_request(self, raw_request: bytes) -> RequestInfo:
+        return self.request_id(raw_request)
+
+    def verify_consenter_sig(self, signature: Signature, proposal: Proposal) -> bytes:
+        return signature.msg
+
+    def verify_signature(self, signature: Signature) -> None:
+        return None
+
+    def verification_sequence(self) -> int:
+        return self.verification_seq
+
+    def requests_from_proposal(self, proposal: Proposal) -> list[RequestInfo]:
+        if not proposal.payload:
+            return []
+        batch = decode(BatchPayload, proposal.payload)
+        return [self.request_id(r) for r in batch.requests]
+
+    def auxiliary_data(self, msg: bytes) -> bytes:
+        return msg
+
+    # -- RequestInspector --------------------------------------------------
+
+    def request_id(self, raw_request: bytes) -> RequestInfo:
+        req = decode(TestRequest, raw_request)
+        return RequestInfo(client_id=req.client_id, request_id=req.request_id)
+
+    # -- MembershipNotifier ------------------------------------------------
+
+    def membership_change(self) -> bool:
+        return self.membership_changed
+
+    # -- Synchronizer (test_app.go:327-371) --------------------------------
+
+    def sync(self) -> SyncResponse:
+        import time as _time
+
+        if self.delay_sync_by:
+            _time.sleep(self.delay_sync_by)
+        best = self.shared.longest(exclude=self.id)
+        mine = self.shared.get(self.id)
+        for decision in best[len(mine):]:
+            self.deliver(decision.proposal, list(decision.signatures))
+        mine = self.shared.get(self.id)
+        latest = mine[-1] if mine else Decision(proposal=Proposal())
+        return SyncResponse(latest=latest, reconfig=Reconfig(in_latest_decision=False))
+
+    # ------------------------------------------------------------------ lifecycle
+
+    def _read_wal(self) -> list[bytes]:
+        if self.wal_dir is None:
+            # in-memory WAL stub: no durability, restart loses protocol state
+            class _NopWAL:
+                def append(self, entry: bytes, truncate_to: bool) -> None:
+                    pass
+
+            self._wal = _NopWAL()
+            return []
+        self._wal, entries = walmod.initialize_and_read_all(self.wal_dir, self.logger)
+        return entries
+
+    def _latest_metadata(self) -> tuple[ViewMetadata, Proposal, list[Signature]]:
+        mine = self.shared.get(self.id)
+        if not mine:
+            return ViewMetadata(), Proposal(), []
+        last = mine[-1]
+        md = decode(ViewMetadata, last.proposal.metadata)
+        return md, last.proposal, list(last.signatures)
+
+    async def start(self) -> None:
+        entries = self._read_wal()
+        md, last_proposal, last_sigs = self._latest_metadata()
+        self.consensus = Consensus(
+            config=self.config,
+            application=self,
+            assembler=self,
+            wal=self._wal,
+            wal_initial_content=entries,
+            comm=self,
+            signer=self,
+            verifier=self,
+            membership_notifier=self,
+            request_inspector=self,
+            synchronizer=self,
+            logger=self.logger,
+            metadata=md,
+            last_proposal=last_proposal,
+            last_signatures=last_sigs,
+            scheduler=self.scheduler,
+            metrics=self.metrics,
+            viewchanger_tick_interval=0.2,
+            heartbeat_tick_interval=0.2,
+        )
+        self.node.consensus = self.consensus
+        self.node.start()
+        await self.consensus.start()
+
+    async def stop(self) -> None:
+        if self.consensus is not None:
+            await self.consensus.stop()
+        await self.node.stop()
+        if self._wal is not None and hasattr(self._wal, "close"):
+            self._wal.close()
+
+    async def restart(self) -> None:
+        """Crash-restart with WAL recovery (test_app.go:129-143)."""
+        await self.stop()
+        await self.start()
+
+    async def submit(self, client_id: str, request_id: str, payload: bytes = b"") -> None:
+        req = encode(TestRequest(client_id=client_id, request_id=request_id, payload=payload))
+        await self.consensus.submit_request(req)
+
+    # -- fault injection convenience --------------------------------------
+
+    def disconnect(self) -> None:
+        self.node.disconnect()
+
+    def connect(self) -> None:
+        self.node.connect()
+
+    # -- queries -----------------------------------------------------------
+
+    def ledger(self) -> list[Decision]:
+        return self.shared.get(self.id)
+
+    def height(self) -> int:
+        return self.shared.height(self.id)
+
+
+async def wait_for(predicate, scheduler: Scheduler, timeout: float = 30.0, step: float = 0.05):
+    """Advance logical+real time until predicate() or timeout.
+
+    Drives the shared scheduler in lockstep with the asyncio loop so
+    tick-driven timers fire while tasks make progress.
+    """
+    elapsed = 0.0
+    while elapsed < timeout:
+        if predicate():
+            return
+        await asyncio.sleep(0)  # let tasks run
+        scheduler.advance_by(step)
+        await asyncio.sleep(0.001)
+        elapsed += step
+    raise TimeoutError(f"condition not met within {timeout}s of logical time")

@@ -1,0 +1,168 @@
+"""In-process network simulator with fault injection.
+
+Re-design of /root/reference/test/network.go:18-252: a map of node id ->
+Node, each with a bounded inbox drained by its own asyncio task.  Faults are
+injectable per node and per peer: probabilistic message loss, message
+mutation hooks, full disconnects, and drop-on-overflow.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from typing import Callable, Optional
+
+from ..messages import Message
+
+INCOMING_BUFFER = 1000  # network.go:18-20
+
+
+class Node:
+    """One endpoint: wraps a Consensus instance's handle_message/
+    handle_request behind an inbox task (network.go:200-241)."""
+
+    def __init__(self, node_id: int, network: "Network", rng: random.Random):
+        self.id = node_id
+        self.network = network
+        self.rng = rng
+        self.consensus = None  # set by the harness (an App or Consensus)
+        self.running = False
+        self.lossy = False
+        self.loss_probability = 0.0
+        self.peer_loss_probability: dict[int, float] = {}
+        self.mutate_send: Optional[Callable[[int, Message], Optional[Message]]] = None
+        self.filters: list[Callable[[Message, int], bool]] = []
+        self._inbox: asyncio.Queue = asyncio.Queue(maxsize=INCOMING_BUFFER)
+        self._task: Optional[asyncio.Task] = None
+        self.dropped = 0
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        if self.running:
+            return
+        self.running = True
+        self._task = asyncio.get_running_loop().create_task(
+            self._serve(), name=f"netnode-{self.id}"
+        )
+
+    async def stop(self) -> None:
+        self.running = False
+        if self._task is not None:
+            self._inbox.put_nowait(None)
+            await self._task
+            self._task = None
+
+    async def _serve(self) -> None:
+        while True:
+            item = await self._inbox.get()
+            if item is None or not self.running:
+                return
+            kind, sender, payload = item
+            try:
+                if kind == "consensus":
+                    self.consensus.handle_message(sender, payload)
+                else:
+                    await self.consensus.handle_request(sender, payload)
+            except Exception as e:  # pragma: no cover — harness robustness
+                import traceback
+
+                traceback.print_exc()
+                raise
+
+    # -- ingress -----------------------------------------------------------
+
+    def _offer(self, kind: str, sender: int, payload) -> None:
+        if not self.running:
+            return
+        try:
+            self._inbox.put_nowait((kind, sender, payload))
+        except asyncio.QueueFull:
+            self.dropped += 1  # drop on overflow (network.go:135-139)
+
+    # -- fault injection (test_app.go:129-195) -----------------------------
+
+    def disconnect(self) -> None:
+        self.lossy = True
+        self.loss_probability = 1.0
+
+    def disconnect_from(self, peer: int) -> None:
+        self.peer_loss_probability[peer] = 1.0
+
+    def connect_to(self, peer: int) -> None:
+        self.peer_loss_probability.pop(peer, None)
+
+    def connect(self) -> None:
+        self.lossy = False
+        self.loss_probability = 0.0
+        self.peer_loss_probability.clear()
+
+    def lose_messages(self, probability: float) -> None:
+        self.lossy = probability > 0
+        self.loss_probability = probability
+
+    def add_filter(self, f: Callable[[Message, int], bool]) -> None:
+        """Keep a message iff every filter returns True (network.go:232-234)."""
+        self.filters.append(f)
+
+    def clear_filters(self) -> None:
+        self.filters.clear()
+
+    def _drops(self, peer: int) -> bool:
+        p = self.peer_loss_probability.get(peer, self.loss_probability if self.lossy else 0.0)
+        return p > 0 and self.rng.random() < p
+
+
+class Network:
+    """The mesh (network.go:34-74)."""
+
+    def __init__(self, seed: int = 0):
+        self.nodes: dict[int, Node] = {}
+        self.rng = random.Random(seed)
+
+    def add_node(self, node_id: int) -> Node:
+        node = Node(node_id, self, self.rng)
+        self.nodes[node_id] = node
+        return node
+
+    def node_ids(self) -> list[int]:
+        return sorted(self.nodes.keys())
+
+    def start(self) -> None:
+        for node in self.nodes.values():
+            node.start()
+
+    async def stop(self) -> None:
+        for node in self.nodes.values():
+            await node.stop()
+
+    # -- transport ---------------------------------------------------------
+
+    def send_consensus(self, source: int, target: int, msg: Message) -> None:
+        src = self.nodes.get(source)
+        dst = self.nodes.get(target)
+        if src is None or dst is None:
+            return
+        # sender-side faults
+        if src._drops(target):
+            return
+        if src.mutate_send is not None:
+            msg = src.mutate_send(target, msg)
+            if msg is None:
+                return
+        # receiver-side faults
+        if dst._drops(source):
+            return
+        for f in dst.filters:
+            if not f(msg, source):
+                return
+        dst._offer("consensus", source, msg)
+
+    def send_transaction(self, source: int, target: int, request: bytes) -> None:
+        src = self.nodes.get(source)
+        dst = self.nodes.get(target)
+        if src is None or dst is None:
+            return
+        if src._drops(target) or dst._drops(source):
+            return
+        dst._offer("request", source, request)

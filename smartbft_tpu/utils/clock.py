@@ -72,14 +72,19 @@ class Scheduler:
         """
         if t < self._now:
             t = self._now
-        self._now = t
         fired = 0
+        # advance logical time task-by-task so callbacks that reschedule
+        # (tickers) observe the correct "now" — a single large jump must
+        # fire a periodic task once per period, not once per jump
         while self._heap and self._heap[0].deadline <= t:
             task = heapq.heappop(self._heap)
             if task.cancelled:
                 continue
+            if task.deadline > self._now:
+                self._now = task.deadline
             fired += 1
             task._callback()
+        self._now = t
         return fired
 
     def advance_by(self, dt: float) -> int:
@@ -93,6 +98,8 @@ class Ticker:
     """Periodic callback built on :class:`Scheduler` (reference tick channels)."""
 
     def __init__(self, scheduler: Scheduler, interval: float, callback: Callable[[], None]):
+        if interval <= 0:
+            raise ValueError(f"ticker interval must be positive, got {interval}")
         self._scheduler = scheduler
         self._interval = interval
         self._callback = callback
@@ -127,7 +134,7 @@ class WallClockDriver:
         self._scheduler = scheduler
         self._tick_interval = tick_interval
         self._task: Optional[asyncio.Task] = None
-        self._stop = asyncio.Event()
+        self._stop: Optional[asyncio.Event] = None  # created in start()
 
     async def _run(self) -> None:
         base_wall = time.monotonic()

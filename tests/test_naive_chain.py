@@ -1,0 +1,14 @@
+"""Tier-3 smoke test: the naive_chain example orders blocks on 4 nodes
+(mirrors /root/reference/examples/naive_chain/chain_test.go:71-139)."""
+
+import asyncio
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "examples"))
+
+from naive_chain import main
+
+
+def test_naive_chain_orders_blocks():
+    asyncio.run(main(num_blocks=5))

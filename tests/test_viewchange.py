@@ -1,0 +1,144 @@
+"""View-change scenarios: leader failure, heartbeat timeouts, restoration.
+
+Modeled on /root/reference/test/basic_test.go view-change coverage
+(TestLeaderInPartition, TestViewChangeAfterTryingToFork, heartbeat
+timeout scenarios) and viewchanger_test.go.
+"""
+
+import asyncio
+import dataclasses
+
+import pytest
+
+from smartbft_tpu.messages import PrePrepare, Proposal
+from smartbft_tpu.testing.app import App, SharedLedgers, fast_config, wait_for
+from smartbft_tpu.testing.network import Network
+from smartbft_tpu.utils.clock import Scheduler
+
+from tests.test_basic import make_nodes, start_all, stop_all
+
+
+def vc_config(i):
+    """Short heartbeat/view-change timeouts so failures are detected quickly."""
+    return dataclasses.replace(
+        fast_config(i),
+        leader_heartbeat_timeout=2.0,
+        leader_heartbeat_count=10,
+        view_change_timeout=8.0,
+        view_change_resend_interval=2.0,
+    )
+
+
+def test_leader_in_partition(tmp_path):
+    """Disconnect the leader; followers complain via heartbeat timeout and
+    elect a new leader; consensus resumes (basic_test.go:TestLeaderInPartition)."""
+
+    async def run():
+        apps, scheduler, network, shared = make_nodes(4, tmp_path, config_fn=vc_config)
+        await start_all(apps)
+
+        # commit one request under leader 1
+        await apps[0].submit("c", "r0")
+        await wait_for(lambda: all(a.height() >= 1 for a in apps), scheduler)
+        assert apps[1].consensus.get_leader_id() == 1
+
+        apps[0].disconnect()  # leader goes dark
+
+        # followers should view-change to leader 2
+        await wait_for(
+            lambda: all(a.consensus.get_leader_id() == 2 for a in apps[1:]),
+            scheduler,
+            timeout=120.0,
+        )
+
+        # consensus resumes among the remaining 3 (quorum for n=4 is 3)
+        await apps[1].submit("c", "r1")
+        await wait_for(
+            lambda: all(a.height() >= 2 for a in apps[1:]), scheduler, timeout=120.0
+        )
+        await stop_all(apps)
+
+    asyncio.run(run())
+
+
+def test_rejoining_leader_syncs(tmp_path):
+    """The deposed leader reconnects and catches up via sync."""
+
+    async def run():
+        apps, scheduler, network, shared = make_nodes(4, tmp_path, config_fn=vc_config)
+        await start_all(apps)
+        await apps[0].submit("c", "r0")
+        await wait_for(lambda: all(a.height() >= 1 for a in apps), scheduler)
+
+        apps[0].disconnect()
+        await wait_for(
+            lambda: all(a.consensus.get_leader_id() == 2 for a in apps[1:]),
+            scheduler,
+            timeout=120.0,
+        )
+        await apps[1].submit("c", "r1")
+        await wait_for(lambda: all(a.height() >= 2 for a in apps[1:]), scheduler, timeout=120.0)
+
+        apps[0].connect()
+        # heartbeats from the new leader should make node 1 sync
+        await wait_for(lambda: apps[0].height() >= 2, scheduler, timeout=240.0)
+        assert [d.proposal for d in apps[0].ledger()][:2] == [
+            d.proposal for d in apps[1].ledger()
+        ][:2]
+        await stop_all(apps)
+
+    asyncio.run(run())
+
+
+def test_byzantine_leader_mutates_preprepare(tmp_path):
+    """A leader mutating outbound pre-prepares triggers complaints and a view
+    change (basic_test.go:TestLeaderModifiesPreprepare)."""
+
+    async def run():
+        apps, scheduler, network, shared = make_nodes(4, tmp_path, config_fn=vc_config)
+        await start_all(apps)
+
+        def corrupt(target, msg):
+            if isinstance(msg, PrePrepare):
+                return dataclasses.replace(
+                    msg,
+                    proposal=dataclasses.replace(msg.proposal, payload=b"evil"),
+                )
+            return msg
+
+        apps[0].node.mutate_send = corrupt
+
+        await apps[0].submit("c", "r0")
+        # followers reject the mutated proposal, complain, and change view
+        await wait_for(
+            lambda: all(a.consensus.get_leader_id() == 2 for a in apps[1:]),
+            scheduler,
+            timeout=240.0,
+        )
+        # the honest majority can now commit
+        apps[0].node.mutate_send = None
+        await apps[1].submit("c", "r1")
+        await wait_for(lambda: all(a.height() >= 1 for a in apps[1:]), scheduler, timeout=120.0)
+        await stop_all(apps)
+
+    asyncio.run(run())
+
+
+def test_restart_all_nodes_resume(tmp_path):
+    """Stop and restart the whole cluster; WAL restore brings every node
+    back and consensus continues (basic_test.go restart scenarios)."""
+
+    async def run():
+        apps, scheduler, network, shared = make_nodes(4, tmp_path)
+        await start_all(apps)
+        await apps[0].submit("c", "r0")
+        await wait_for(lambda: all(a.height() >= 1 for a in apps), scheduler)
+        for app in apps:
+            await app.stop()
+        for app in apps:
+            await app.start()
+        await apps[0].submit("c", "r1")
+        await wait_for(lambda: all(a.height() >= 2 for a in apps), scheduler, timeout=120.0)
+        await stop_all(apps)
+
+    asyncio.run(run())
