@@ -1,0 +1,14 @@
+"""TPU crypto plane: batched signature verification kernels in JAX.
+
+This package is the point of the framework (SURVEY.md §7.6): the reference
+verifies one commit signature per goroutine on the CPU
+(/root/reference/internal/bft/view.go:537-541); here quorum signature checks
+are accumulated and executed as one vmap'd/jit'd kernel launch on the TPU.
+
+Layout:
+  bignum.py   -- fixed-width big integers on 16-bit limbs (uint32 storage),
+                 Montgomery arithmetic; dtype-safe on TPU (no 64-bit needed).
+  p256.py     -- NIST P-256 ECDSA: complete-addition curve ops, batched verify.
+"""
+
+from . import bignum  # noqa: F401

@@ -1,0 +1,285 @@
+"""Fixed-width big-integer arithmetic for TPU, on 16-bit limbs.
+
+Design notes (TPU-first):
+
+* A k-bit integer is stored little-endian as ``ceil(k/16)`` limbs of 16 bits
+  each, in a ``uint32`` array whose last axis is the limb axis.  All ops are
+  natively batched: any leading axes are batch axes, so a (B, n) array is a
+  batch of B bignums and every primitive vectorizes on the VPU without
+  ``vmap``.
+* 16-bit limbs inside 32-bit lanes mean every partial product
+  ``a_i * b_j <= (2^16-1)^2`` fits a uint32 lane, and a full schoolbook
+  column (<= 2n terms of 16 bits) stays below 2^21 — so multiplication needs
+  **no 64-bit arithmetic at all**.  TPUs have no native int64; this layout is
+  why the kernels run at full VPU rate instead of through XLA's i64
+  emulation.
+* The only sequential parts are the carry/borrow chains, expressed as
+  ``lax.scan`` along the limb axis (16-32 steps) while the batch dimension
+  stays fully vectorized.
+* Modular arithmetic is Montgomery-form (separated operand scanning: one
+  full product, one low product by N', one full product by N).  The modulus
+  is a Python int baked in at trace time via :class:`MontCtx`, so P-256's
+  p and n, Ed25519's p and L, and BLS12-381's q all share this engine.
+
+Replaces the host-language bigint the reference leans on implicitly via Go's
+``crypto/ecdsa`` (/root/reference/internal/bft/view.go:537-541 is the
+per-signature verify fan-out this engine batches).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+LIMB_BITS = 16
+LIMB_MASK = (1 << LIMB_BITS) - 1
+DTYPE = jnp.uint32
+
+
+# ---------------------------------------------------------------------------
+# host <-> device conversion
+# ---------------------------------------------------------------------------
+
+def to_limbs(x: int, nlimbs: int) -> np.ndarray:
+    """Python int -> little-endian 16-bit limb vector (numpy uint32)."""
+    if x < 0:
+        raise ValueError("negative")
+    out = np.zeros(nlimbs, dtype=np.uint32)
+    for i in range(nlimbs):
+        out[i] = x & LIMB_MASK
+        x >>= LIMB_BITS
+    if x:
+        raise ValueError("overflow: value does not fit in %d limbs" % nlimbs)
+    return out
+
+
+def from_limbs(arr) -> int:
+    """Limb vector (1-D) -> Python int.  Host-side only."""
+    a = np.asarray(arr, dtype=np.uint64)
+    x = 0
+    for i in range(a.shape[-1] - 1, -1, -1):
+        x = (x << LIMB_BITS) | int(a[i])
+    return x
+
+
+def batch_to_limbs(xs, nlimbs: int) -> np.ndarray:
+    """List of Python ints -> (B, nlimbs) uint32."""
+    return np.stack([to_limbs(x, nlimbs) for x in xs])
+
+
+# ---------------------------------------------------------------------------
+# carry / borrow chains (lax.scan along the limb axis)
+# ---------------------------------------------------------------------------
+
+def carry_propagate(cols, out_len: int):
+    """Normalize column sums (< 2^31 each) into 16-bit limbs.
+
+    ``cols``: (..., m) uint32.  Returns (..., out_len) with out_len >= m;
+    the caller guarantees the final carry is zero (bounded inputs).
+    """
+    m = cols.shape[-1]
+    if out_len > m:
+        pad = [(0, 0)] * (cols.ndim - 1) + [(0, out_len - m)]
+        cols = jnp.pad(cols, pad)
+    x = jnp.moveaxis(cols, -1, 0)  # (out_len, ...)
+
+    def step(c, col):
+        t = col + c
+        return t >> LIMB_BITS, t & LIMB_MASK
+
+    _, limbs = lax.scan(step, jnp.zeros(x.shape[1:], DTYPE), x)
+    return jnp.moveaxis(limbs, 0, -1)
+
+
+def sub_borrow(a, b):
+    """(a - b) mod 2^(16n) limb-wise; returns (diff, borrow_out).
+
+    borrow_out is (...,) uint32: 1 when a < b.
+    """
+    xa = jnp.moveaxis(a, -1, 0)
+    xb = jnp.moveaxis(jnp.broadcast_to(b, a.shape), -1, 0)
+
+    def step(borrow, ab):
+        ai, bi = ab
+        t = ai + jnp.uint32(1 << LIMB_BITS) - bi - borrow
+        return jnp.uint32(1) - (t >> LIMB_BITS), t & LIMB_MASK
+
+    borrow, limbs = lax.scan(
+        step, jnp.zeros(xa.shape[1:], DTYPE), (xa, xb)
+    )
+    return jnp.moveaxis(limbs, 0, -1), borrow
+
+
+def geq(a, b):
+    """a >= b as (...,) uint32 0/1."""
+    _, borrow = sub_borrow(a, b)
+    return jnp.uint32(1) - borrow
+
+
+def select(mask, a, b):
+    """mask ? a : b, broadcasting a (...,) mask over the limb axis."""
+    return jnp.where(mask[..., None].astype(bool), a, b)
+
+
+def is_zero(a):
+    """(...,) uint32 1 if the bignum is zero."""
+    return (jnp.max(a, axis=-1) == 0).astype(DTYPE)
+
+
+def eq(a, b):
+    """(...,) uint32 1 if equal limb-wise."""
+    return jnp.all(a == b, axis=-1).astype(DTYPE)
+
+
+def bits_msb(a, nbits: int):
+    """Bit decomposition, most-significant first: (..., n) -> (..., nbits)."""
+    idx = np.arange(nbits - 1, -1, -1)
+    limb = idx // LIMB_BITS
+    off = idx % LIMB_BITS
+    return (a[..., limb] >> jnp.asarray(off, DTYPE)) & jnp.uint32(1)
+
+
+# ---------------------------------------------------------------------------
+# multiplication
+# ---------------------------------------------------------------------------
+
+def mul_full(a, b):
+    """Full product: (..., n) x (..., n) -> (..., 2n), normalized limbs.
+
+    Schoolbook via shift-accumulate: row i of partial products lands in
+    columns [i, i+n).  Each 32-bit product is split into 16-bit halves
+    before accumulation, so column sums never exceed ~2^21.
+    """
+    n = a.shape[-1]
+    bshape = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
+    acc = jnp.zeros(bshape + (2 * n + 1,), DTYPE)
+    for i in range(n):
+        p = a[..., i : i + 1] * b  # (..., n) full 32-bit products
+        acc = acc.at[..., i : i + n].add(p & LIMB_MASK)
+        acc = acc.at[..., i + 1 : i + n + 1].add(p >> LIMB_BITS)
+    return carry_propagate(acc, 2 * n + 1)[..., : 2 * n]
+
+
+def add_raw(a, b, out_len: int):
+    """Plain (non-modular) limb addition with carry normalization."""
+    m = max(a.shape[-1], b.shape[-1])
+    pad_a = [(0, 0)] * (a.ndim - 1) + [(0, m - a.shape[-1])]
+    pad_b = [(0, 0)] * (b.ndim - 1) + [(0, m - b.shape[-1])]
+    cols = jnp.pad(a, pad_a) + jnp.pad(b, pad_b)
+    return carry_propagate(cols, out_len)
+
+
+# ---------------------------------------------------------------------------
+# Montgomery context
+# ---------------------------------------------------------------------------
+
+class MontCtx:
+    """Montgomery arithmetic mod an odd ``modulus`` over ``nlimbs`` limbs.
+
+    All device methods accept/return (..., nlimbs) uint32 arrays in the
+    Montgomery domain unless noted.  Constants are precomputed with Python
+    ints at construction and baked into the trace as numpy constants.
+    """
+
+    def __init__(self, modulus: int, nlimbs: int):
+        if modulus % 2 == 0:
+            raise ValueError("modulus must be odd")
+        self.modulus = modulus
+        self.n = nlimbs
+        R = 1 << (LIMB_BITS * nlimbs)
+        if modulus >= R:
+            raise ValueError("modulus too large for limb count")
+        self.R = R
+        self.N = to_limbs(modulus, nlimbs)
+        self.N_ext = to_limbs(modulus, nlimbs + 1)
+        self.R2 = to_limbs((R * R) % modulus, nlimbs)
+        self.Nprime = to_limbs((-pow(modulus, -1, R)) % R, nlimbs)
+        self.one_mont = to_limbs(R % modulus, nlimbs)  # 1 in Mont domain
+        self.zero = to_limbs(0, nlimbs)
+
+    # -- domain conversion --------------------------------------------------
+
+    def to_mont(self, a):
+        return self.mul(a, jnp.asarray(self.R2))
+
+    def from_mont(self, a):
+        return self.mul(a, jnp.asarray(to_limbs(1, self.n)))
+
+    def encode(self, x: int) -> np.ndarray:
+        """Host: Python int -> Montgomery-domain limbs (numpy)."""
+        return to_limbs((x * self.R) % self.modulus, self.n)
+
+    def decode(self, arr) -> int:
+        """Host: Montgomery-domain limbs -> Python int."""
+        return (from_limbs(arr) * pow(self.R, -1, self.modulus)) % self.modulus
+
+    # -- core ops -----------------------------------------------------------
+
+    def mul(self, a, b):
+        """Montgomery product: returns a*b*R^-1 mod N."""
+        n = self.n
+        t = mul_full(a, b)  # (..., 2n)
+        m = mul_full(t[..., :n], jnp.asarray(self.Nprime))[..., :n]
+        mN = mul_full(m, jnp.asarray(self.N))  # (..., 2n)
+        s = carry_propagate(t + mN, 2 * n + 1)
+        r = s[..., n : 2 * n + 1]  # (..., n+1), value < 2N
+        d, borrow = sub_borrow(r, jnp.asarray(self.N_ext))
+        return select(borrow, r, d)[..., :n]
+
+    def square(self, a):
+        return self.mul(a, a)
+
+    def add(self, a, b):
+        s = add_raw(a, b, self.n + 1)
+        d, borrow = sub_borrow(s, jnp.asarray(self.N_ext))
+        return select(borrow, s, d)[..., : self.n]
+
+    def sub(self, a, b):
+        d, borrow = sub_borrow(a, b)
+        wrapped = add_raw(d, jnp.asarray(self.N), self.n + 1)[..., : self.n]
+        return select(borrow, wrapped, d)
+
+    def neg(self, a):
+        """-a mod N (a in [0, N))."""
+        d, _ = sub_borrow(jnp.broadcast_to(jnp.asarray(self.N), a.shape), a)
+        return select(is_zero(a), a, d)
+
+    def dbl(self, a):
+        return self.add(a, a)
+
+    def reduce_once(self, a):
+        """One conditional subtract: a in [0, 2N) -> a mod N."""
+        d, borrow = sub_borrow(a, jnp.asarray(self.N))
+        return select(borrow, a, d)
+
+    # -- exponentiation (static exponent) ------------------------------------
+
+    def exp(self, a, e: int):
+        """a^e mod N for a *static* Python-int exponent; a in Mont domain.
+
+        Square-and-multiply as a ``lax.scan`` over the exponent's bits
+        (MSB first) so the compiled graph stays small.
+        """
+        if e < 0:
+            raise ValueError("negative exponent")
+        nbits = max(e.bit_length(), 1)
+        bits = np.array(
+            [(e >> i) & 1 for i in range(nbits - 1, -1, -1)], dtype=np.uint32
+        )
+        one = jnp.broadcast_to(jnp.asarray(self.one_mont), a.shape)
+
+        def step(acc, bit):
+            acc = self.mul(acc, acc)
+            acc = select(bit * jnp.ones(acc.shape[:-1], DTYPE),
+                         self.mul(acc, a), acc)
+            return acc, None
+
+        out, _ = lax.scan(step, one, jnp.asarray(bits))
+        return out
+
+    def inv(self, a):
+        """a^-1 mod N via Fermat (N must be prime); Mont domain in/out."""
+        return self.exp(a, self.modulus - 2)

@@ -1,0 +1,314 @@
+"""NIST P-256 ECDSA: batched TPU verification, host-side signing.
+
+The verify kernel replaces the reference's per-signature goroutine fan-out
+(/root/reference/internal/bft/view.go:519-551 spawns one goroutine per
+commit vote, each doing one ``crypto/ecdsa`` verify).  Here a whole quorum
+— across commits, replicas, and in-flight sequences — is verified as ONE
+jitted call:
+
+* Field/scalar arithmetic: :mod:`bignum` Montgomery contexts for p and n.
+* Curve arithmetic: Renes–Costello–Batina 2015 complete addition formulas
+  (Algorithm 4, a = -3) in homogeneous projective coordinates — branch-free
+  and identity-safe, exactly what XLA wants: one straight-line formula for
+  add, double, and infinity alike.
+* Double-scalar multiplication u1*G + u2*Q: Strauss–Shamir interleaving as
+  a single ``lax.scan`` over 256 bits, one table gather + one complete
+  addition per bit.  No data-dependent control flow anywhere.
+
+Signing stays on the host (one signature per decision — never a hot path)
+with RFC 6979 deterministic nonces.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import secrets
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+from . import bignum as bn
+from .bignum import DTYPE, MontCtx
+
+# --- curve constants (FIPS 186-4, D.1.2.3) ---------------------------------
+
+P = 0xFFFFFFFF00000001000000000000000000000000FFFFFFFFFFFFFFFFFFFFFFFF
+N = 0xFFFFFFFF00000000FFFFFFFFFFFFFFFFBCE6FAADA7179E84F3B9CAC2FC632551
+B = 0x5AC635D8AA3A93E7B3EBBD55769886BC651D06B0CC53B0F63BCE3C3E27D2604B
+GX = 0x6B17D1F2E12C4247F8BCE6E563A440F277037D812DEB33A0F4A13945D898C296
+GY = 0x4FE342E2FE1A7F9B8EE7EB4A7C0F9E162BCE33576B315ECECBB6406837BF51F5
+
+NLIMBS = 16
+FP = MontCtx(P, NLIMBS)
+FN = MontCtx(N, NLIMBS)
+
+_B_MONT = FP.encode(B)
+_G_MONT = np.stack([FP.encode(GX), FP.encode(GY), FP.one_mont])
+_INF_MONT = np.stack([FP.zero, FP.one_mont, FP.zero])
+
+
+# ---------------------------------------------------------------------------
+# projective curve ops (points are (..., 3, NLIMBS) Montgomery-domain arrays)
+# ---------------------------------------------------------------------------
+
+def point_add(p, q):
+    """Complete addition, RCB15 Algorithm 4 (a = -3).
+
+    Valid for every input pair: distinct points, doubling, and the identity
+    (0 : 1 : 0).  12 field mults + 2 mults by b + 29 add/subs.
+    """
+    f = FP
+    b_m = jnp.asarray(_B_MONT)
+    x1, y1, z1 = p[..., 0, :], p[..., 1, :], p[..., 2, :]
+    x2, y2, z2 = q[..., 0, :], q[..., 1, :], q[..., 2, :]
+
+    t0 = f.mul(x1, x2)
+    t1 = f.mul(y1, y2)
+    t2 = f.mul(z1, z2)
+    t3 = f.add(x1, y1)
+    t4 = f.add(x2, y2)
+    t3 = f.mul(t3, t4)
+    t4 = f.add(t0, t1)
+    t3 = f.sub(t3, t4)
+    t4 = f.add(y1, z1)
+    x3 = f.add(y2, z2)
+    t4 = f.mul(t4, x3)
+    x3 = f.add(t1, t2)
+    t4 = f.sub(t4, x3)
+    x3 = f.add(x1, z1)
+    y3 = f.add(x2, z2)
+    x3 = f.mul(x3, y3)
+    y3 = f.add(t0, t2)
+    y3 = f.sub(x3, y3)
+    z3 = f.mul(b_m, t2)
+    x3 = f.sub(y3, z3)
+    z3 = f.add(x3, x3)
+    x3 = f.add(x3, z3)
+    z3 = f.sub(t1, x3)
+    x3 = f.add(t1, x3)
+    y3 = f.mul(b_m, y3)
+    t1 = f.add(t2, t2)
+    t2 = f.add(t1, t2)
+    y3 = f.sub(y3, t2)
+    y3 = f.sub(y3, t0)
+    t1 = f.add(y3, y3)
+    y3 = f.add(t1, y3)
+    t1 = f.add(t0, t0)
+    t0 = f.add(t1, t0)
+    t0 = f.sub(t0, t2)
+    t1 = f.mul(t4, y3)
+    t2 = f.mul(t0, y3)
+    y3 = f.mul(x3, z3)
+    y3 = f.add(y3, t2)
+    x3 = f.mul(t3, x3)
+    x3 = f.sub(x3, t1)
+    z3 = f.mul(t4, z3)
+    t1 = f.mul(t3, t0)
+    z3 = f.add(z3, t1)
+    return jnp.stack([x3, y3, z3], axis=-2)
+
+
+def is_on_curve(xm, ym):
+    """y^2 == x^3 - 3x + b in Montgomery domain; (...,) uint32 mask."""
+    f = FP
+    lhs = f.mul(ym, ym)
+    x3 = f.mul(f.mul(xm, xm), xm)
+    threex = f.add(f.add(xm, xm), xm)
+    rhs = f.add(f.sub(x3, threex), jnp.asarray(_B_MONT))
+    return bn.eq(lhs, rhs)
+
+
+def shamir_double_scalar(u1_bits, u2_bits, q):
+    """u1*G + u2*Q with one scan: per bit, 1 doubling + 1 table add.
+
+    u1_bits/u2_bits: (..., 256) MSB-first; q: (..., 3, NLIMBS) Mont domain.
+    """
+    g = jnp.broadcast_to(jnp.asarray(_G_MONT), q.shape)
+    inf = jnp.broadcast_to(jnp.asarray(_INF_MONT), q.shape)
+    gq = point_add(g, q)
+    table = jnp.stack([inf, g, q, gq], axis=-3)  # (..., 4, 3, n)
+
+    xs = (
+        jnp.moveaxis(u1_bits, -1, 0),  # (256, ...)
+        jnp.moveaxis(u2_bits, -1, 0),
+    )
+
+    def step(acc, bits):
+        b1, b2 = bits
+        acc = point_add(acc, acc)
+        # table order [inf, G, Q, G+Q]: G iff b1, Q iff b2 -> idx = b1 + 2*b2
+        idx = (b1 + 2 * b2).astype(DTYPE)
+        sel = jnp.take_along_axis(
+            table, idx[..., None, None, None].astype(jnp.int32), axis=-3
+        )[..., 0, :, :]
+        return point_add(acc, sel), None
+
+    acc, _ = lax.scan(step, inf, xs)
+    return acc
+
+
+def ecdsa_verify_kernel(e, r, s, qx, qy):
+    """Batched ECDSA-P256 verification.  Pure, jittable.
+
+    All inputs are (..., NLIMBS) uint32 limb vectors in the *standard*
+    domain: e = 256-bit truncated message hash, (r, s) the signature,
+    (qx, qy) the signer's affine public key.  Returns a (...,) uint32
+    validity mask.  Invalid signatures yield 0 — never an exception — so a
+    whole quorum batch survives one bad vote (the protocol layer maps the
+    mask back to per-replica verdicts).
+    """
+    n_arr = jnp.asarray(FN.N)
+
+    # 1 <= r, s < n
+    r_ok = (jnp.uint32(1) - bn.is_zero(r)) * (jnp.uint32(1) - bn.geq(r, n_arr))
+    s_ok = (jnp.uint32(1) - bn.is_zero(s)) * (jnp.uint32(1) - bn.geq(s, n_arr))
+
+    # scalars: u1 = e/s, u2 = r/s (mod n)
+    e_red = FN.reduce_once(e)  # e < 2^256 < 2n
+    w = FN.inv(FN.to_mont(s))
+    u1 = FN.from_mont(FN.mul(FN.to_mont(e_red), w))
+    u2 = FN.from_mont(FN.mul(FN.to_mont(r), w))
+
+    # curve: R = u1*G + u2*Q
+    xm, ym = FP.to_mont(qx), FP.to_mont(qy)
+    oncurve = is_on_curve(xm, ym)
+    qpt = jnp.stack([xm, ym, jnp.broadcast_to(jnp.asarray(FP.one_mont), xm.shape)],
+                    axis=-2)
+    acc = shamir_double_scalar(bn.bits_msb(u1, 256), bn.bits_msb(u2, 256), qpt)
+
+    xr, zr = acc[..., 0, :], acc[..., 2, :]
+    not_inf = jnp.uint32(1) - bn.is_zero(zr)
+    x_aff = FP.from_mont(FP.mul(xr, FP.inv(zr)))  # garbage if zr == 0; masked
+    # x mod n: p < 2n so one conditional subtract
+    d, borrow = bn.sub_borrow(x_aff, n_arr)
+    x_mod_n = bn.select(borrow, x_aff, d)
+
+    # r is already < n when r_ok; compare
+    match = bn.eq(x_mod_n, r)
+    return match * not_inf * r_ok * s_ok * oncurve
+
+
+# ---------------------------------------------------------------------------
+# host-side reference arithmetic (Python ints) — keygen, sign, CPU verify
+# ---------------------------------------------------------------------------
+
+def _inv_mod(a: int, m: int) -> int:
+    return pow(a, -1, m)
+
+
+def _point_add_int(p1, p2):
+    """Affine addition over GF(P); None is the identity."""
+    if p1 is None:
+        return p2
+    if p2 is None:
+        return p1
+    x1, y1 = p1
+    x2, y2 = p2
+    if x1 == x2 and (y1 + y2) % P == 0:
+        return None
+    if p1 == p2:
+        lam = (3 * x1 * x1 - 3) * _inv_mod(2 * y1, P) % P
+    else:
+        lam = (y2 - y1) * _inv_mod(x2 - x1, P) % P
+    x3 = (lam * lam - x1 - x2) % P
+    y3 = (lam * (x1 - x3) - y1) % P
+    return (x3, y3)
+
+
+def scalar_mult_int(k: int, point):
+    """Double-and-add with Python ints (host-side; keygen/sign only)."""
+    acc = None
+    addend = point
+    while k:
+        if k & 1:
+            acc = _point_add_int(acc, addend)
+        addend = _point_add_int(addend, addend)
+        k >>= 1
+    return acc
+
+
+def keygen(seed: bytes | None = None):
+    """Returns (private_scalar, (qx, qy)).  Deterministic given a seed."""
+    if seed is None:
+        d = secrets.randbelow(N - 1) + 1
+    else:
+        d = (int.from_bytes(hashlib.sha256(b"p256-keygen" + seed).digest(), "big")
+             % (N - 1)) + 1
+    return d, scalar_mult_int(d, (GX, GY))
+
+
+def _rfc6979_nonce(priv: int, h1: bytes) -> int:
+    """Deterministic nonce, RFC 6979 §3.2 with HMAC-SHA256."""
+    holen = 32
+    bx = priv.to_bytes(32, "big") + (
+        int.from_bytes(h1, "big") % N
+    ).to_bytes(32, "big")
+    v = b"\x01" * holen
+    k = b"\x00" * holen
+    k = hmac.new(k, v + b"\x00" + bx, hashlib.sha256).digest()
+    v = hmac.new(k, v, hashlib.sha256).digest()
+    k = hmac.new(k, v + b"\x01" + bx, hashlib.sha256).digest()
+    v = hmac.new(k, v, hashlib.sha256).digest()
+    while True:
+        v = hmac.new(k, v, hashlib.sha256).digest()
+        cand = int.from_bytes(v, "big")
+        if 1 <= cand < N:
+            return cand
+        k = hmac.new(k, v + b"\x00", hashlib.sha256).digest()
+        v = hmac.new(k, v, hashlib.sha256).digest()
+
+
+def sign(priv: int, msg: bytes):
+    """ECDSA-SHA256 sign; returns (r, s) Python ints.  Host-side."""
+    h1 = hashlib.sha256(msg).digest()
+    e = int.from_bytes(h1, "big")
+    while True:
+        k = _rfc6979_nonce(priv, h1)
+        pt = scalar_mult_int(k, (GX, GY))
+        r = pt[0] % N
+        if r == 0:
+            h1 = hashlib.sha256(h1).digest()
+            continue
+        s = _inv_mod(k, N) * (e + r * priv) % N
+        if s == 0:
+            h1 = hashlib.sha256(h1).digest()
+            continue
+        return r, s
+
+
+def verify_int(pub, msg: bytes, r: int, s: int) -> bool:
+    """Pure-Python ECDSA verify — the CPU reference the kernel is tested
+    against and the single-threaded baseline for the benchmark harness."""
+    if not (1 <= r < N and 1 <= s < N):
+        return False
+    e = int.from_bytes(hashlib.sha256(msg).digest(), "big")
+    w = _inv_mod(s, N)
+    u1, u2 = e * w % N, r * w % N
+    pt = _point_add_int(
+        scalar_mult_int(u1, (GX, GY)), scalar_mult_int(u2, pub)
+    )
+    if pt is None:
+        return False
+    return pt[0] % N == r
+
+
+# ---------------------------------------------------------------------------
+# host <-> kernel marshalling
+# ---------------------------------------------------------------------------
+
+def hash_to_limbs(msg: bytes) -> np.ndarray:
+    """SHA-256(msg) as a 16-limb vector (the kernel's ``e`` input)."""
+    return bn.to_limbs(int.from_bytes(hashlib.sha256(msg).digest(), "big"), NLIMBS)
+
+
+def verify_inputs(items) -> tuple[np.ndarray, ...]:
+    """[(msg, r, s, (qx,qy)), ...] -> stacked (B,16) kernel inputs."""
+    e = np.stack([hash_to_limbs(m) for m, _, _, _ in items])
+    r = bn.batch_to_limbs([r for _, r, _, _ in items], NLIMBS)
+    s = bn.batch_to_limbs([s for _, _, s, _ in items], NLIMBS)
+    qx = bn.batch_to_limbs([q[0] for _, _, _, q in items], NLIMBS)
+    qy = bn.batch_to_limbs([q[1] for _, _, _, q in items], NLIMBS)
+    return e, r, s, qx, qy

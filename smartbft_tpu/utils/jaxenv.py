@@ -1,0 +1,70 @@
+"""JAX platform selection helpers for this environment.
+
+The image registers an ``axon`` PJRT plugin (TPU tunnel) from a
+sitecustomize hook in *every* Python process and forces
+``jax_platforms="axon,cpu"``.  When the tunnel is healthy that is the TPU
+path the benchmarks use; when it is down, the first backend initialization
+dials a dead relay and hangs every jit — CPU included.  Anything that must
+run regardless of tunnel health (tests, standalone drive scripts, CI)
+calls :func:`force_cpu` before touching jax.
+
+Call order matters: this must run before the first jax backend
+initialization (first ``jnp`` op / ``jax.devices()``), ideally right after
+``import jax``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def force_cpu(virtual_devices: int | None = None) -> None:
+    """Pin this process to the CPU backend, immune to tunnel health.
+
+    ``virtual_devices``: optionally fake an N-device host platform
+    (``--xla_force_host_platform_device_count``) for Mesh/sharding tests.
+    Only effective if no XLA flags conflict and jax hasn't initialized yet.
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ.pop("PALLAS_AXON_REMOTE_COMPILE", None)
+    if virtual_devices is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={virtual_devices}"
+            ).strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update(
+        "jax_compilation_cache_dir", os.path.expanduser("~/.smartbft_jax_cache")
+    )
+    # The sitecustomize hook has already registered the axon factory by the
+    # time any library code runs; JAX_PLATFORMS=cpu alone still errors on
+    # backend init ("Unable to initialize backend 'axon'").  Drop every
+    # non-CPU factory before initialization.  Loudly: if jax's internals
+    # move, we want to know, not hang.
+    try:
+        from jax._src import xla_bridge as _xb
+
+        factories = getattr(_xb, "_backend_factories", None)
+        if factories is None:
+            print(
+                "smartbft_tpu.utils.jaxenv: jax._src.xla_bridge._backend_factories "
+                "is gone; cannot purge non-CPU PJRT plugins — jit may hang if "
+                "the axon tunnel is down",
+                file=sys.stderr,
+            )
+            return
+        for name in list(factories):
+            if name != "cpu":
+                factories.pop(name, None)
+    except ImportError as exc:
+        print(
+            f"smartbft_tpu.utils.jaxenv: cannot purge PJRT factories ({exc}); "
+            "jit may hang if the axon tunnel is down",
+            file=sys.stderr,
+        )

@@ -3,17 +3,17 @@
 Multi-chip TPU hardware is not available in CI; sharding correctness is
 validated on virtual CPU devices exactly as the driver's multichip dry-run
 does.  Must run before jax is imported anywhere.
+
+Platform pinning (incl. disabling the axon TPU-tunnel plugin, which hangs
+every jit when the tunnel is down) lives in smartbft_tpu.utils.jaxenv so
+standalone drive scripts get the identical environment.
 """
 
 import os
-
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from smartbft_tpu.utils.jaxenv import force_cpu  # noqa: E402
+
+force_cpu(virtual_devices=8)
