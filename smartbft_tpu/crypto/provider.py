@@ -1,0 +1,327 @@
+"""Signer/Verifier crypto providers: host signing, batched TPU verification.
+
+The reference treats Signer/Verifier as opaque app plugins
+(/root/reference/pkg/api/dependencies.go:47-71) and verifies each commit
+signature on its own goroutine (/root/reference/internal/bft/view.go:537-541).
+Here the crypto seam is a first-class component:
+
+* :class:`Keyring` — node-id -> P-256 public key registry + own private key.
+* :class:`P256CryptoProvider` — implements the crypto subset of the
+  Verifier/Signer SPI.  Signing is host-side (one signature per decision;
+  never hot).  Verification goes through a pluggable engine:
+    - :class:`HostVerifyEngine`  — pure-Python ints; the CPU baseline.
+    - :class:`JaxVerifyEngine`   — pads votes into fixed-size lanes and runs
+      ONE jitted P-256 kernel launch per flush; an asyncio micro-batcher
+      coalesces concurrent quorum checks (across sequences and view-change
+      validations) into shared launches, which is where the cross-request
+      x cross-replica batching of BASELINE.md configs[2] comes from.
+
+Wire format of a consenter signature (Signature.msg): canonical encoding of
+:class:`ConsenterSigMsg` binding the proposal digest and the auxiliary data
+(the reference smuggles PreparesFrom aux the same way, view.go:472-481).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+import time
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..codec import decode, encode, wiremsg
+from ..messages import Proposal, Signature
+from ..types import proposal_digest
+from . import p256
+
+
+@wiremsg
+class ConsenterSigMsg:
+    """The exact bytes a consenter signs for a commit vote."""
+
+    proposal_digest: str = ""
+    aux: bytes = b""
+
+
+def _sig_encode(r: int, s: int) -> bytes:
+    return r.to_bytes(32, "big") + s.to_bytes(32, "big")
+
+
+def _sig_decode(raw: bytes) -> tuple[int, int]:
+    if len(raw) != 64:
+        raise ValueError("bad signature length")
+    return int.from_bytes(raw[:32], "big"), int.from_bytes(raw[32:], "big")
+
+
+class Keyring:
+    """Public keys of all replicas + this replica's private key."""
+
+    def __init__(self, self_id: int, private_key: int,
+                 public_keys: dict[int, tuple[int, int]]):
+        self.self_id = self_id
+        self.private_key = private_key
+        self.public_keys = dict(public_keys)
+
+    @classmethod
+    def generate(cls, node_ids: Sequence[int], seed: bytes = b"smartbft"):
+        """Deterministic keyring set for tests/benches: one per node id."""
+        keys = {nid: p256.keygen(seed + b"-%d" % nid) for nid in node_ids}
+        return {
+            nid: cls(nid, keys[nid][0], {n: k[1] for n, k in keys.items()})
+            for nid in node_ids
+        }
+
+
+# ---------------------------------------------------------------------------
+# verify engines
+# ---------------------------------------------------------------------------
+
+@dataclass
+class VerifyStats:
+    """Batch-occupancy + latency accounting (BASELINE.md metrics)."""
+
+    launches: int = 0
+    sigs_verified: int = 0
+    slots_used: int = 0
+    total_kernel_seconds: float = 0.0
+
+    @property
+    def batch_fill_pct(self) -> float:
+        return 100.0 * self.sigs_verified / self.slots_used if self.slots_used else 0.0
+
+    @property
+    def us_per_sig(self) -> float:
+        if not self.sigs_verified:
+            return 0.0
+        return 1e6 * self.total_kernel_seconds / self.sigs_verified
+
+
+class HostVerifyEngine:
+    """Sequential pure-Python verification — the CPU baseline engine."""
+
+    def __init__(self) -> None:
+        self.stats = VerifyStats()
+
+    def verify(self, items) -> list[bool]:
+        t0 = time.perf_counter()
+        out = [p256.verify_int(pub, msg, r, s) for (msg, r, s, pub) in items]
+        self.stats.launches += 1
+        self.stats.sigs_verified += len(items)
+        self.stats.slots_used += len(items)
+        self.stats.total_kernel_seconds += time.perf_counter() - t0
+        return out
+
+
+class JaxVerifyEngine:
+    """Padded, jit-cached, batched P-256 verification on the JAX device.
+
+    Lane sizes are fixed (powers of two) so at most ``len(pad_sizes)``
+    kernels ever compile; every call pads up to the next size.  Thread-safe;
+    the jit cache is shared.
+    """
+
+    def __init__(self, pad_sizes: Sequence[int] = (8, 32, 128, 512, 2048)):
+        import jax  # deferred: engine construction may precede platform pin
+
+        self._jax = jax
+        self.pad_sizes = tuple(sorted(pad_sizes))
+        self._kernel = jax.jit(p256.ecdsa_verify_kernel)
+        self._lock = threading.Lock()
+        self.stats = VerifyStats()
+
+    def _pad_to(self, n: int) -> int:
+        for s in self.pad_sizes:
+            if n <= s:
+                return s
+        return self.pad_sizes[-1]
+
+    def verify(self, items) -> list[bool]:
+        """items: [(msg_bytes, r, s, (qx, qy)), ...] -> validity per item."""
+        if not items:
+            return []
+        out: list[bool] = []
+        # oversized batches run in chunks of the largest lane size
+        cap = self.pad_sizes[-1]
+        for off in range(0, len(items), cap):
+            out.extend(self._verify_chunk(items[off : off + cap]))
+        return out
+
+    def _verify_chunk(self, items) -> list[bool]:
+        n = len(items)
+        size = self._pad_to(n)
+        e, r, s, qx, qy = p256.verify_inputs(items)
+
+        def pad(a):
+            return np.concatenate([a, np.zeros((size - n,) + a.shape[1:], a.dtype)])
+
+        t0 = time.perf_counter()
+        mask = np.asarray(self._kernel(pad(e), pad(r), pad(s), pad(qx), pad(qy)))
+        dt = time.perf_counter() - t0
+        with self._lock:
+            self.stats.launches += 1
+            self.stats.sigs_verified += n
+            self.stats.slots_used += size
+            self.stats.total_kernel_seconds += dt
+        return [bool(v) for v in mask[:n]]
+
+
+class AsyncBatchCoalescer:
+    """Merges concurrent verify calls into shared kernel launches.
+
+    The protocol core awaits ``submit(items)``; submissions that arrive
+    within ``window`` seconds (or until ``max_batch`` fills) are flushed as
+    one engine call on a worker thread.  This is the TPU analog of the
+    reference's per-signature goroutine fan-out — except the fan-*in* is
+    explicit, so one launch serves many sequences and replicas.
+    """
+
+    def __init__(self, engine, window: float = 0.002, max_batch: int = 2048):
+        self.engine = engine
+        self.window = window
+        self.max_batch = max_batch
+        self._pending: list[tuple] = []
+        self._futures: list[tuple[asyncio.Future, int, int]] = []
+        self._flush_task: Optional[asyncio.Task] = None
+        self._lock = asyncio.Lock()
+
+    async def submit(self, items) -> list[bool]:
+        if not items:
+            return []
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        async with self._lock:
+            start = len(self._pending)
+            self._pending.extend(items)
+            self._futures.append((fut, start, len(items)))
+            full = len(self._pending) >= self.max_batch
+            if full or self._flush_task is None or self._flush_task.done():
+                self._flush_task = asyncio.ensure_future(
+                    self._flush_after(0.0 if full else self.window)
+                )
+        return await fut
+
+    async def _flush_after(self, delay: float) -> None:
+        if delay:
+            await asyncio.sleep(delay)
+        # swap under the lock, verify outside it — submissions arriving
+        # during the kernel launch accumulate into the NEXT batch
+        async with self._lock:
+            pending, futures = self._pending, self._futures
+            self._pending, self._futures = [], []
+        if not pending:
+            return
+        results = await asyncio.to_thread(self.engine.verify, pending)
+        for fut, start, count in futures:
+            if not fut.done():
+                fut.set_result(results[start : start + count])
+
+
+# ---------------------------------------------------------------------------
+# SPI provider
+# ---------------------------------------------------------------------------
+
+class P256CryptoProvider:
+    """Crypto subset of the Signer/Verifier SPI over a :class:`Keyring`.
+
+    The application's Verifier implementation delegates
+    sign/verify-signature duties here and keeps request/proposal semantics
+    (payload checks, request extraction) to itself.
+    """
+
+    def __init__(self, keyring: Keyring, engine=None,
+                 coalesce_window: float = 0.002):
+        self.keyring = keyring
+        self.engine = engine if engine is not None else HostVerifyEngine()
+        self._coalescer = AsyncBatchCoalescer(self.engine, window=coalesce_window)
+
+    # -- Signer -------------------------------------------------------------
+
+    def sign(self, data: bytes) -> bytes:
+        return _sig_encode(*p256.sign(self.keyring.private_key, data))
+
+    def sign_proposal(self, proposal: Proposal, auxiliary_input: bytes) -> Signature:
+        msg = encode(ConsenterSigMsg(
+            proposal_digest=proposal_digest(proposal), aux=auxiliary_input
+        ))
+        return Signature(signer=self.keyring.self_id, value=self.sign(msg), msg=msg)
+
+    # -- Verifier (crypto methods) -------------------------------------------
+
+    def _item(self, signature: Signature):
+        pub = self.keyring.public_keys.get(signature.signer)
+        if pub is None:
+            raise ValueError(f"unknown signer {signature.signer}")
+        r, s = _sig_decode(signature.value)
+        return (signature.msg, r, s, pub)
+
+    def _check_binding(self, signature: Signature, proposal: Proposal) -> bytes:
+        """Digest binding check; returns aux.  Raises on mismatch."""
+        decoded = decode(ConsenterSigMsg, signature.msg)
+        if decoded.proposal_digest != proposal_digest(proposal):
+            raise ValueError(
+                f"signature of {signature.signer} binds digest "
+                f"{decoded.proposal_digest[:12]}.. not the proposal's"
+            )
+        return decoded.aux
+
+    def verify_consenter_sig(self, signature: Signature, proposal: Proposal) -> bytes:
+        aux = self._check_binding(signature, proposal)
+        ok = self.engine.verify([self._item(signature)])[0]
+        if not ok:
+            raise ValueError(f"invalid consenter signature from {signature.signer}")
+        return aux
+
+    def verify_consenter_sigs_batch(
+        self, signatures: Sequence[Signature], proposal: Proposal
+    ) -> list[Optional[bytes]]:
+        auxes: list[Optional[bytes]] = []
+        items, idxs = [], []
+        for i, sig in enumerate(signatures):
+            try:
+                aux = self._check_binding(sig, proposal)
+                items.append(self._item(sig))
+                idxs.append(i)
+                auxes.append(aux)
+            except Exception:
+                auxes.append(None)
+        mask = self.engine.verify(items)
+        for pos, i in enumerate(idxs):
+            if not mask[pos]:
+                auxes[i] = None
+        return auxes
+
+    async def verify_consenter_sigs_batch_async(
+        self, signatures: Sequence[Signature], proposal: Proposal
+    ) -> list[Optional[bytes]]:
+        """Async path the View prefers: coalesces with concurrent callers."""
+        auxes: list[Optional[bytes]] = []
+        items, idxs = [], []
+        for i, sig in enumerate(signatures):
+            try:
+                aux = self._check_binding(sig, proposal)
+                items.append(self._item(sig))
+                idxs.append(i)
+                auxes.append(aux)
+            except Exception:
+                auxes.append(None)
+        mask = await self._coalescer.submit(items)
+        for pos, i in enumerate(idxs):
+            if not mask[pos]:
+                auxes[i] = None
+        return auxes
+
+    def verify_signature(self, signature: Signature) -> None:
+        try:
+            ok = self.engine.verify([self._item(signature)])[0]
+        except Exception as exc:
+            raise ValueError(f"malformed signature from {signature.signer}: {exc}")
+        if not ok:
+            raise ValueError(f"invalid signature from {signature.signer}")
+
+    def auxiliary_data(self, msg: bytes) -> bytes:
+        try:
+            return decode(ConsenterSigMsg, msg).aux
+        except Exception:
+            return b""

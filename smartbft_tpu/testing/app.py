@@ -1,8 +1,11 @@
 """Test application: implements every SPI interface in-process.
 
-Re-design of /root/reference/test/test_app.go:28-494.  Trivial crypto
-(signature = node id, verification always succeeds, auxiliary data passes
-through), a shared in-memory ledger that doubles as the Synchronizer source,
+Re-design of /root/reference/test/test_app.go:28-494.  Crypto is trivial by
+default (signature = node id, verification always succeeds, auxiliary data
+passes through) but a real provider (smartbft_tpu.crypto.provider.
+P256CryptoProvider) can be injected via ``crypto=`` — then every commit vote
+carries a real P-256 signature and verification can genuinely fail.  Plus: a
+shared in-memory ledger that doubles as the Synchronizer source,
 fault-injection hooks, restart with real per-node WAL dirs, and the fast
 test configuration.
 """
@@ -127,6 +130,7 @@ class App(Application, Assembler, Comm, Signer, Verifier, RequestInspector,
         wal_dir: Optional[str] = None,
         config: Optional[Configuration] = None,
         use_metrics: bool = False,
+        crypto=None,
     ):
         self.id = node_id
         self.network = network
@@ -146,6 +150,14 @@ class App(Application, Assembler, Comm, Signer, Verifier, RequestInspector,
         shared.register(node_id)
         self.metrics = MetricsBundle(InMemoryProvider()) if use_metrics else None
         self.clock = scheduler
+        # optional real-crypto provider (smartbft_tpu.crypto.provider.
+        # P256CryptoProvider); when set, Signer/Verifier crypto methods
+        # delegate to it and the View's async batch path is enabled
+        self.crypto = crypto
+        if crypto is not None and hasattr(crypto, "verify_consenter_sigs_batch_async"):
+            self.verify_consenter_sigs_batch_async = (
+                crypto.verify_consenter_sigs_batch_async
+            )
 
     # ------------------------------------------------------------------ app
 
@@ -178,9 +190,13 @@ class App(Application, Assembler, Comm, Signer, Verifier, RequestInspector,
     # -- Signer ------------------------------------------------------------
 
     def sign(self, data: bytes) -> bytes:
+        if self.crypto is not None:
+            return self.crypto.sign(data)
         return b"sig-%d" % self.id
 
     def sign_proposal(self, proposal: Proposal, auxiliary_input: bytes) -> Signature:
+        if self.crypto is not None:
+            return self.crypto.sign_proposal(proposal, auxiliary_input)
         return Signature(signer=self.id, value=b"sig-%d" % self.id, msg=auxiliary_input)
 
     # -- Verifier (trivial crypto, test_app.go:237-267) --------------------
@@ -192,9 +208,19 @@ class App(Application, Assembler, Comm, Signer, Verifier, RequestInspector,
         return self.request_id(raw_request)
 
     def verify_consenter_sig(self, signature: Signature, proposal: Proposal) -> bytes:
+        if self.crypto is not None:
+            return self.crypto.verify_consenter_sig(signature, proposal)
         return signature.msg
 
+    def verify_consenter_sigs_batch(self, signatures, proposal: Proposal):
+        if self.crypto is not None and hasattr(self.crypto, "verify_consenter_sigs_batch"):
+            return self.crypto.verify_consenter_sigs_batch(signatures, proposal)
+        # SPI default: sequential loop over verify_consenter_sig
+        return super().verify_consenter_sigs_batch(signatures, proposal)
+
     def verify_signature(self, signature: Signature) -> None:
+        if self.crypto is not None:
+            return self.crypto.verify_signature(signature)
         return None
 
     def verification_sequence(self) -> int:
@@ -207,6 +233,8 @@ class App(Application, Assembler, Comm, Signer, Verifier, RequestInspector,
         return [self.request_id(r) for r in batch.requests]
 
     def auxiliary_data(self, msg: bytes) -> bytes:
+        if self.crypto is not None:
+            return self.crypto.auxiliary_data(msg)
         return msg
 
     # -- RequestInspector --------------------------------------------------
