@@ -1,0 +1,148 @@
+"""Benchmark: batched P-256 signature verification, device vs native CPU.
+
+Prints ONE JSON line:
+  {"metric": "p256_sig_verify_p50_us", "value": <device us/sig>,
+   "unit": "us/sig", "vs_baseline": <speedup over single-core OpenSSL>}
+
+The metric is BASELINE.md's "p50 sig-verify us/sig".  The baseline is
+single-threaded OpenSSL ECDSA-P256 verify (via the `cryptography` wheel) —
+the same class of optimized native code as the reference's Go
+crypto/ecdsa, which verifies one commit signature per goroutine
+(/root/reference/internal/bft/view.go:537-541).  vs_baseline > 1 means one
+device kernel launch beats a CPU core by that factor per signature.
+
+Platform: uses whatever JAX platform the environment provides (the axon TPU
+tunnel on the driver; CPU elsewhere).  A subprocess probe guards against a
+wedged tunnel — if device init doesn't come up in time, the bench re-execs
+itself pinned to CPU so it always completes.
+
+Env knobs: SMARTBFT_BENCH_BATCH (default 512), SMARTBFT_BENCH_REPS (5).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+BATCH = int(os.environ.get("SMARTBFT_BENCH_BATCH", "512"))
+REPS = int(os.environ.get("SMARTBFT_BENCH_REPS", "5"))
+PROBE_TIMEOUT = float(os.environ.get("SMARTBFT_BENCH_PROBE_TIMEOUT", "120"))
+
+
+def _log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _platform_ok() -> bool:
+    """Probe default-platform JAX init in a subprocess (tunnel may hang)."""
+    code = "import jax; jax.devices(); import jax.numpy as jnp; (jnp.ones(4)+1).block_until_ready()"
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code], timeout=PROBE_TIMEOUT,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        return proc.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def _openssl_baseline(items) -> float:
+    """Single-threaded OpenSSL verify; returns us/sig."""
+    import hashlib
+
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.hazmat.primitives.asymmetric.utils import (
+        encode_dss_signature,
+    )
+
+    pubs = {}
+    prepared = []
+    for msg, r, s, pub in items:
+        if pub not in pubs:
+            pubs[pub] = ec.EllipticCurvePublicNumbers(
+                pub[0], pub[1], ec.SECP256R1()
+            ).public_key()
+        prepared.append((msg, encode_dss_signature(r, s), pubs[pub]))
+    t0 = time.perf_counter()
+    for msg, der, key in prepared:
+        key.verify(der, msg, ec.ECDSA(hashes.SHA256()))
+    dt = time.perf_counter() - t0
+    return 1e6 * dt / len(prepared)
+
+
+def main() -> None:
+    if os.environ.get("_SMARTBFT_BENCH_CPU") != "1" and not _platform_ok():
+        _log("bench: default JAX platform unavailable (tunnel down?); "
+             "re-exec pinned to CPU")
+        env = dict(os.environ, _SMARTBFT_BENCH_CPU="1")
+        os.execve(sys.executable, [sys.executable, __file__], env)
+
+    if os.environ.get("_SMARTBFT_BENCH_CPU") == "1":
+        from smartbft_tpu.utils.jaxenv import force_cpu
+
+        force_cpu()
+    import jax
+
+    jax.config.update(
+        "jax_compilation_cache_dir", os.path.expanduser("~/.smartbft_jax_cache")
+    )
+    import jax.numpy as jnp
+
+    from smartbft_tpu.crypto import p256
+
+    platform = jax.devices()[0].platform
+    _log(f"bench: platform={platform} batch={BATCH} reps={REPS}")
+
+    # workload: BATCH commit votes, 64 distinct replica keys, distinct msgs
+    keys = [p256.keygen(b"bench-%d" % i) for i in range(64)]
+    items = []
+    for i in range(BATCH):
+        d, pub = keys[i % 64]
+        msg = b"proposal-%d" % i
+        r, s = p256.sign(d, msg)
+        items.append((msg, r, s, pub))
+
+    args = tuple(jnp.asarray(a) for a in p256.verify_inputs(items))
+    kern = jax.jit(p256.ecdsa_verify_kernel)
+
+    t0 = time.perf_counter()
+    mask = kern(*args)
+    mask.block_until_ready()
+    _log(f"bench: first call (compile+run) {time.perf_counter() - t0:.1f}s")
+    import numpy as np
+
+    if not np.asarray(mask).all():
+        _log("bench: ERROR device kernel rejected valid signatures")
+        raise SystemExit(1)
+
+    times = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        kern(*args).block_until_ready()
+        times.append(time.perf_counter() - t0)
+    device_us = 1e6 * statistics.median(times) / BATCH
+    _log(f"bench: device {device_us:.1f} us/sig "
+         f"({BATCH / statistics.median(times):.0f} sigs/s)")
+
+    base_n = min(BATCH, 256)
+    base_us = _openssl_baseline(items[:base_n])
+    _log(f"bench: openssl single-core {base_us:.1f} us/sig")
+
+    print(json.dumps({
+        "metric": "p256_sig_verify_p50_us",
+        "value": round(device_us, 2),
+        "unit": "us/sig",
+        "vs_baseline": round(base_us / device_us, 3),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

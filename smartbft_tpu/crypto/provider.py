@@ -101,16 +101,22 @@ class VerifyStats:
 class HostVerifyEngine:
     """Sequential pure-Python verification — the CPU baseline engine."""
 
+    # sequential engine: coalescing gains nothing, don't add window latency
+    preferred_coalesce_window = 0.0
+
     def __init__(self) -> None:
         self.stats = VerifyStats()
+        self._lock = threading.Lock()
 
     def verify(self, items) -> list[bool]:
         t0 = time.perf_counter()
         out = [p256.verify_int(pub, msg, r, s) for (msg, r, s, pub) in items]
-        self.stats.launches += 1
-        self.stats.sigs_verified += len(items)
-        self.stats.slots_used += len(items)
-        self.stats.total_kernel_seconds += time.perf_counter() - t0
+        dt = time.perf_counter() - t0
+        with self._lock:
+            self.stats.launches += 1
+            self.stats.sigs_verified += len(items)
+            self.stats.slots_used += len(items)
+            self.stats.total_kernel_seconds += dt
         return out
 
 
@@ -121,6 +127,8 @@ class JaxVerifyEngine:
     kernels ever compile; every call pads up to the next size.  Thread-safe;
     the jit cache is shared.
     """
+
+    preferred_coalesce_window = 0.002  # batched engine: wait for fan-in
 
     def __init__(self, pad_sizes: Sequence[int] = (8, 32, 128, 512, 2048)):
         import jax  # deferred: engine construction may precede platform pin
@@ -183,7 +191,7 @@ class AsyncBatchCoalescer:
         self.max_batch = max_batch
         self._pending: list[tuple] = []
         self._futures: list[tuple[asyncio.Future, int, int]] = []
-        self._flush_task: Optional[asyncio.Task] = None
+        self._flush_scheduled = False
         self._lock = asyncio.Lock()
 
     async def submit(self, items) -> list[bool]:
@@ -195,11 +203,15 @@ class AsyncBatchCoalescer:
             start = len(self._pending)
             self._pending.extend(items)
             self._futures.append((fut, start, len(items)))
-            full = len(self._pending) >= self.max_batch
-            if full or self._flush_task is None or self._flush_task.done():
-                self._flush_task = asyncio.ensure_future(
-                    self._flush_after(0.0 if full else self.window)
-                )
+            # _flush_scheduled covers exactly the CURRENT batch: it resets
+            # when a flush swaps the batch out, so items arriving while a
+            # previous flush's kernel is still running get their own flush
+            if len(self._pending) >= self.max_batch:
+                asyncio.ensure_future(self._flush_after(0.0))
+                self._flush_scheduled = True
+            elif not self._flush_scheduled:
+                self._flush_scheduled = True
+                asyncio.ensure_future(self._flush_after(self.window))
         return await fut
 
     async def _flush_after(self, delay: float) -> None:
@@ -210,9 +222,18 @@ class AsyncBatchCoalescer:
         async with self._lock:
             pending, futures = self._pending, self._futures
             self._pending, self._futures = [], []
+            self._flush_scheduled = False
         if not pending:
             return
-        results = await asyncio.to_thread(self.engine.verify, pending)
+        try:
+            results = await asyncio.to_thread(self.engine.verify, pending)
+        except Exception as exc:
+            for fut, _, _ in futures:
+                if not fut.done():
+                    fut.set_exception(
+                        RuntimeError(f"batch verify failed: {exc!r}")
+                    )
+            return
         for fut, start, count in futures:
             if not fut.done():
                 fut.set_result(results[start : start + count])
@@ -231,9 +252,13 @@ class P256CryptoProvider:
     """
 
     def __init__(self, keyring: Keyring, engine=None,
-                 coalesce_window: float = 0.002):
+                 coalesce_window: Optional[float] = None):
         self.keyring = keyring
         self.engine = engine if engine is not None else HostVerifyEngine()
+        if coalesce_window is None:
+            coalesce_window = getattr(
+                self.engine, "preferred_coalesce_window", 0.002
+            )
         self._coalescer = AsyncBatchCoalescer(self.engine, window=coalesce_window)
 
     # -- Signer -------------------------------------------------------------

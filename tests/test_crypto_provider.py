@@ -112,6 +112,48 @@ def test_coalescer_merges_concurrent_submissions(keyrings):
     assert engine.stats.sigs_verified == 5
 
 
+def test_coalescer_flushes_items_arriving_mid_verify():
+    """Regression: a submit landing while a flush's kernel is running must
+    get its own flush, not wait for unrelated future traffic."""
+
+    class SlowEngine:
+        def __init__(self):
+            self.calls = 0
+
+        def verify(self, items):
+            self.calls += 1
+            import time
+            time.sleep(0.05)  # runs in a worker thread
+            return [True] * len(items)
+
+    engine = SlowEngine()
+    co = AsyncBatchCoalescer(engine, window=0.001)
+
+    async def run():
+        first = asyncio.ensure_future(co.submit([("a",)]))
+        await asyncio.sleep(0.01)  # first flush is now inside engine.verify
+        second = await asyncio.wait_for(co.submit([("b",)]), timeout=2.0)
+        await first
+        return second
+
+    assert asyncio.run(run()) == [True]
+    assert engine.calls == 2
+
+
+def test_coalescer_propagates_engine_errors():
+    class BoomEngine:
+        def verify(self, items):
+            raise ValueError("boom")
+
+    co = AsyncBatchCoalescer(BoomEngine(), window=0.001)
+
+    async def run():
+        with pytest.raises(RuntimeError, match="batch verify failed"):
+            await asyncio.wait_for(co.submit([("a",)]), timeout=2.0)
+
+    asyncio.run(run())
+
+
 def test_e2e_consensus_with_real_ecdsa(tmp_path):
     """4 nodes, real P-256 commit signatures, host engine (fast in CI;
     JaxVerifyEngine is exercised above and in the bench harness)."""
