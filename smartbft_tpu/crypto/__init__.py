@@ -9,6 +9,8 @@ Layout:
   bignum.py   -- fixed-width big integers on 16-bit limbs (uint32 storage),
                  Montgomery arithmetic; dtype-safe on TPU (no 64-bit needed).
   p256.py     -- NIST P-256 ECDSA: complete-addition curve ops, batched verify.
+  ed25519.py  -- Ed25519 EdDSA: unified twisted-Edwards ops, batched verify.
 """
 
 from . import bignum  # noqa: F401
+from . import ed25519  # noqa: F401

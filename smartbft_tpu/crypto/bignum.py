@@ -30,7 +30,7 @@ from __future__ import annotations
 
 import numpy as np
 
-import jax
+
 import jax.numpy as jnp
 from jax import lax
 
@@ -140,6 +140,29 @@ def bits_msb(a, nbits: int):
     limb = idx // LIMB_BITS
     off = idx % LIMB_BITS
     return (a[..., limb] >> jnp.asarray(off, DTYPE)) & jnp.uint32(1)
+
+
+def shamir_scan(point_add, table, ident, bits1, bits2):
+    """Strauss–Shamir double-scalar-mult scan shared by every curve.
+
+    Per bit: one doubling + one gather from ``table`` (shape (..., 4, C, n),
+    entries [ident, P1, P2, P1+P2]) + one addition.  ``bits1``/``bits2`` are
+    (..., nbits) MSB-first; points are (..., C, n) for any coordinate count C.
+    ``point_add`` must be complete (identity-safe) — no branches are emitted.
+    """
+    xs = (jnp.moveaxis(bits1, -1, 0), jnp.moveaxis(bits2, -1, 0))
+
+    def step(acc, bits):
+        b1, b2 = bits
+        acc = point_add(acc, acc)
+        idx = (b1 + 2 * b2).astype(DTYPE)
+        sel = jnp.take_along_axis(
+            table, idx[..., None, None, None].astype(jnp.int32), axis=-3
+        )[..., 0, :, :]
+        return point_add(acc, sel), None
+
+    acc, _ = lax.scan(step, ident, xs)
+    return acc
 
 
 # ---------------------------------------------------------------------------

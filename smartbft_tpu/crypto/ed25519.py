@@ -1,0 +1,311 @@
+"""Ed25519 (RFC 8032): batched TPU verification, host-side signing.
+
+Same design as :mod:`p256` — the alt-curve Signer/Verifier variant of
+BASELINE.md configs[3].  The reference delegates signatures to the embedding
+application (/root/reference/pkg/api/dependencies.go:47-71) and verifies one
+commit vote per goroutine (/root/reference/internal/bft/view.go:537-541);
+here a whole quorum of EdDSA votes is ONE jitted kernel launch:
+
+* Field/scalar arithmetic: :mod:`bignum` Montgomery contexts for
+  p = 2^255-19 and the group order L.
+* Curve arithmetic: extended twisted-Edwards coordinates (X:Y:Z:T) with the
+  unified a=-1 addition formula (Hisil-Wong-Carter-Dawson 2008,
+  "add-2008-hwcd-3").  Because -1 is a square mod p and d is non-square,
+  the formula is complete: one branch-free straight-line block covers
+  addition, doubling, and the identity — ideal for XLA.
+* Verification equation (cofactorless, as in Go's crypto/ed25519):
+  [S]B == R + [h]A, evaluated as [S]B + [h](-A) == R with Strauss-Shamir
+  interleaving: a single ``lax.scan`` over 253 bits, one table gather + one
+  unified addition per bit.
+
+Hashing (SHA-512) and point decompression are host-side marshalling —
+exactly like SHA-256 digesting in the P-256 path; the kernel re-checks both
+points against the curve equation so a bad decompression can never validate.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import secrets
+
+import numpy as np
+
+import jax.numpy as jnp
+
+
+from . import bignum as bn
+from .bignum import MontCtx
+
+# --- curve constants (RFC 8032 §5.1) ---------------------------------------
+
+P = 2**255 - 19
+L = 2**252 + 27742317777372353535851937790883648493
+D = (-121665 * pow(121666, -1, P)) % P
+BY = (4 * pow(5, -1, P)) % P
+BX = 15112221349535400772501151409588531511454012693041857206046113283949847762202
+SQRT_M1 = pow(2, (P - 1) // 4, P)  # sqrt(-1) mod p
+
+NLIMBS = 16
+FP = MontCtx(P, NLIMBS)
+FL = MontCtx(L, NLIMBS)
+
+SCALAR_BITS = 253  # L < 2^253
+
+_D_MONT = FP.encode(D)
+_D2_MONT = FP.encode((2 * D) % P)
+_B_MONT = np.stack([
+    FP.encode(BX), FP.encode(BY), FP.one_mont, FP.encode(BX * BY % P)
+])
+# identity in extended coordinates: (0 : 1 : 1 : 0)
+_ID_MONT = np.stack([FP.zero, FP.one_mont, FP.one_mont, FP.zero])
+
+
+# ---------------------------------------------------------------------------
+# extended twisted-Edwards ops (points are (..., 4, NLIMBS) Mont arrays)
+# ---------------------------------------------------------------------------
+
+def point_add(p, q):
+    """Unified addition, add-2008-hwcd-3 (a = -1).  Complete on this curve.
+
+    8 field mults + 1 mult by the 2d constant.
+    """
+    f = FP
+    x1, y1, z1, t1 = (p[..., i, :] for i in range(4))
+    x2, y2, z2, t2 = (q[..., i, :] for i in range(4))
+
+    a = f.mul(f.sub(y1, x1), f.sub(y2, x2))
+    b = f.mul(f.add(y1, x1), f.add(y2, x2))
+    c = f.mul(f.mul(t1, jnp.asarray(_D2_MONT)), t2)
+    d = f.mul(f.dbl(z1), z2)
+    e = f.sub(b, a)
+    ff = f.sub(d, c)
+    g = f.add(d, c)
+    h = f.add(b, a)
+    x3 = f.mul(e, ff)
+    y3 = f.mul(g, h)
+    t3 = f.mul(e, h)
+    z3 = f.mul(ff, g)
+    return jnp.stack([x3, y3, z3, t3], axis=-2)
+
+
+def point_neg(p):
+    """-(X:Y:Z:T) = (-X:Y:Z:-T)."""
+    return jnp.stack([
+        FP.neg(p[..., 0, :]), p[..., 1, :], p[..., 2, :], FP.neg(p[..., 3, :])
+    ], axis=-2)
+
+
+def is_on_curve(xm, ym):
+    """-x^2 + y^2 == 1 + d*x^2*y^2 in Mont domain; (...,) uint32 mask."""
+    f = FP
+    xx = f.mul(xm, xm)
+    yy = f.mul(ym, ym)
+    lhs = f.sub(yy, xx)
+    one = jnp.broadcast_to(jnp.asarray(FP.one_mont), xx.shape)
+    rhs = f.add(one, f.mul(jnp.asarray(_D_MONT), f.mul(xx, yy)))
+    return bn.eq(lhs, rhs)
+
+
+def _extended(xm, ym):
+    """Affine Mont coords -> extended (X:Y:1:XY)."""
+    one = jnp.broadcast_to(jnp.asarray(FP.one_mont), xm.shape)
+    return jnp.stack([xm, ym, one, FP.mul(xm, ym)], axis=-2)
+
+
+def shamir_double_scalar(s_bits, h_bits, nega):
+    """[s]B + [h]*nega with one scan: per bit, 1 doubling + 1 table add.
+
+    s_bits/h_bits: (..., 253) MSB-first; nega: (..., 4, NLIMBS) Mont domain.
+    """
+    b = jnp.broadcast_to(jnp.asarray(_B_MONT), nega.shape)
+    ident = jnp.broadcast_to(jnp.asarray(_ID_MONT), nega.shape)
+    b_na = point_add(b, nega)
+    table = jnp.stack([ident, b, nega, b_na], axis=-3)  # (..., 4, 4, n)
+    return bn.shamir_scan(point_add, table, ident, s_bits, h_bits)
+
+
+def eddsa_verify_kernel(s, h, rx, ry, ax, ay, ok_in):
+    """Batched Ed25519 verification.  Pure, jittable.
+
+    Inputs are (..., NLIMBS) uint32 limb vectors in the *standard* domain:
+    ``s`` the signature scalar, ``h`` = SHA-512(R || A || M) mod L (host
+    hashing, like the P-256 path's SHA-256), (rx, ry) and (ax, ay) the
+    decompressed signature/public points, plus ``ok_in`` — a (...,) uint32
+    host flag, 0 where decoding/decompression already failed (those lanes
+    carry identity coordinates).  Returns a (...,) uint32 validity mask;
+    invalid signatures yield 0, never an exception.
+    """
+    l_arr = jnp.asarray(FL.N)
+    s_ok = jnp.uint32(1) - bn.geq(s, l_arr)  # RFC 8032: 0 <= s < L
+
+    rxm, rym = FP.to_mont(rx), FP.to_mont(ry)
+    axm, aym = FP.to_mont(ax), FP.to_mont(ay)
+    oncurve = is_on_curve(rxm, rym) * is_on_curve(axm, aym)
+
+    nega = point_neg(_extended(axm, aym))
+    acc = shamir_double_scalar(
+        bn.bits_msb(s, SCALAR_BITS), bn.bits_msb(h, SCALAR_BITS), nega
+    )  # [s]B - [h]A, extended coords; Z != 0 by completeness
+
+    xz = acc[..., 0, :]
+    yz = acc[..., 1, :]
+    z = acc[..., 2, :]
+    match = bn.eq(FP.mul(rxm, z), xz) * bn.eq(FP.mul(rym, z), yz)
+    return match * s_ok * oncurve * ok_in
+
+
+# ---------------------------------------------------------------------------
+# host-side reference arithmetic (Python ints) — keygen, sign, CPU verify
+# ---------------------------------------------------------------------------
+
+def _edwards_add_int(p1, p2):
+    """Affine Edwards addition over GF(P); (0, 1) is the identity."""
+    x1, y1 = p1
+    x2, y2 = p2
+    den = D * x1 * x2 * y1 * y2 % P
+    x3 = (x1 * y2 + x2 * y1) * pow(1 + den, -1, P) % P
+    y3 = (y1 * y2 + x1 * x2) * pow(1 - den, -1, P) % P
+    return (x3, y3)
+
+
+def scalar_mult_int(k: int, point):
+    """Double-and-add with Python ints (host-side; keygen/sign only)."""
+    acc = (0, 1)
+    addend = point
+    while k:
+        if k & 1:
+            acc = _edwards_add_int(acc, addend)
+        addend = _edwards_add_int(addend, addend)
+        k >>= 1
+    return acc
+
+
+def compress(point) -> bytes:
+    x, y = point
+    return (y | ((x & 1) << 255)).to_bytes(32, "little")
+
+
+def decompress(data: bytes):
+    """32-byte encoding -> affine point, or None if invalid (RFC 8032 §5.1.3)."""
+    if len(data) != 32:
+        return None
+    val = int.from_bytes(data, "little")
+    sign = val >> 255
+    y = val & ((1 << 255) - 1)
+    if y >= P:
+        return None
+    yy = y * y % P
+    u = (yy - 1) % P
+    v = (D * yy + 1) % P
+    # candidate root of u/v: (u*v^3) * (u*v^7)^((p-5)/8)
+    x = u * pow(v, 3, P) * pow(u * pow(v, 7, P) % P, (P - 5) // 8, P) % P
+    if v * x * x % P != u:
+        x = x * SQRT_M1 % P
+        if v * x * x % P != u:
+            return None
+    if x == 0 and sign:
+        return None
+    if x & 1 != sign:
+        x = P - x
+    return (x, y)
+
+
+def _clamp(raw: bytes) -> int:
+    a = bytearray(raw)
+    a[0] &= 248
+    a[31] &= 127
+    a[31] |= 64
+    return int.from_bytes(bytes(a), "little")
+
+
+def keygen(seed: bytes | None = None):
+    """Returns (private_key_bytes, public_key_bytes).  Deterministic w/ seed."""
+    if seed is None:
+        priv = secrets.token_bytes(32)
+    else:
+        priv = hashlib.sha256(b"ed25519-keygen" + seed).digest()
+    h = hashlib.sha512(priv).digest()
+    a = _clamp(h[:32])
+    return priv, compress(scalar_mult_int(a, (BX, BY)))
+
+
+def sign(priv: bytes, msg: bytes) -> bytes:
+    """RFC 8032 §5.1.6 deterministic signature; returns 64 bytes R || S."""
+    h = hashlib.sha512(priv).digest()
+    a = _clamp(h[:32])
+    prefix = h[32:]
+    pub = compress(scalar_mult_int(a, (BX, BY)))
+    r = int.from_bytes(hashlib.sha512(prefix + msg).digest(), "little") % L
+    r_enc = compress(scalar_mult_int(r, (BX, BY)))
+    k = int.from_bytes(
+        hashlib.sha512(r_enc + pub + msg).digest(), "little"
+    ) % L
+    s = (r + k * a) % L
+    return r_enc + s.to_bytes(32, "little")
+
+
+def verify_int(pub: bytes, msg: bytes, sig: bytes) -> bool:
+    """Pure-Python Ed25519 verify — CPU reference / baseline engine path."""
+    if len(sig) != 64:
+        return False
+    a_pt = decompress(pub)
+    r_pt = decompress(sig[:32])
+    if a_pt is None or r_pt is None:
+        return False
+    s = int.from_bytes(sig[32:], "little")
+    if s >= L:
+        return False
+    k = int.from_bytes(hashlib.sha512(sig[:32] + pub + msg).digest(), "little") % L
+    lhs = scalar_mult_int(s, (BX, BY))
+    rhs = _edwards_add_int(r_pt, scalar_mult_int(k, a_pt))
+    return lhs == rhs
+
+
+# ---------------------------------------------------------------------------
+# host <-> kernel marshalling (scheme API used by the verify engines)
+# ---------------------------------------------------------------------------
+
+def sign_raw(priv: bytes, msg: bytes) -> bytes:
+    return sign(priv, msg)
+
+
+def make_item(msg: bytes, sig: bytes, pub: bytes):
+    return (msg, sig, pub)
+
+
+def verify_item(item) -> bool:
+    msg, sig, pub = item
+    return verify_int(pub, msg, sig)
+
+
+def verify_inputs(items) -> tuple[np.ndarray, ...]:
+    """[(msg, sig64, pub32), ...] -> stacked (B, 16)x6 + (B,) kernel inputs."""
+    n = len(items)
+    s = np.zeros((n, NLIMBS), np.uint32)
+    h = np.zeros((n, NLIMBS), np.uint32)
+    rx = np.zeros((n, NLIMBS), np.uint32)
+    ry = np.zeros((n, NLIMBS), np.uint32)
+    ry[:, 0] = 1  # identity placeholder for invalid lanes
+    ax = np.zeros((n, NLIMBS), np.uint32)
+    ay = np.zeros((n, NLIMBS), np.uint32)
+    ay[:, 0] = 1
+    ok = np.zeros((n,), np.uint32)
+    for i, (msg, sig, pub) in enumerate(items):
+        if len(sig) != 64:
+            continue
+        r_pt = decompress(sig[:32])
+        a_pt = decompress(pub)
+        if r_pt is None or a_pt is None:
+            continue
+        s[i] = bn.to_limbs(int.from_bytes(sig[32:], "little") % (1 << 256), NLIMBS)
+        k = int.from_bytes(
+            hashlib.sha512(sig[:32] + pub + msg).digest(), "little"
+        ) % L
+        h[i] = bn.to_limbs(k, NLIMBS)
+        rx[i], ry[i] = bn.to_limbs(r_pt[0], NLIMBS), bn.to_limbs(r_pt[1], NLIMBS)
+        ax[i], ay[i] = bn.to_limbs(a_pt[0], NLIMBS), bn.to_limbs(a_pt[1], NLIMBS)
+        ok[i] = 1
+    return s, h, rx, ry, ax, ay, ok
+
+
+verify_kernel = eddsa_verify_kernel

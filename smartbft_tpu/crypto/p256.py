@@ -28,10 +28,10 @@ import secrets
 import numpy as np
 
 import jax.numpy as jnp
-from jax import lax
+
 
 from . import bignum as bn
-from .bignum import DTYPE, MontCtx
+from .bignum import MontCtx
 
 # --- curve constants (FIPS 186-4, D.1.2.3) ---------------------------------
 
@@ -130,24 +130,7 @@ def shamir_double_scalar(u1_bits, u2_bits, q):
     inf = jnp.broadcast_to(jnp.asarray(_INF_MONT), q.shape)
     gq = point_add(g, q)
     table = jnp.stack([inf, g, q, gq], axis=-3)  # (..., 4, 3, n)
-
-    xs = (
-        jnp.moveaxis(u1_bits, -1, 0),  # (256, ...)
-        jnp.moveaxis(u2_bits, -1, 0),
-    )
-
-    def step(acc, bits):
-        b1, b2 = bits
-        acc = point_add(acc, acc)
-        # table order [inf, G, Q, G+Q]: G iff b1, Q iff b2 -> idx = b1 + 2*b2
-        idx = (b1 + 2 * b2).astype(DTYPE)
-        sel = jnp.take_along_axis(
-            table, idx[..., None, None, None].astype(jnp.int32), axis=-3
-        )[..., 0, :, :]
-        return point_add(acc, sel), None
-
-    acc, _ = lax.scan(step, inf, xs)
-    return acc
+    return bn.shamir_scan(point_add, table, inf, u1_bits, u2_bits)
 
 
 def ecdsa_verify_kernel(e, r, s, qx, qy):
@@ -312,3 +295,28 @@ def verify_inputs(items) -> tuple[np.ndarray, ...]:
     qx = bn.batch_to_limbs([q[0] for _, _, _, q in items], NLIMBS)
     qy = bn.batch_to_limbs([q[1] for _, _, _, q in items], NLIMBS)
     return e, r, s, qx, qy
+
+
+# ---------------------------------------------------------------------------
+# scheme API (uniform surface the verify engines/providers program against)
+# ---------------------------------------------------------------------------
+
+def sign_raw(priv: int, msg: bytes) -> bytes:
+    """Sign and encode as fixed 64-byte big-endian r || s."""
+    r, s = sign(priv, msg)
+    return r.to_bytes(32, "big") + s.to_bytes(32, "big")
+
+
+def make_item(msg: bytes, sig: bytes, pub):
+    if len(sig) != 64:
+        raise ValueError("bad signature length")
+    return (msg, int.from_bytes(sig[:32], "big"),
+            int.from_bytes(sig[32:], "big"), pub)
+
+
+def verify_item(item) -> bool:
+    msg, r, s, pub = item
+    return verify_int(pub, msg, r, s)
+
+
+verify_kernel = ecdsa_verify_kernel

@@ -5,13 +5,15 @@ The reference treats Signer/Verifier as opaque app plugins
 signature on its own goroutine (/root/reference/internal/bft/view.go:537-541).
 Here the crypto seam is a first-class component:
 
-* :class:`Keyring` — node-id -> P-256 public key registry + own private key.
-* :class:`P256CryptoProvider` — implements the crypto subset of the
-  Verifier/Signer SPI.  Signing is host-side (one signature per decision;
-  never hot).  Verification goes through a pluggable engine:
+* :class:`Keyring` — node-id -> public key registry + own private key
+  (key types are scheme-opaque).
+* :class:`CryptoProvider` — implements the crypto subset of the
+  Verifier/Signer SPI for a pluggable signature scheme (P-256, Ed25519).
+  Signing is host-side (one signature per decision; never hot).
+  Verification goes through a pluggable engine:
     - :class:`HostVerifyEngine`  — pure-Python ints; the CPU baseline.
     - :class:`JaxVerifyEngine`   — pads votes into fixed-size lanes and runs
-      ONE jitted P-256 kernel launch per flush; an asyncio micro-batcher
+      ONE jitted verify-kernel launch per flush; an asyncio micro-batcher
       coalesces concurrent quorum checks (across sequences and view-change
       validations) into shared launches, which is where the cross-request
       x cross-replica batching of BASELINE.md configs[2] comes from.
@@ -34,7 +36,7 @@ import numpy as np
 from ..codec import decode, encode, wiremsg
 from ..messages import Proposal, Signature
 from ..types import proposal_digest
-from . import p256
+from . import ed25519, p256
 
 
 @wiremsg
@@ -45,29 +47,25 @@ class ConsenterSigMsg:
     aux: bytes = b""
 
 
-def _sig_encode(r: int, s: int) -> bytes:
-    return r.to_bytes(32, "big") + s.to_bytes(32, "big")
-
-
-def _sig_decode(raw: bytes) -> tuple[int, int]:
-    if len(raw) != 64:
-        raise ValueError("bad signature length")
-    return int.from_bytes(raw[:32], "big"), int.from_bytes(raw[32:], "big")
-
-
 class Keyring:
-    """Public keys of all replicas + this replica's private key."""
+    """Public keys of all replicas + this replica's private key.
 
-    def __init__(self, self_id: int, private_key: int,
-                 public_keys: dict[int, tuple[int, int]]):
+    Key types are scheme-opaque: P-256 uses (int, (qx, qy)); Ed25519 uses
+    (bytes, bytes).  The keyring never interprets them — only the scheme
+    module does.
+    """
+
+    def __init__(self, self_id: int, private_key,
+                 public_keys: dict[int, object]):
         self.self_id = self_id
         self.private_key = private_key
         self.public_keys = dict(public_keys)
 
     @classmethod
-    def generate(cls, node_ids: Sequence[int], seed: bytes = b"smartbft"):
+    def generate(cls, node_ids: Sequence[int], seed: bytes = b"smartbft",
+                 scheme=p256):
         """Deterministic keyring set for tests/benches: one per node id."""
-        keys = {nid: p256.keygen(seed + b"-%d" % nid) for nid in node_ids}
+        keys = {nid: scheme.keygen(seed + b"-%d" % nid) for nid in node_ids}
         return {
             nid: cls(nid, keys[nid][0], {n: k[1] for n, k in keys.items()})
             for nid in node_ids
@@ -104,13 +102,14 @@ class HostVerifyEngine:
     # sequential engine: coalescing gains nothing, don't add window latency
     preferred_coalesce_window = 0.0
 
-    def __init__(self) -> None:
+    def __init__(self, scheme=p256) -> None:
+        self.scheme = scheme
         self.stats = VerifyStats()
         self._lock = threading.Lock()
 
     def verify(self, items) -> list[bool]:
         t0 = time.perf_counter()
-        out = [p256.verify_int(pub, msg, r, s) for (msg, r, s, pub) in items]
+        out = [self.scheme.verify_item(item) for item in items]
         dt = time.perf_counter() - t0
         with self._lock:
             self.stats.launches += 1
@@ -121,7 +120,7 @@ class HostVerifyEngine:
 
 
 class JaxVerifyEngine:
-    """Padded, jit-cached, batched P-256 verification on the JAX device.
+    """Padded, jit-cached, batched signature verification on the JAX device.
 
     Lane sizes are fixed (powers of two) so at most ``len(pad_sizes)``
     kernels ever compile; every call pads up to the next size.  Thread-safe;
@@ -130,12 +129,14 @@ class JaxVerifyEngine:
 
     preferred_coalesce_window = 0.002  # batched engine: wait for fan-in
 
-    def __init__(self, pad_sizes: Sequence[int] = (8, 32, 128, 512, 2048)):
+    def __init__(self, pad_sizes: Sequence[int] = (8, 32, 128, 512, 2048),
+                 scheme=p256):
         import jax  # deferred: engine construction may precede platform pin
 
         self._jax = jax
+        self.scheme = scheme
         self.pad_sizes = tuple(sorted(pad_sizes))
-        self._kernel = jax.jit(p256.ecdsa_verify_kernel)
+        self._kernel = jax.jit(scheme.verify_kernel)
         self._lock = threading.Lock()
         self.stats = VerifyStats()
 
@@ -146,7 +147,7 @@ class JaxVerifyEngine:
         return self.pad_sizes[-1]
 
     def verify(self, items) -> list[bool]:
-        """items: [(msg_bytes, r, s, (qx, qy)), ...] -> validity per item."""
+        """items: scheme.make_item tuples -> validity per item."""
         if not items:
             return []
         out: list[bool] = []
@@ -159,13 +160,13 @@ class JaxVerifyEngine:
     def _verify_chunk(self, items) -> list[bool]:
         n = len(items)
         size = self._pad_to(n)
-        e, r, s, qx, qy = p256.verify_inputs(items)
+        arrays = self.scheme.verify_inputs(items)
 
         def pad(a):
             return np.concatenate([a, np.zeros((size - n,) + a.shape[1:], a.dtype)])
 
         t0 = time.perf_counter()
-        mask = np.asarray(self._kernel(pad(e), pad(r), pad(s), pad(qx), pad(qy)))
+        mask = np.asarray(self._kernel(*(pad(a) for a in arrays)))
         dt = time.perf_counter() - t0
         with self._lock:
             self.stats.launches += 1
@@ -243,18 +244,27 @@ class AsyncBatchCoalescer:
 # SPI provider
 # ---------------------------------------------------------------------------
 
-class P256CryptoProvider:
+class CryptoProvider:
     """Crypto subset of the Signer/Verifier SPI over a :class:`Keyring`.
 
     The application's Verifier implementation delegates
     sign/verify-signature duties here and keeps request/proposal semantics
-    (payload checks, request extraction) to itself.
+    (payload checks, request extraction) to itself.  ``scheme`` selects the
+    signature system (:mod:`p256` default; :mod:`ed25519` — BASELINE.md
+    configs[3] — via :class:`Ed25519CryptoProvider`); the engine must be
+    built for the same scheme.
     """
+
+    scheme = p256
 
     def __init__(self, keyring: Keyring, engine=None,
                  coalesce_window: Optional[float] = None):
         self.keyring = keyring
-        self.engine = engine if engine is not None else HostVerifyEngine()
+        self.engine = (engine if engine is not None
+                       else HostVerifyEngine(scheme=self.scheme))
+        eng_scheme = getattr(self.engine, "scheme", self.scheme)
+        if eng_scheme is not self.scheme:
+            raise ValueError("engine scheme does not match provider scheme")
         if coalesce_window is None:
             coalesce_window = getattr(
                 self.engine, "preferred_coalesce_window", 0.002
@@ -264,7 +274,7 @@ class P256CryptoProvider:
     # -- Signer -------------------------------------------------------------
 
     def sign(self, data: bytes) -> bytes:
-        return _sig_encode(*p256.sign(self.keyring.private_key, data))
+        return self.scheme.sign_raw(self.keyring.private_key, data)
 
     def sign_proposal(self, proposal: Proposal, auxiliary_input: bytes) -> Signature:
         msg = encode(ConsenterSigMsg(
@@ -278,8 +288,7 @@ class P256CryptoProvider:
         pub = self.keyring.public_keys.get(signature.signer)
         if pub is None:
             raise ValueError(f"unknown signer {signature.signer}")
-        r, s = _sig_decode(signature.value)
-        return (signature.msg, r, s, pub)
+        return self.scheme.make_item(signature.msg, signature.value, pub)
 
     def _check_binding(self, signature: Signature, proposal: Proposal) -> bytes:
         """Digest binding check; returns aux.  Raises on mismatch."""
@@ -350,3 +359,15 @@ class P256CryptoProvider:
             return decode(ConsenterSigMsg, msg).aux
         except Exception:
             return b""
+
+
+class P256CryptoProvider(CryptoProvider):
+    """ECDSA P-256 provider (the default scheme)."""
+
+    scheme = p256
+
+
+class Ed25519CryptoProvider(CryptoProvider):
+    """Ed25519 provider — the alt-curve variant of BASELINE.md configs[3]."""
+
+    scheme = ed25519

@@ -1,0 +1,200 @@
+"""Ed25519: RFC 8032 vectors, host/kernel parity, provider SPI, e2e consensus.
+
+The alt-curve Signer/Verifier variant of BASELINE.md configs[3].  The
+reference treats crypto as an app plugin (/root/reference/pkg/api/
+dependencies.go:47-71); here the Ed25519 scheme is a drop-in for P-256
+behind the same provider/engine seam, so the whole consensus stack runs
+unchanged on either curve.
+"""
+
+import binascii
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from smartbft_tpu.crypto import ed25519 as ed
+from smartbft_tpu.crypto.provider import (
+    Ed25519CryptoProvider,
+    HostVerifyEngine,
+    JaxVerifyEngine,
+    Keyring,
+)
+from smartbft_tpu.messages import Proposal, Signature
+
+
+# --- RFC 8032 §7.1 test vectors --------------------------------------------
+
+RFC_VECTORS = [
+    # (secret, public, message, signature)
+    (
+        "9d61b19deffd5a60ba844af492ec2cc44449c5697b326919703bac031cae7f60",
+        "d75a980182b10ab7d54bfed3c964073a0ee172f3daa62325af021a68f707511a",
+        "",
+        "e5564300c360ac729086e2cc806e828a84877f1eb8e5d974d873e06522490155"
+        "5fb8821590a33bacc61e39701cf9b46bd25bf5f0595bbe24655141438e7a100b",
+    ),
+    (
+        "4ccd089b28ff96da9db6c346ec114e0f5b8a319f35aba624da8cf6ed4fb8a6fb",
+        "3d4017c3e843895a92b70aa74d1b7ebc9c982ccf2ec4968cc0cd55f12af4660c",
+        "72",
+        "92a009a9f0d4cab8720e820b5f642540a2b27b5416503f8fb3762223ebdb69da"
+        "085ac1e43e15996e458f3613d0f11d8c387b2eaeb4302aeeb00d291612bb0c00",
+    ),
+    (
+        "c5aa8df43f9f837bedb7442f31dcb7b166d38535076f094b85ce3a2e0b4458f7",
+        "fc51cd8e6218a1a38da47ed00230f0580816ed13ba3303ac5deb911548908025",
+        "af82",
+        "6291d657deec24024827e69c3abe01a30ce548a284743a445e3680d7db5ac3ac"
+        "18ff9b538d16f290ae67f760984dc6594a7c15e9716ed28dc027beceea1ec40a",
+    ),
+]
+
+
+@pytest.mark.parametrize("sk,pk,msg,sig", RFC_VECTORS)
+def test_rfc8032_vectors(sk, pk, msg, sig):
+    sk, pk, msg, sig = (binascii.unhexlify(x) for x in (sk, pk, msg, sig))
+    import hashlib
+
+    a = ed._clamp(hashlib.sha512(sk).digest()[:32])
+    assert ed.compress(ed.scalar_mult_int(a, (ed.BX, ed.BY))) == pk
+    assert ed.sign(sk, msg) == sig
+    assert ed.verify_int(pk, msg, sig)
+
+
+def test_host_sign_verify_roundtrip():
+    priv, pub = ed.keygen(b"seed")
+    sig = ed.sign(priv, b"payload")
+    assert ed.verify_int(pub, b"payload", sig)
+    assert not ed.verify_int(pub, b"payload2", sig)
+    bad = sig[:32] + ((int.from_bytes(sig[32:], "little") + 1) % ed.L
+                      ).to_bytes(32, "little")
+    assert not ed.verify_int(pub, b"payload", bad)
+
+
+def test_decompress_rejects_invalid():
+    assert ed.decompress(b"\xff" * 32) is None  # y >= p
+    # x = 0 with sign bit set is invalid
+    enc = (1 << 255 | 1).to_bytes(32, "little")
+    assert ed.decompress(enc) is None or ed.decompress(enc)[0] & 1 == 1
+    # roundtrip
+    _, pub = ed.keygen(b"rt")
+    pt = ed.decompress(pub)
+    assert ed.compress(pt) == pub
+
+
+def test_point_add_matches_host():
+    FP = ed.FP
+    _, pub = ed.keygen(b"k")
+    q = ed.decompress(pub)
+    B = jnp.asarray(ed._B_MONT)[None]
+    qm = jnp.asarray(np.stack([
+        FP.encode(q[0]), FP.encode(q[1]), FP.one_mont,
+        FP.encode(q[0] * q[1] % ed.P),
+    ]))[None]
+
+    def decode_affine(pt):
+        x, y, z = [np.asarray(pt[0, i]) for i in (0, 1, 2)]
+        zi = pow(FP.decode(z), -1, ed.P)
+        return FP.decode(x) * zi % ed.P, FP.decode(y) * zi % ed.P
+
+    add = jax.jit(ed.point_add)
+    assert decode_affine(add(B, B)) == ed._edwards_add_int(
+        (ed.BX, ed.BY), (ed.BX, ed.BY)
+    )
+    assert decode_affine(add(B, qm)) == ed._edwards_add_int((ed.BX, ed.BY), q)
+    ident = jnp.asarray(ed._ID_MONT)[None]
+    assert decode_affine(add(B, ident)) == (ed.BX, ed.BY)
+    assert decode_affine(add(ident, ident)) == (0, 1)
+
+
+@pytest.fixture(scope="module")
+def verify_jit():
+    return jax.jit(ed.verify_kernel)
+
+
+def test_verify_kernel_batch(verify_jit):
+    items, truth = [], []
+    for i in range(4):
+        priv, pub = ed.keygen(bytes([i]))
+        msg = b"msg-%d" % i
+        sig = ed.sign(priv, msg)
+        if i == 1:  # corrupt S
+            sig = sig[:32] + ((int.from_bytes(sig[32:], "little") + 1) % ed.L
+                              ).to_bytes(32, "little")
+            truth.append(False)
+        elif i == 2:  # wrong message
+            msg += b"x"
+            truth.append(False)
+        else:
+            truth.append(True)
+        items.append((msg, sig, pub))
+    # undecodable lanes: bad pubkey, bad R encoding, S >= L
+    priv, pub = ed.keygen(b"extra")
+    good = ed.sign(priv, b"m")
+    items.append((b"m", good, b"\xff" * 32))
+    truth.append(False)
+    items.append((b"m", b"\xff" * 32 + good[32:], pub))
+    truth.append(False)
+    big_s = good[:32] + (ed.L + 5).to_bytes(32, "little")
+    items.append((b"m", big_s, pub))
+    truth.append(False)
+
+    args = [jnp.asarray(a) for a in ed.verify_inputs(items)]
+    mask = np.asarray(verify_jit(*args))
+    assert [bool(v) for v in mask] == truth
+    # host parity
+    assert [ed.verify_item(it) for it in items] == truth
+
+
+def test_verify_kernel_multidim(verify_jit):
+    """(S, V) shaped batches — the quorum-block layout — also work."""
+    items = []
+    keys = [ed.keygen(b"q%d" % v) for v in range(3)]
+    for s in range(2):
+        msg = b"prop-%d" % s
+        for priv, pub in keys:
+            items.append((msg, ed.sign(priv, msg), pub))
+    arrays = ed.verify_inputs(items)
+    shaped = [a.reshape((2, 3) + a.shape[1:]) for a in arrays]
+    mask = np.asarray(verify_jit(*[jnp.asarray(a) for a in shaped]))
+    assert mask.shape == (2, 3) and mask.all()
+
+
+# --- provider SPI + engines --------------------------------------------------
+
+@pytest.fixture(scope="module")
+def keyrings():
+    return Keyring.generate([1, 2, 3, 4], seed=b"ed-t", scheme=ed)
+
+
+def test_provider_roundtrip(keyrings):
+    prov1 = Ed25519CryptoProvider(keyrings[1])
+    prov2 = Ed25519CryptoProvider(keyrings[2])
+    prop = Proposal(payload=b"data", metadata=b"md")
+    sig = prov1.sign_proposal(prop, b"aux-bytes")
+    assert sig.signer == 1
+    assert prov2.verify_consenter_sig(sig, prop) == b"aux-bytes"
+    with pytest.raises(ValueError):
+        prov2.verify_consenter_sig(sig, Proposal(payload=b"other"))
+
+
+def test_provider_scheme_mismatch_rejected(keyrings):
+    with pytest.raises(ValueError):
+        Ed25519CryptoProvider(keyrings[1], engine=HostVerifyEngine())  # p256
+
+
+def test_jax_engine_batch(keyrings):
+    eng = JaxVerifyEngine(pad_sizes=(8,), scheme=ed)
+    provs = {i: Ed25519CryptoProvider(keyrings[i], engine=eng)
+             for i in (1, 2, 3, 4)}
+    prop = Proposal(payload=b"x")
+    sigs = [provs[i].sign_proposal(prop, b"a%d" % i) for i in (1, 2, 3, 4)]
+    sigs[2] = Signature(signer=3, value=b"\x00" * 64, msg=sigs[2].msg)
+    auxes = provs[1].verify_consenter_sigs_batch(sigs, prop)
+    assert auxes[0] == b"a1" and auxes[1] == b"a2" and auxes[3] == b"a4"
+    assert auxes[2] is None
+    # forged sig decodes (zero lanes) so all 4 items reach the one launch
+    assert eng.stats.launches == 1 and eng.stats.sigs_verified == 4
