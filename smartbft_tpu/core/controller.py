@@ -323,6 +323,8 @@ class Controller(RequestTimeoutHandler):
         self, new_view_number: int, new_proposal_sequence: int, new_decisions_in_view: int
     ) -> None:
         """controller.go:428-454."""
+        if self._stopped:
+            return
         latest_view = self.curr_view_number
         if latest_view > new_view_number:
             return
@@ -670,6 +672,11 @@ class Controller(RequestTimeoutHandler):
         """controller.go:829-861."""
         self.close()
         self.batcher.close()
+        # release a run-loop blocked in collect_state_responses: its timeout
+        # lives on the logical scheduler, which may no longer be advancing by
+        # the time stop() is called (the reference's collector timeout is
+        # wall-clock and always fires, statecollector.go:100-106)
+        self.collector.stop()
         if pool_pause:
             self.request_pool.stop_timers()
         else:

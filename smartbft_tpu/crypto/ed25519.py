@@ -25,6 +25,7 @@ points against the curve equation so a bad decompression can never validate.
 
 from __future__ import annotations
 
+import functools
 import hashlib
 import secrets
 
@@ -229,12 +230,18 @@ def keygen(seed: bytes | None = None):
     return priv, compress(scalar_mult_int(a, (BX, BY)))
 
 
-def sign(priv: bytes, msg: bytes) -> bytes:
-    """RFC 8032 §5.1.6 deterministic signature; returns 64 bytes R || S."""
+@functools.lru_cache(maxsize=256)
+def _expand_key(priv: bytes) -> tuple[int, bytes, bytes]:
+    """(clamped scalar, prefix, public key) — fixed per private key, so cache
+    it instead of re-deriving A with a full scalar mult on every sign()."""
     h = hashlib.sha512(priv).digest()
     a = _clamp(h[:32])
-    prefix = h[32:]
-    pub = compress(scalar_mult_int(a, (BX, BY)))
+    return a, h[32:], compress(scalar_mult_int(a, (BX, BY)))
+
+
+def sign(priv: bytes, msg: bytes) -> bytes:
+    """RFC 8032 §5.1.6 deterministic signature; returns 64 bytes R || S."""
+    a, prefix, pub = _expand_key(priv)
     r = int.from_bytes(hashlib.sha512(prefix + msg).digest(), "little") % L
     r_enc = compress(scalar_mult_int(r, (BX, BY)))
     k = int.from_bytes(
@@ -278,6 +285,14 @@ def verify_item(item) -> bool:
     return verify_int(pub, msg, sig)
 
 
+@functools.lru_cache(maxsize=1024)
+def _decompress_pub(pub: bytes):
+    """Signer pubkeys come from the small static membership set; memoize the
+    sqrt-heavy decompression so the batched hot path pays it once per key.
+    R decompression stays uncached — unique per signature."""
+    return decompress(pub)
+
+
 def verify_inputs(items) -> tuple[np.ndarray, ...]:
     """[(msg, sig64, pub32), ...] -> stacked (B, 16)x6 + (B,) kernel inputs."""
     n = len(items)
@@ -294,7 +309,7 @@ def verify_inputs(items) -> tuple[np.ndarray, ...]:
         if len(sig) != 64:
             continue
         r_pt = decompress(sig[:32])
-        a_pt = decompress(pub)
+        a_pt = _decompress_pub(pub)
         if r_pt is None or a_pt is None:
             continue
         s[i] = bn.to_limbs(int.from_bytes(sig[32:], "little") % (1 << 256), NLIMBS)
