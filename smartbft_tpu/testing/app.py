@@ -164,7 +164,29 @@ class App(Application, Assembler, Comm, Signer, Verifier, RequestInspector,
     def deliver(self, proposal: Proposal, signatures) -> Reconfig:
         decision = Decision(proposal=proposal, signatures=tuple(signatures))
         self.shared.append(self.id, decision)
-        return Reconfig(in_latest_decision=False)
+        return self._reconfig_in(proposal)
+
+    def _reconfig_in(self, proposal: Proposal) -> Reconfig:
+        """Scan a committed batch for a reconfiguration transaction
+        (test/reconfig.go; the last one in the batch wins)."""
+        from .reconfig import detect_reconfig
+
+        found = Reconfig(in_latest_decision=False)
+        if not proposal.payload:
+            return found
+        try:
+            batch = decode(BatchPayload, proposal.payload)
+        except Exception:
+            return found
+        for raw in batch.requests:
+            try:
+                req = decode(TestRequest, raw)
+            except Exception:
+                continue
+            reconfig = detect_reconfig(req.payload)
+            if reconfig is not None:
+                found = reconfig
+        return found
 
     # -- Assembler ---------------------------------------------------------
 
@@ -261,7 +283,12 @@ class App(Application, Assembler, Comm, Signer, Verifier, RequestInspector,
             self.deliver(decision.proposal, list(decision.signatures))
         mine = self.shared.get(self.id)
         latest = mine[-1] if mine else Decision(proposal=Proposal())
-        return SyncResponse(latest=latest, reconfig=Reconfig(in_latest_decision=False))
+        # a reconfig in the latest synced decision must surface so the facade
+        # rebuilds with the new membership (consensus.go:86-100)
+        reconfig = (
+            self._reconfig_in(latest.proposal) if mine else Reconfig(in_latest_decision=False)
+        )
+        return SyncResponse(latest=latest, reconfig=reconfig)
 
     # ------------------------------------------------------------------ lifecycle
 
@@ -328,6 +355,14 @@ class App(Application, Assembler, Comm, Signer, Verifier, RequestInspector,
     async def submit(self, client_id: str, request_id: str, payload: bytes = b"") -> None:
         req = encode(TestRequest(client_id=client_id, request_id=request_id, payload=payload))
         await self.consensus.submit_request(req)
+
+    async def submit_reconfig(
+        self, request_id: str, nodes: list[int], config=None
+    ) -> None:
+        """Order a reconfiguration transaction (test/reconfig.go pattern)."""
+        from .reconfig import reconfig_request_payload
+
+        await self.submit("reconfig", request_id, reconfig_request_payload(nodes, config))
 
     # -- fault injection convenience --------------------------------------
 

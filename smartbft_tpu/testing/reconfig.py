@@ -1,0 +1,125 @@
+"""Reconfiguration payloads for the test harness.
+
+Re-design of /root/reference/test/reconfig.go: the reference mirrors the
+whole Configuration struct in int64 fields so a reconfiguration can ride
+inside an ordered request payload.  Here the canonical codec carries ints /
+bools natively, so only the float-second durations need mirroring — they
+travel as integer milliseconds.
+
+A reconfig transaction is an ordinary TestRequest whose payload starts with
+:data:`RECONFIG_MAGIC`; ``App.deliver`` detects it in a committed batch and
+returns a ``Reconfig`` to the consensus facade, which tears down and rebuilds
+every component with the new node set / configuration
+(/root/reference/pkg/consensus/consensus.go:186-253).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from ..codec import decode, encode, wiremsg
+from ..config import Configuration
+from ..types import Reconfig
+
+RECONFIG_MAGIC = b"smartbft-reconfig\x00"
+
+_MS_FIELDS = (
+    "request_batch_max_interval",
+    "request_forward_timeout",
+    "request_complain_timeout",
+    "request_auto_remove_timeout",
+    "view_change_resend_interval",
+    "view_change_timeout",
+    "leader_heartbeat_timeout",
+    "collect_timeout",
+    "request_pool_submit_timeout",
+)
+
+_INT_FIELDS = (
+    "request_batch_max_count",
+    "request_batch_max_bytes",
+    "incoming_message_buffer_size",
+    "request_pool_size",
+    "leader_heartbeat_count",
+    "num_of_ticks_behind_before_syncing",
+    "decisions_per_leader",
+    "request_max_bytes",
+)
+
+_BOOL_FIELDS = (
+    "sync_on_start",
+    "speed_up_view_change",
+    "leader_rotation",
+)
+
+
+@wiremsg
+class ConfigMirror:
+    """Configuration with durations as integer milliseconds (test/reconfig.go)."""
+
+    request_batch_max_count: int = 0
+    request_batch_max_bytes: int = 0
+    incoming_message_buffer_size: int = 0
+    request_pool_size: int = 0
+    leader_heartbeat_count: int = 0
+    num_of_ticks_behind_before_syncing: int = 0
+    decisions_per_leader: int = 0
+    request_max_bytes: int = 0
+    request_batch_max_interval_ms: int = 0
+    request_forward_timeout_ms: int = 0
+    request_complain_timeout_ms: int = 0
+    request_auto_remove_timeout_ms: int = 0
+    view_change_resend_interval_ms: int = 0
+    view_change_timeout_ms: int = 0
+    leader_heartbeat_timeout_ms: int = 0
+    collect_timeout_ms: int = 0
+    request_pool_submit_timeout_ms: int = 0
+    sync_on_start: bool = False
+    speed_up_view_change: bool = False
+    leader_rotation: bool = False
+
+
+@wiremsg
+class ReconfigPayload:
+    nodes: list[int] = None  # type: ignore[assignment]
+    config: Optional[ConfigMirror] = None
+
+    def __post_init__(self):
+        if self.nodes is None:
+            object.__setattr__(self, "nodes", [])
+
+
+def mirror_config(config: Configuration) -> ConfigMirror:
+    kwargs = {f: getattr(config, f) for f in _INT_FIELDS}
+    kwargs.update({f: getattr(config, f) for f in _BOOL_FIELDS})
+    kwargs.update({f + "_ms": round(getattr(config, f) * 1000) for f in _MS_FIELDS})
+    return ConfigMirror(**kwargs)
+
+
+def unmirror_config(m: ConfigMirror) -> Configuration:
+    kwargs = {f: getattr(m, f) for f in _INT_FIELDS}
+    kwargs.update({f: getattr(m, f) for f in _BOOL_FIELDS})
+    kwargs.update({f: getattr(m, f + "_ms") / 1000.0 for f in _MS_FIELDS})
+    return Configuration(**kwargs)
+
+
+def reconfig_request_payload(
+    nodes: list[int], config: Optional[Configuration] = None
+) -> bytes:
+    """Payload bytes for a TestRequest that carries a reconfiguration."""
+    mirror = mirror_config(config) if config is not None else None
+    return RECONFIG_MAGIC + encode(ReconfigPayload(nodes=list(nodes), config=mirror))
+
+
+def detect_reconfig(payload: bytes) -> Optional[Reconfig]:
+    """Parse a request payload; None when it is not a reconfig transaction."""
+    if not payload.startswith(RECONFIG_MAGIC):
+        return None
+    body = decode(ReconfigPayload, payload[len(RECONFIG_MAGIC):])
+    config = unmirror_config(body.config) if body.config is not None else None
+    return Reconfig(
+        in_latest_decision=True,
+        current_nodes=tuple(body.nodes),
+        current_config=config,
+    )

@@ -1,0 +1,188 @@
+"""Dynamic reconfiguration: add/remove nodes, config swap, VC after reconfig.
+
+Mirrors /root/reference/test/reconfig_test.go (7 scenarios driven by reconfig
+transactions ordered inside regular requests) using the harness's
+ReconfigPayload (smartbft_tpu/testing/reconfig.py).
+"""
+
+import asyncio
+import dataclasses
+
+from smartbft_tpu.testing.app import App, fast_config, wait_for
+from smartbft_tpu.testing.reconfig import (
+    detect_reconfig,
+    mirror_config,
+    reconfig_request_payload,
+    unmirror_config,
+)
+
+from tests.test_basic import make_nodes, start_all, stop_all
+from tests.test_viewchange import vc_config
+
+
+def test_config_mirror_roundtrip():
+    cfg = fast_config(3)
+    assert unmirror_config(mirror_config(cfg)).with_self_id(3) == cfg
+    payload = reconfig_request_payload([1, 2, 3, 4, 5], cfg)
+    reconfig = detect_reconfig(payload)
+    assert reconfig.in_latest_decision
+    assert reconfig.current_nodes == (1, 2, 3, 4, 5)
+    assert reconfig.current_config.request_batch_max_count == cfg.request_batch_max_count
+    assert detect_reconfig(b"not a reconfig") is None
+
+
+def test_add_node(tmp_path):
+    """reconfig_test.go:TestBasicReconfigWithAddedNode — grow 4 -> 5; the new
+    node syncs the existing chain and participates."""
+
+    async def run():
+        apps, scheduler, network, shared = make_nodes(4, tmp_path)
+        await start_all(apps)
+        await apps[0].submit("c", "r0")
+        await wait_for(lambda: all(a.height() >= 1 for a in apps), scheduler)
+
+        # create node 5 (joins the transport now, starts after the reconfig)
+        cfg5 = dataclasses.replace(fast_config(5), sync_on_start=True)
+        app5 = App(5, network, shared, scheduler,
+                   wal_dir=str(tmp_path / "wal-5"), config=cfg5)
+
+        await apps[0].submit_reconfig("rc-add", [1, 2, 3, 4, 5])
+        await wait_for(
+            lambda: all(a.consensus.num_nodes == 5 for a in apps),
+            scheduler, timeout=120.0,
+        )
+
+        await app5.start()
+        await wait_for(lambda: app5.height() >= 2, scheduler, timeout=240.0)
+
+        await apps[0].submit("c", "r1")
+        everyone = apps + [app5]
+        await wait_for(
+            lambda: all(a.height() >= 3 for a in everyone), scheduler, timeout=240.0
+        )
+        ref = [d.proposal for d in apps[0].ledger()]
+        assert [d.proposal for d in app5.ledger()] == ref
+        await stop_all(everyone)
+
+    asyncio.run(run())
+
+
+def test_remove_node(tmp_path):
+    """reconfig_test.go removal scenario — shrink 4 -> 3; the evicted node
+    shuts itself down and the rest keep ordering."""
+
+    async def run():
+        apps, scheduler, network, shared = make_nodes(4, tmp_path)
+        await start_all(apps)
+        await apps[0].submit("c", "r0")
+        await wait_for(lambda: all(a.height() >= 1 for a in apps), scheduler)
+
+        await apps[0].submit_reconfig("rc-rm", [1, 2, 3])
+        await wait_for(
+            lambda: all(a.consensus.num_nodes == 3 for a in apps[:3])
+            and not apps[3].consensus._running,
+            scheduler, timeout=240.0,
+        )
+
+        await apps[0].submit("c", "r1")
+        await wait_for(
+            lambda: all(a.height() >= 3 for a in apps[:3]), scheduler, timeout=240.0
+        )
+        assert apps[3].height() == 2  # evicted after delivering the reconfig
+        await stop_all(apps)
+
+    asyncio.run(run())
+
+
+def test_reconfig_swaps_configuration(tmp_path):
+    """A reconfig carrying a new Configuration replaces every node's config
+    atomically between epochs (consensus.go:210-218)."""
+
+    async def run():
+        apps, scheduler, network, shared = make_nodes(4, tmp_path)
+        await start_all(apps)
+        new_cfg = dataclasses.replace(
+            fast_config(1), request_batch_max_count=7, request_pool_size=123
+        )
+        await apps[0].submit_reconfig("rc-cfg", [1, 2, 3, 4], new_cfg)
+        await wait_for(
+            lambda: all(
+                a.consensus.config.request_batch_max_count == 7
+                and a.consensus.config.request_pool_size == 123
+                and a.consensus.config.self_id == a.id
+                for a in apps
+            ),
+            scheduler, timeout=240.0,
+        )
+        await apps[0].submit("c", "r1")
+        await wait_for(lambda: all(a.height() >= 2 for a in apps), scheduler, timeout=240.0)
+        await stop_all(apps)
+
+    asyncio.run(run())
+
+
+def test_view_change_after_reconfig(tmp_path):
+    """reconfig_test.go:TestViewChangeAfterReconfig — a leader failure after
+    a reconfiguration is handled by the rebuilt components."""
+
+    async def run():
+        apps, scheduler, network, shared = make_nodes(4, tmp_path, config_fn=vc_config)
+        await start_all(apps)
+        await apps[0].submit("c", "r0")
+        await wait_for(lambda: all(a.height() >= 1 for a in apps), scheduler)
+
+        await apps[0].submit_reconfig("rc", [1, 2, 3, 4], vc_config(1))
+        await wait_for(lambda: all(a.height() >= 2 for a in apps), scheduler, timeout=240.0)
+
+        apps[0].disconnect()
+        await wait_for(
+            lambda: all(a.consensus.get_leader_id() == 2 for a in apps[1:]),
+            scheduler, timeout=600.0,
+        )
+        await apps[1].submit("c", "r1")
+        await wait_for(
+            lambda: all(a.height() >= 3 for a in apps[1:]), scheduler, timeout=240.0
+        )
+        await stop_all(apps)
+
+    asyncio.run(run())
+
+
+def test_rotation_then_add_node(tmp_path):
+    """reconfig_test.go:TestAddNodeAfterManyRotations — leader rotation
+    through several decisions, then membership growth."""
+
+    async def run():
+        def rot(i):
+            return dataclasses.replace(
+                fast_config(i), leader_rotation=True, decisions_per_leader=1
+            )
+
+        apps, scheduler, network, shared = make_nodes(4, tmp_path, config_fn=rot)
+        await start_all(apps)
+        for k in range(5):
+            await apps[0].submit("c", f"r{k}")
+            await wait_for(
+                lambda k=k: all(a.height() >= k + 1 for a in apps),
+                scheduler, timeout=240.0,
+            )
+
+        cfg5 = dataclasses.replace(rot(5), sync_on_start=True)
+        app5 = App(5, network, shared, scheduler,
+                   wal_dir=str(tmp_path / "wal-5"), config=cfg5)
+        await apps[0].submit_reconfig("rc-add", [1, 2, 3, 4, 5], rot(1))
+        await wait_for(
+            lambda: all(a.consensus.num_nodes == 5 for a in apps),
+            scheduler, timeout=240.0,
+        )
+        await app5.start()
+        await wait_for(lambda: app5.height() >= 6, scheduler, timeout=240.0)
+
+        everyone = apps + [app5]
+        await apps[0].submit("c", "after")
+        await wait_for(
+            lambda: all(a.height() >= 7 for a in everyone), scheduler, timeout=240.0
+        )
+        await stop_all(everyone)
+
+    asyncio.run(run())
