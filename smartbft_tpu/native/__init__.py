@@ -17,7 +17,7 @@ from typing import Optional
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _LIB_NAME = "libsmartbft_native.so"
-_SOURCES = ["crc32c.cc"]
+_SOURCES = ["crc32c.cc", "wal_frame.cc"]
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -71,15 +71,24 @@ def load() -> Optional[ctypes.CDLL]:
         if _stale(lib_path) and not _build_lib(lib_path):
             return None
         try:
-            lib = ctypes.CDLL(lib_path)
+            lib = ctypes.CDLL(lib_path, use_errno=True)
             lib.smartbft_crc32c_update.restype = ctypes.c_uint32
             lib.smartbft_crc32c_update.argtypes = [
                 ctypes.c_uint32,
                 ctypes.c_char_p,
                 ctypes.c_size_t,
             ]
+            lib.smartbft_wal_append.restype = ctypes.c_long
+            lib.smartbft_wal_append.argtypes = [
+                ctypes.c_int,
+                ctypes.c_char_p,
+                ctypes.c_size_t,
+                ctypes.POINTER(ctypes.c_uint32),
+                ctypes.c_int,
+                ctypes.c_int,
+            ]
             _lib = lib
-        except OSError:
+        except (OSError, AttributeError):
             _lib = None
         return _lib
 
@@ -123,3 +132,28 @@ def crc32c_update(crc: int, data: bytes) -> int:
 
 def using_native() -> bool:
     return load() is not None
+
+
+# ---------------------------------------------------------------------------
+# WAL frame append
+# ---------------------------------------------------------------------------
+
+def wal_append(fd: int, payload: bytes, crc: int, update_crc: bool,
+               do_sync: bool = True) -> Optional[tuple[int, int]]:
+    """One-call frame append: pack + CRC + write + fdatasync.
+
+    Returns (frame_size, new_crc) or None when the native library is
+    unavailable (caller falls back to the Python path).  Raises OSError on
+    an I/O failure, mirroring what the Python path would raise.
+    """
+    lib = load()
+    if lib is None:
+        return None
+    crc_io = ctypes.c_uint32(crc)
+    n = lib.smartbft_wal_append(
+        fd, payload, len(payload), ctypes.byref(crc_io),
+        1 if update_crc else 0, 1 if do_sync else 0,
+    )
+    if n < 0:
+        raise OSError(ctypes.get_errno(), "wal: native append failed")
+    return int(n), int(crc_io.value)

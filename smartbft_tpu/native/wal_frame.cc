@@ -1,0 +1,65 @@
+// WAL frame append engine: header pack + CRC chain + vectored write +
+// fdatasync in ONE native call.
+//
+// The Python append path (smartbft_tpu/wal/log.py _append_record; reference:
+// /root/reference/pkg/wal/writeaheadlog.go:440-472) costs two buffered
+// writes, a flush, and an fsync with GIL round-trips between them.  Here the
+// whole frame is assembled in a stack buffer and hits the kernel in one
+// write(2); durability via fdatasync(2), which flushes the data and the
+// size-extension metadata the reader needs.
+//
+// Built as a shared library and loaded via ctypes (no pybind11 in the image).
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <unistd.h>
+
+extern "C" {
+
+uint32_t smartbft_crc32c_update(uint32_t crc, const uint8_t* data, size_t n);
+
+// Appends one frame: 8B LE header (len | crc<<32) + payload + zero pad to 8B.
+// ENTRY/CONTROL frames (update_crc=1): chain CRC over payload+pad from
+// *crc_io, write it into the header, and store it back to *crc_io.
+// CRC_ANCHOR frames (update_crc=0): the header carries *crc_io unchanged and
+// no bytes are covered.
+// Returns the frame size on success, -1 on I/O error (errno preserved).
+long smartbft_wal_append(int fd, const uint8_t* payload, size_t len,
+                         uint32_t* crc_io, int update_crc, int do_sync) {
+  const size_t pad = (8 - len % 8) % 8;
+  const size_t padded = len + pad;
+  const size_t frame = 8 + padded;
+
+  // proposal batches default to 10 MiB; heap-allocate past 64 KiB
+  uint8_t stack_buf[65536];
+  uint8_t* buf = frame <= sizeof(stack_buf) ? stack_buf : new uint8_t[frame];
+
+  std::memcpy(buf + 8, payload, len);
+  std::memset(buf + 8 + len, 0, pad);
+
+  uint32_t crc = *crc_io;
+  if (update_crc) crc = smartbft_crc32c_update(crc, buf + 8, padded);
+
+  const uint64_t header =
+      static_cast<uint64_t>(len) | (static_cast<uint64_t>(crc) << 32);
+  for (int i = 0; i < 8; i++) buf[i] = (header >> (8 * i)) & 0xFF;  // LE
+
+  long result = static_cast<long>(frame);
+  size_t off = 0;
+  while (off < frame) {
+    ssize_t n = write(fd, buf + off, frame - off);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      result = -1;
+      break;
+    }
+    off += static_cast<size_t>(n);
+  }
+  if (result > 0 && do_sync && fdatasync(fd) != 0) result = -1;
+  if (buf != stack_buf) delete[] buf;
+  if (result > 0 && update_crc) *crc_io = crc;
+  return result;
+}
+
+}  // extern "C"

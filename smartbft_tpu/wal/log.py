@@ -37,7 +37,7 @@ from typing import BinaryIO, Optional
 from ..api import Logger, WriteAheadLog
 from ..codec import decode, encode
 from ..metrics import Gauge, MetricOpts, Provider
-from ..native import crc32c_update
+from ..native import crc32c_update, wal_append as native_wal_append
 from ..utils.logging import StdLogger
 
 WAL_SUFFIX = ".wal"
@@ -314,13 +314,19 @@ class WriteAheadLogFile(WriteAheadLog):
             length = len(payload)
             if length > 0xFFFFFFFF:
                 raise WALError(f"wal: record too big: {length}")
-            padded = payload + _pad(length)
-            crc = crc32c_update(self._crc, padded)
-            self._f.write(_HDR.pack(length | (crc << 32)))
-            self._f.write(padded)
-            self._f.flush()
-            os.fsync(self._f.fileno())
-            self._crc = crc
+            # native fast path: pack + CRC + write + fdatasync in one call
+            # (write-mode files are unbuffered, so fd-level writes are safe)
+            res = native_wal_append(self._f.fileno(), payload, self._crc, True)
+            if res is not None:
+                _, self._crc = res
+            else:
+                padded = payload + _pad(length)
+                crc = crc32c_update(self._crc, padded)
+                self._f.write(_HDR.pack(length | (crc << 32)))
+                self._f.write(padded)
+                self._f.flush()
+                os.fsync(self._f.fileno())
+                self._crc = crc
             if rec.truncate_to:
                 self._truncate_index = self._index
             # switch if this or the next (>=16B) record could overflow
@@ -331,6 +337,8 @@ class WriteAheadLogFile(WriteAheadLog):
         """CRC_ANCHOR frame carrying the chain value (writeaheadlog.go:716-757)."""
         assert self._f is not None
         payload = encode(LogRecord(type=CRC_ANCHOR, truncate_to=False, data=b""))
+        if native_wal_append(self._f.fileno(), payload, self._crc, False) is not None:
+            return
         length = len(payload)
         padded = payload + _pad(length)
         self._f.write(_HDR.pack(length | (self._crc << 32)))
@@ -352,7 +360,9 @@ class WriteAheadLogFile(WriteAheadLog):
                     keep.append(idx)
             self._active_indexes = keep
         path = os.path.join(self._dir, _file_name(self._index))
-        self._f = open(path, "wb")
+        # unbuffered: appends go straight to the fd (native fast path writes
+        # at fd level; nothing may linger in a Python-side buffer)
+        self._f = open(path, "wb", buffering=0)
         self._write_anchor()
         self._active_indexes.append(self._index)
         self._metrics.count_of_files.set(len(self._active_indexes))

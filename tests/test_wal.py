@@ -39,6 +39,39 @@ def test_crc32c_native_matches_python():
     assert whole == part
 
 
+def test_native_append_produces_identical_files(tmp_path):
+    """The native one-call append engine and the pure-Python path must write
+    byte-identical WAL directories (incl. rotation and truncation frames)."""
+    import hashlib
+    import subprocess
+    import sys
+
+    script = (
+        "import sys\n"
+        "sys.path.insert(0, sys.argv[2])\n"
+        "from smartbft_tpu import wal as walmod\n"
+        "w = walmod.create(sys.argv[1], file_size_bytes=4096)\n"
+        "for i in range(200):\n"
+        "    w.append(b'entry-%03d' % i, truncate_to=(i == 150))\n"
+        "w.close()\n"
+    )
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    digests = []
+    for extra in ({}, {"SMARTBFT_NO_NATIVE": "1"}):
+        d = str(tmp_path / ("native" if not extra else "python"))
+        subprocess.run(
+            [sys.executable, "-c", script, d, repo],
+            check=True, env=dict(os.environ, **extra),
+        )
+        h = hashlib.sha256()
+        for name in sorted(os.listdir(d)):
+            h.update(name.encode())
+            with open(os.path.join(d, name), "rb") as f:
+                h.update(f.read())
+        digests.append(h.hexdigest())
+    assert digests[0] == digests[1]
+
+
 def test_create_append_reopen_readall(tmp_path):
     d = str(tmp_path / "wal")
     w = walmod.create(d)
