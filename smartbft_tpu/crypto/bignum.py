@@ -38,6 +38,14 @@ LIMB_BITS = 16
 LIMB_MASK = (1 << LIMB_BITS) - 1
 DTYPE = jnp.uint32
 
+# Carry-chain scan unrolling (lax.scan unroll=N).  The chains are short
+# (~25-50 steps) but appear inside every Montgomery op; for kernels whose
+# scan bodies contain many of them (the pairing), unrolling trades while-loop
+# count for straightline ops, which XLA often compiles much faster.
+import os as _os
+
+UNROLL = int(_os.environ.get("SMARTBFT_BN_UNROLL", "1") or "1")
+
 
 # ---------------------------------------------------------------------------
 # host <-> device conversion
@@ -90,7 +98,7 @@ def carry_propagate(cols, out_len: int):
         t = col + c
         return t >> LIMB_BITS, t & LIMB_MASK
 
-    _, limbs = lax.scan(step, jnp.zeros(x.shape[1:], DTYPE), x)
+    _, limbs = lax.scan(step, jnp.zeros(x.shape[1:], DTYPE), x, unroll=UNROLL)
     return jnp.moveaxis(limbs, 0, -1)
 
 
@@ -108,7 +116,7 @@ def sub_borrow(a, b):
         return jnp.uint32(1) - (t >> LIMB_BITS), t & LIMB_MASK
 
     borrow, limbs = lax.scan(
-        step, jnp.zeros(xa.shape[1:], DTYPE), (xa, xb)
+        step, jnp.zeros(xa.shape[1:], DTYPE), (xa, xb), unroll=UNROLL
     )
     return jnp.moveaxis(limbs, 0, -1), borrow
 
@@ -169,21 +177,32 @@ def shamir_scan(point_add, table, ident, bits1, bits2):
 # multiplication
 # ---------------------------------------------------------------------------
 
-def mul_full(a, b):
-    """Full product: (..., n) x (..., n) -> (..., 2n), normalized limbs.
+def mul_columns(a, b):
+    """Raw product columns: (..., n) x (..., n) -> (..., 2n) UNNORMALIZED.
 
-    Schoolbook via shift-accumulate: row i of partial products lands in
-    columns [i, i+n).  Each 32-bit product is split into 16-bit halves
-    before accumulation, so column sums never exceed ~2^21.
+    Schoolbook via shift-accumulate, WITHOUT the carry chain — zero
+    sequential ops.  Row i of partial products lands in columns [i, i+n);
+    each 32-bit product is split into 16-bit halves before accumulation, so
+    column sums stay < 2^22; callers may add up to ~2^7 such column arrays
+    together before normalizing (uint32 headroom), which is the basis of
+    the lazy-reduction tower arithmetic: linear combinations of products
+    cost vector adds only, and one carry chain + one Montgomery reduction
+    amortizes over the whole combination.
     """
     n = a.shape[-1]
     bshape = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
-    acc = jnp.zeros(bshape + (2 * n + 1,), DTYPE)
+    acc = jnp.zeros(bshape + (2 * n,), DTYPE)
     for i in range(n):
-        p = a[..., i : i + 1] * b  # (..., n) full 32-bit products
+        p = a[..., i : i + 1] * b
         acc = acc.at[..., i : i + n].add(p & LIMB_MASK)
         acc = acc.at[..., i + 1 : i + n + 1].add(p >> LIMB_BITS)
-    return carry_propagate(acc, 2 * n + 1)[..., : 2 * n]
+    return acc
+
+
+def mul_full(a, b):
+    """Full product: (..., n) x (..., n) -> (..., 2n), normalized limbs."""
+    n = a.shape[-1]
+    return carry_propagate(mul_columns(a, b), 2 * n + 1)[..., : 2 * n]
 
 
 def add_raw(a, b, out_len: int):
@@ -254,6 +273,32 @@ class MontCtx:
 
     def square(self, a):
         return self.mul(a, a)
+
+    def redc_cols(self, cols):
+        """Montgomery-reduce raw product columns: (..., 2n) -> (..., n) < N.
+
+        ``cols`` is a sum of k column arrays from :func:`mul_columns` over
+        operands < N, with k strictly less than R/N — the exact requirement
+        is k * N^2 < R * N, i.e. the summed value T < R*N.  (For BLS12-381
+        with R = 2^384, R/P is ~9.84, so k <= 9 is safe even though
+        floor(R/P) = 9.)
+        Output is (T + mN)/R mod N, strictly < N after one conditional
+        subtract.  Exactly 4 sequential chains regardless of how many
+        outputs are stacked in the leading axes — the whole point.
+        """
+        n = self.n
+        T = carry_propagate(cols, 2 * n + 1)
+        m = mul_columns(T[..., :n], jnp.asarray(self.Nprime))
+        m = carry_propagate(m[..., :n], n)  # low n limbs: mod R
+        s = carry_propagate(
+            jnp.pad(T, [(0, 0)] * (T.ndim - 1) + [(0, 1)])
+            + jnp.pad(mul_columns(m, jnp.asarray(self.N)),
+                      [(0, 0)] * (T.ndim - 1) + [(0, 2)]),
+            2 * n + 2,
+        )
+        r = s[..., n : 2 * n + 1]  # (..., n+1), value < 2N
+        d, borrow = sub_borrow(r, jnp.asarray(self.N_ext))
+        return select(borrow, r, d)[..., :n]
 
     def add(self, a, b):
         s = add_raw(a, b, self.n + 1)

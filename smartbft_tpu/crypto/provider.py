@@ -36,7 +36,7 @@ import numpy as np
 from ..codec import decode, encode, wiremsg
 from ..messages import Proposal, Signature
 from ..types import proposal_digest
-from . import ed25519, p256
+from . import bls12381, ed25519, p256
 
 
 @wiremsg
@@ -307,9 +307,16 @@ class CryptoProvider:
             raise ValueError(f"invalid consenter signature from {signature.signer}")
         return aux
 
-    def verify_consenter_sigs_batch(
-        self, signatures: Sequence[Signature], proposal: Proposal
-    ) -> list[Optional[bytes]]:
+    # batch verification = collect/bind (shared below) + a scheme-overridable
+    # mask step (_verify_items); BLS swaps in its aggregate fast path there
+
+    def _verify_items(self, items) -> list[bool]:
+        return self.engine.verify(items)
+
+    async def _verify_items_async(self, items) -> list[bool]:
+        return await self._coalescer.submit(items)
+
+    def _collect(self, signatures: Sequence[Signature], proposal: Proposal):
         auxes: list[Optional[bytes]] = []
         items, idxs = [], []
         for i, sig in enumerate(signatures):
@@ -320,31 +327,27 @@ class CryptoProvider:
                 auxes.append(aux)
             except Exception:
                 auxes.append(None)
-        mask = self.engine.verify(items)
+        return auxes, items, idxs
+
+    @staticmethod
+    def _apply_mask(auxes, idxs, mask):
         for pos, i in enumerate(idxs):
             if not mask[pos]:
                 auxes[i] = None
         return auxes
 
+    def verify_consenter_sigs_batch(
+        self, signatures: Sequence[Signature], proposal: Proposal
+    ) -> list[Optional[bytes]]:
+        auxes, items, idxs = self._collect(signatures, proposal)
+        return self._apply_mask(auxes, idxs, self._verify_items(items))
+
     async def verify_consenter_sigs_batch_async(
         self, signatures: Sequence[Signature], proposal: Proposal
     ) -> list[Optional[bytes]]:
         """Async path the View prefers: coalesces with concurrent callers."""
-        auxes: list[Optional[bytes]] = []
-        items, idxs = [], []
-        for i, sig in enumerate(signatures):
-            try:
-                aux = self._check_binding(sig, proposal)
-                items.append(self._item(sig))
-                idxs.append(i)
-                auxes.append(aux)
-            except Exception:
-                auxes.append(None)
-        mask = await self._coalescer.submit(items)
-        for pos, i in enumerate(idxs):
-            if not mask[pos]:
-                auxes[i] = None
-        return auxes
+        auxes, items, idxs = self._collect(signatures, proposal)
+        return self._apply_mask(auxes, idxs, await self._verify_items_async(items))
 
     def verify_signature(self, signature: Signature) -> None:
         try:
@@ -371,3 +374,96 @@ class Ed25519CryptoProvider(CryptoProvider):
     """Ed25519 provider — the alt-curve variant of BASELINE.md configs[3]."""
 
     scheme = ed25519
+
+
+class BlsCryptoProvider(CryptoProvider):
+    """BLS12-381 aggregate provider — BASELINE.md configs[4]:
+    one pairing equation per quorum.
+
+    Same-message aggregation requires every consenter to sign identical
+    bytes, so this provider signs the PROPOSAL DIGEST ONLY; the per-signer
+    auxiliary data (PreparesFrom witness lists, view.go:472-481) still
+    travels in ``Signature.msg`` but is NOT covered by the signature.
+    Deployments that rely on authenticated aux for blacklist redemption
+    should use the P-256/Ed25519 providers (or treat redemption as
+    advisory) — the tradeoff is the price of quorum collapse.
+
+    Verification strategy (the FastAggregateVerify shape of the IETF BLS
+    draft): aggregate the whole batch into ONE kernel lane (sum of G1 sigs,
+    sum of G2 pubkeys); only if that single pairing check fails fall back to
+    per-signature lanes to attribute the bad vote.  Two consequences:
+
+    * **Rogue keys.** Same-message aggregation is sound only when every
+      registered public key has a verified proof of possession (otherwise
+      pk_b = b*g2 - pk_a lets b fabricate a "quorum" containing a vote a
+      never cast).  Pass ``pops`` (signer id -> ``bls12381.pop_prove``
+      output) to enforce this at construction; deployments that omit it
+      MUST verify possession during key registration instead.
+    * **Set-level attestation.** When the aggregate check passes, it
+      attests that the quorum *as a set* signed the digest; the individual
+      ``Signature.value`` byte strings are not separately attested (a relay
+      could offset two of them by equal-and-opposite G1 points without
+      changing the sum).  All quorum-cert validation in this framework goes
+      through this batch path, so replicas agree; code that needs a single
+      signature attributable on its own must call
+      :meth:`verify_consenter_sig`, which never aggregates.
+    """
+
+    scheme = bls12381
+
+    def __init__(self, keyring: Keyring, engine=None,
+                 coalesce_window: Optional[float] = None,
+                 pops: Optional[dict[int, bytes]] = None):
+        super().__init__(keyring, engine, coalesce_window)
+        if pops is not None:
+            for nid, pub in keyring.public_keys.items():
+                pop = pops.get(nid)
+                if pop is None or not bls12381.pop_verify(pub, pop):
+                    raise ValueError(
+                        f"missing/invalid proof of possession for node {nid}"
+                    )
+
+    def _signed_bytes(self, msg: bytes) -> bytes:
+        """The digest-only bytes actually covered by the BLS signature."""
+        decoded = decode(ConsenterSigMsg, msg)
+        return encode(ConsenterSigMsg(proposal_digest=decoded.proposal_digest))
+
+    def sign(self, data: bytes) -> bytes:
+        try:
+            data = self._signed_bytes(data)
+        except Exception:
+            pass  # non-consenter payloads (e.g. ViewData) sign as-is
+        return self.scheme.sign_raw(self.keyring.private_key, data)
+
+    def _item(self, signature: Signature):
+        pub = self.keyring.public_keys.get(signature.signer)
+        if pub is None:
+            raise ValueError(f"unknown signer {signature.signer}")
+        try:
+            msg = self._signed_bytes(signature.msg)
+        except Exception:
+            msg = signature.msg
+        return self.scheme.make_item(msg, signature.value, pub)
+
+    def _aggregate_lane(self, items):
+        """One lane for the whole batch, or None if no collapse is possible."""
+        if len(items) <= 1:
+            return None
+        try:
+            return self.scheme.aggregate_items(items)
+        except ValueError:
+            return None  # mixed messages / degenerate sums
+
+    def _verify_items(self, items) -> list[bool]:
+        lane = self._aggregate_lane(items)
+        if lane is not None and self.engine.verify([lane])[0]:
+            return [True] * len(items)
+        return self.engine.verify(items)
+
+    async def _verify_items_async(self, items) -> list[bool]:
+        """Aggregate path with coalescing: the single aggregated lane joins
+        other in-flight quorums in one shared kernel launch."""
+        lane = self._aggregate_lane(items)
+        if lane is not None and (await self._coalescer.submit([lane]))[0]:
+            return [True] * len(items)
+        return await self._coalescer.submit(items)

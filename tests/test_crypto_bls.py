@@ -1,0 +1,187 @@
+"""BLS12-381: pairing identities, scheme behavior, aggregate path, kernel.
+
+The host implementation is validated against algebraic ground truth
+(bilinearity, order-r, the full (p^12-1)/r exponent); the device kernel is
+then validated against the host implementation.
+"""
+
+import numpy as np
+import pytest
+
+from smartbft_tpu.crypto import bls12381 as bls
+from smartbft_tpu.crypto.bls12381 import (
+    HOST,
+    NEG_G2,
+    G1X,
+    G1Y,
+    G2X,
+    G2Y,
+    P,
+    R_ORDER,
+    fp12_eq_one_host,
+    fp12_inv,
+    fp12_mul,
+    fp12_one,
+    g1_scalar_mult,
+    g2_scalar_mult,
+    host_final_exp,
+    host_miller_loop,
+    host_pairing_check,
+)
+
+G1 = (G1X, G1Y)
+G2 = (G2X, G2Y)
+
+
+def fp12_pow(a, e):
+    r = fp12_one(HOST)
+    b = a
+    while e:
+        if e & 1:
+            r = fp12_mul(HOST, r, b)
+        b = fp12_mul(HOST, b, b)
+        e >>= 1
+    return r
+
+
+def pairing(p, q):
+    return host_final_exp(host_miller_loop(p, q))
+
+
+class TestPairing:
+    def test_non_degenerate(self):
+        assert not fp12_eq_one_host(pairing(G1, G2))
+
+    def test_bilinear(self):
+        e = pairing(G1, G2)
+        assert pairing(g1_scalar_mult(6, G1), g2_scalar_mult(5, G2)) == fp12_pow(e, 30)
+        assert pairing(g1_scalar_mult(30, G1), G2) == fp12_pow(e, 30)
+
+    def test_order_r(self):
+        assert fp12_eq_one_host(fp12_pow(pairing(G1, G2), R_ORDER))
+
+    def test_final_exp_identity_matches_full_exponent(self):
+        """The (x-1)^2 (x+p)(x^2+p^2-1)+3 hard-part chain equals the
+        3(p^12-1)/r power (the cubed-ate convention; see host_final_exp)."""
+        f = host_miller_loop(G1, G2)
+        want = fp12_pow(f, 3 * ((P**12 - 1) // R_ORDER))
+        assert host_final_exp(f) == want
+
+    def test_inverse_pair_cancels(self):
+        s = g1_scalar_mult(9, G1)
+        assert host_pairing_check([(s, NEG_G2), (g1_scalar_mult(9, G1), G2)])
+
+
+class TestScheme:
+    def setup_method(self):
+        self.keys = [bls.keygen(b"node-%d" % i) for i in range(4)]
+        self.msg = b"proposal-digest"
+        self.sigs = [bls.sign(sk, self.msg) for sk, _ in self.keys]
+
+    def test_sign_verify(self):
+        for (sk, pk), sig in zip(self.keys, self.sigs):
+            assert bls.verify_int(pk, self.msg, sig)
+
+    def test_reject_wrong_message(self):
+        assert not bls.verify_int(self.keys[0][1], b"other", self.sigs[0])
+
+    def test_reject_wrong_key(self):
+        assert not bls.verify_int(self.keys[1][1], self.msg, self.sigs[0])
+
+    def test_reject_corrupt_signature(self):
+        bad = bytearray(self.sigs[0])
+        bad[7] ^= 1
+        assert not bls.verify_int(self.keys[0][1], self.msg, bytes(bad))
+
+    def test_reject_point_not_in_subgroup(self):
+        # find an E(Fp) point of non-r order (no cofactor clearing)
+        x = 1
+        while True:
+            rhs = (x * x * x + 4) % P
+            y = pow(rhs, (P + 1) // 4, P)
+            if y * y % P == rhs:
+                if bls.g1_scalar_mult(R_ORDER, (x, y)) is not None:
+                    break
+            x += 1
+        forged = bls.serialize_g1((x, y))
+        assert not bls.verify_int(self.keys[0][1], self.msg, forged)
+
+    def test_serialization_roundtrip(self):
+        pt = bls.deserialize_g1(self.sigs[0])
+        assert bls.serialize_g1(pt) == self.sigs[0]
+        pk = bls.deserialize_g2(self.keys[0][1])
+        assert bls.serialize_g2(pk) == self.keys[0][1]
+
+    def test_aggregate_verify(self):
+        pubs = [pk for _, pk in self.keys]
+        assert bls.aggregate_verify_int(pubs, self.msg, self.sigs)
+
+    def test_aggregate_rejects_missing_signer(self):
+        pubs = [pk for _, pk in self.keys]
+        assert not bls.aggregate_verify_int(pubs, self.msg, self.sigs[:3])
+
+    def test_aggregate_rejects_wrong_message(self):
+        pubs = [pk for _, pk in self.keys]
+        sigs = [bls.sign(sk, b"other") for sk, _ in self.keys]
+        assert not bls.aggregate_verify_int(pubs, self.msg, sigs)
+
+    def test_aggregate_items_requires_common_message(self):
+        items = [(self.msg, self.sigs[0], self.keys[0][1]),
+                 (b"other", self.sigs[1], self.keys[1][1])]
+        with pytest.raises(ValueError):
+            bls.aggregate_items(items)
+
+
+class TestKernel:
+    """Device kernel vs host; one fixed batch shape so the jit caches."""
+
+    def test_kernel_matches_host(self):
+        import jax
+        import jax.numpy as jnp
+
+        keys = [bls.keygen(b"n%d" % i) for i in range(3)]
+        msg = b"digest-xyz"
+        items = [(msg, bls.sign(sk, msg), pk) for sk, pk in keys]
+        # wrong-key lane must fail
+        items.append((msg, bls.sign(keys[0][0], b"other"), keys[1][1]))
+        # aggregated quorum lane must pass
+        items.append(bls.aggregate_items(items[:3]))
+
+        args = tuple(jnp.asarray(a) for a in bls.verify_inputs(items))
+        mask = np.asarray(jax.jit(bls.bls_verify_kernel)(*args))
+        assert mask.tolist() == [1, 1, 1, 0, 1]
+
+    def test_verify_inputs_flags_garbage(self):
+        bad = [(b"m", b"\x00" * bls.SIG_BYTES, b"\x01" * bls.PUB_BYTES)]
+        *_, ok = bls.verify_inputs(bad)
+        assert ok.tolist() == [0]
+
+
+class TestProofOfPossession:
+    def test_pop_roundtrip(self):
+        sk, pk, pop = bls.keygen_with_pop(b"pop-node")
+        assert bls.pop_verify(pk, pop)
+
+    def test_pop_rejects_other_keys_pop(self):
+        _, pk1, pop1 = bls.keygen_with_pop(b"pop-a")
+        _, pk2, _ = bls.keygen_with_pop(b"pop-b")
+        assert not bls.pop_verify(pk2, pop1)
+
+    def test_pop_is_not_a_consensus_signature(self):
+        """Domain separation: a PoP must not verify as a message signature."""
+        sk, pk, pop = bls.keygen_with_pop(b"pop-c")
+        assert not bls.verify_int(pk, pk, pop)
+
+    def test_provider_enforces_pops(self):
+        from smartbft_tpu.crypto.provider import BlsCryptoProvider, Keyring
+
+        trips = {n: bls.keygen_with_pop(b"pop-%d" % n) for n in (1, 2, 3, 4)}
+        pubs = {n: pk for n, (_, pk, _) in trips.items()}
+        pops = {n: pop for n, (_, _, pop) in trips.items()}
+        ring = Keyring(1, trips[1][0], pubs)
+        BlsCryptoProvider(ring, pops=pops)  # all valid: accepted
+
+        with pytest.raises(ValueError, match="possession"):
+            BlsCryptoProvider(ring, pops={**pops, 3: pops[2]})  # wrong pop
+        with pytest.raises(ValueError, match="possession"):
+            BlsCryptoProvider(ring, pops={n: pops[n] for n in (1, 2, 3)})
