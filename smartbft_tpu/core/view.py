@@ -158,6 +158,10 @@ class View:
         self.my_proposal_sig: Optional[Signature] = None
         self.in_flight_proposal: Optional[Proposal] = None
         self.in_flight_requests: list = []
+        # batch-processing latency starts at pre-prepare receipt; views that
+        # skip processProposal (WAL restore, the in-flight commit view spun
+        # up at Phase=PREPARED) must still have a start point
+        self._begin_pre_prepare = self._now()
         self.last_broadcast_sent: Optional[Message] = None
         self._curr_prepare_sent: Optional[Prepare] = None
         self._curr_commit_sent: Optional[Commit] = None

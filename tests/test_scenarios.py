@@ -1,0 +1,392 @@
+"""Deeper protocol scenarios from the reference's integration matrix.
+
+Each test names the /root/reference/test/basic_test.go scenario it models.
+These cover the parts of the protocol the basic/fault suites don't reach:
+heartbeat-only view changes, gradual start, WAL restore of view-change
+records, in-flight proposal choreography (CheckInFlight conditions), the
+new-leader one-behind ViewData delivery ladder, autonomous sync via
+heartbeat seq evidence, and blacklist redemption under rotation.
+"""
+
+import asyncio
+import dataclasses
+
+import pytest
+
+from smartbft_tpu.codec import decode
+from smartbft_tpu.messages import Commit, Prepare, ViewMetadata
+from smartbft_tpu.testing.app import App, SharedLedgers, wait_for
+from smartbft_tpu.testing.network import Network
+from smartbft_tpu.utils.clock import Scheduler
+
+from tests.test_basic import make_nodes, start_all, stop_all
+from tests.test_viewchange import vc_config
+
+
+def black_list_of(app) -> list[int]:
+    ledger = app.ledger()
+    if not ledger:
+        return []
+    md = decode(ViewMetadata, ledger[-1].proposal.metadata)
+    return list(md.black_list)
+
+
+def ever_blacklisted(app) -> set[int]:
+    """Union of the blacklist across every committed decision."""
+    out: set[int] = set()
+    for d in app.ledger():
+        out.update(decode(ViewMetadata, d.proposal.metadata).black_list)
+    return out
+
+
+def rotation_config(i):
+    return dataclasses.replace(
+        vc_config(i), leader_rotation=True, decisions_per_leader=1
+    )
+
+
+def test_heartbeat_timeout_causes_view_change(tmp_path):
+    """With NO client traffic at all, a dark leader is deposed purely by
+    heartbeat timeout (basic_test.go:TestHeartbeatTimeoutCausesViewChange)."""
+
+    async def run():
+        apps, scheduler, *_ = make_nodes(4, tmp_path, config_fn=vc_config)
+        await start_all(apps)
+        apps[0].disconnect()  # never submits anything
+        await wait_for(
+            lambda: all(a.consensus.get_leader_id() == 2 for a in apps[1:]),
+            scheduler, timeout=120.0,
+        )
+        # the cluster is live under the new leader
+        await apps[1].submit("c", "r0")
+        await wait_for(lambda: all(a.height() >= 1 for a in apps[1:]),
+                       scheduler, timeout=120.0)
+        await stop_all(apps)
+
+    asyncio.run(run())
+
+
+def test_multi_view_change_with_no_requests(tmp_path):
+    """Leaders 1 AND 2 are dark before any traffic; the view change cascades
+    to leader 3 on timeouts alone
+    (basic_test.go:TestMultiViewChangeWithNoRequestsTimeout)."""
+
+    async def run():
+        apps, scheduler, *_ = make_nodes(6, tmp_path, config_fn=vc_config)
+        await start_all(apps)
+        apps[0].disconnect()
+        apps[1].disconnect()
+        await wait_for(
+            lambda: all(a.consensus.get_leader_id() == 3 for a in apps[2:]),
+            scheduler, timeout=240.0,
+        )
+        await apps[2].submit("c", "r0")
+        await wait_for(lambda: all(a.height() >= 1 for a in apps[2:]),
+                       scheduler, timeout=120.0)
+        await stop_all(apps)
+
+    asyncio.run(run())
+
+
+def test_after_decision_leader_in_partition(tmp_path):
+    """Decisions are made, THEN the leader partitions; the next view keeps
+    the chain intact (basic_test.go:TestAfterDecisionLeaderInPartition)."""
+
+    async def run():
+        apps, scheduler, *_ = make_nodes(4, tmp_path, config_fn=vc_config)
+        await start_all(apps)
+        for k in range(3):
+            await apps[0].submit("c", f"r{k}")
+            await wait_for(lambda: all(a.height() >= k + 1 for a in apps),
+                           scheduler, timeout=120.0)
+        apps[0].disconnect()
+        await wait_for(
+            lambda: all(a.consensus.get_leader_id() == 2 for a in apps[1:]),
+            scheduler, timeout=120.0,
+        )
+        await apps[1].submit("c", "r3")
+        await wait_for(lambda: all(a.height() >= 4 for a in apps[1:]),
+                       scheduler, timeout=120.0)
+        ref = [d.proposal for d in apps[1].ledger()]
+        assert [d.proposal for d in apps[2].ledger()] == ref
+        await stop_all(apps)
+
+    asyncio.run(run())
+
+
+def test_gradual_start(tmp_path):
+    """Nodes start one at a time; ordering begins only once a quorum is up
+    (basic_test.go:TestGradualStart)."""
+
+    async def run():
+        scheduler, network, shared = Scheduler(), Network(seed=3), SharedLedgers()
+        apps = [
+            App(i, network, shared, scheduler,
+                wal_dir=str(tmp_path / f"wal-{i}"), config=vc_config(i))
+            for i in (1, 2, 3, 4)
+        ]
+        await apps[0].start()
+        await apps[0].submit("c", "r0")
+        # alone: no quorum, nothing commits
+        with pytest.raises(TimeoutError):
+            await wait_for(lambda: apps[0].height() >= 1, scheduler, timeout=10.0)
+        await apps[1].start()
+        with pytest.raises(TimeoutError):
+            await wait_for(lambda: apps[0].height() >= 1, scheduler, timeout=10.0)
+        await apps[2].start()  # 3 of 4 = quorum
+        await wait_for(lambda: all(a.height() >= 1 for a in apps[:3]),
+                       scheduler, timeout=120.0)
+        await apps[3].start()
+        await apps[0].submit("c", "r1")
+        await wait_for(lambda: all(a.height() >= 2 for a in apps),
+                       scheduler, timeout=240.0)
+        await stop_all(apps)
+
+    asyncio.run(run())
+
+
+def test_restart_after_view_change_restores_new_view(tmp_path):
+    """After a view change, a restarting follower must come back in the NEW
+    view — restored from the WAL NewView record, not view 0
+    (basic_test.go:TestRestartAfterViewChangeAndRestoreNewView)."""
+
+    async def run():
+        apps, scheduler, *_ = make_nodes(4, tmp_path, config_fn=vc_config)
+        await start_all(apps)
+        apps[0].disconnect()
+        await wait_for(
+            lambda: all(a.consensus.get_leader_id() == 2 for a in apps[1:]),
+            scheduler, timeout=120.0,
+        )
+        await apps[2].restart()
+        assert apps[2].consensus.get_leader_id() == 2  # restored, not view 0
+        await apps[1].submit("c", "r0")
+        await wait_for(lambda: all(a.height() >= 1 for a in apps[1:]),
+                       scheduler, timeout=240.0)
+        await stop_all(apps)
+
+    asyncio.run(run())
+
+
+def test_restoring_view_change_record(tmp_path):
+    """A node that persisted a ViewChange and crashed resumes the view change
+    after restart (basic_test.go:TestRestoringViewChange).
+
+    Choreography: only nodes 1 (dark leader) and 2 are up, so node 2 joins a
+    view change that cannot complete (no quorum), persists the ViewChange
+    record, and restarts.  Then 3 and 4 start and the view change finishes.
+    """
+
+    async def run():
+        scheduler, network, shared = Scheduler(), Network(seed=5), SharedLedgers()
+        apps = [
+            App(i, network, shared, scheduler,
+                wal_dir=str(tmp_path / f"wal-{i}"), config=vc_config(i))
+            for i in (1, 2, 3, 4)
+        ]
+        await apps[0].start()
+        await apps[1].start()
+        apps[0].disconnect()
+        # node 2's heartbeat timeout fires; it starts (and persists) a view
+        # change it cannot finish — next_view advances past curr_view
+        def vc_started():
+            vc = apps[1].consensus.view_changer
+            return vc is not None and vc.next_view > vc.curr_view
+
+        await wait_for(vc_started, scheduler, timeout=60.0)
+        await apps[1].restart()
+        await apps[2].start()
+        await apps[3].start()
+        await wait_for(
+            lambda: all(a.consensus.get_leader_id() == 2 for a in apps[1:]),
+            scheduler, timeout=240.0,
+        )
+        await apps[1].submit("c", "r0")
+        await wait_for(lambda: all(a.height() >= 1 for a in apps[1:]),
+                       scheduler, timeout=120.0)
+        await stop_all(apps)
+
+    asyncio.run(run())
+
+
+def test_in_flight_commit_after_sole_committer_crashes(tmp_path):
+    """Only node 4 collects the commit quorum and delivers; it then crashes.
+    The rest are PREPARED; the view change must agree on the in-flight
+    proposal (CheckInFlight condition A) and commit it in the new view, so
+    the chain never forks (basic_test.go:
+    TestNodeCommitTheRestPrepareAndCommittedNodeCrashesThenRecovers)."""
+
+    async def run():
+        apps, scheduler, *_ = make_nodes(4, tmp_path, config_fn=vc_config)
+        await start_all(apps)
+        # nodes 1-3 drop all Commit messages: they stop at PREPARED
+        for a in apps[:3]:
+            a.node.add_filter(lambda msg, src: not isinstance(msg, Commit))
+        await apps[0].submit("c", "r0")
+        await wait_for(lambda: apps[3].height() >= 1, scheduler, timeout=120.0)
+        assert all(a.height() == 0 for a in apps[:3])
+
+        apps[3].disconnect()  # the only committed node goes dark
+        for a in apps[:3]:
+            a.node.clear_filters()
+        # request timeout -> complain -> view change; in-flight commits
+        await wait_for(lambda: all(a.height() >= 1 for a in apps[:3]),
+                       scheduler, timeout=360.0)
+
+        apps[3].connect()
+        await apps[0].submit("c", "r1")
+        await wait_for(lambda: all(a.height() >= 2 for a in apps),
+                       scheduler, timeout=360.0)
+        ref = [d.proposal for d in apps[3].ledger()]
+        for a in apps[:3]:
+            assert [d.proposal for d in a.ledger()] == ref  # no fork
+        await stop_all(apps)
+
+    asyncio.run(run())
+
+
+def test_one_node_prepared_rest_not_then_heals(tmp_path):
+    """Only node 4 reaches PREPARED (the rest never see prepares); after the
+    partition heals and a view change runs, nobody is forked and the cluster
+    commits (basic_test.go:TestNodePreparesTheRestInPartitionThenPartitionHeals,
+    CheckInFlight condition B: quorum with no agreed in-flight)."""
+
+    async def run():
+        apps, scheduler, *_ = make_nodes(4, tmp_path, config_fn=vc_config)
+        await start_all(apps)
+        # nodes 1-3 drop Prepare AND Commit: stuck pre-PREPARED; node 4
+        # collects prepares and goes to PREPARED but can never commit
+        for a in apps[:3]:
+            a.node.add_filter(
+                lambda msg, src: not isinstance(msg, (Prepare, Commit))
+            )
+        await apps[0].submit("c", "r0")
+        # let the protocol wedge, then heal
+        scheduler.advance_by(5.0)
+        await asyncio.sleep(0.05)
+        for a in apps[:3]:
+            a.node.clear_filters()
+        # complaints lead to a view change; the proposal (re-proposed in
+        # flight or re-batched) eventually commits everywhere
+        await wait_for(lambda: all(a.height() >= 1 for a in apps),
+                       scheduler, timeout=360.0)
+        # ledgers must agree on their common prefix (no fork)
+        ref = [d.proposal for d in apps[0].ledger()]
+        for a in apps[1:]:
+            la = [d.proposal for d in a.ledger()]
+            m = min(len(la), len(ref))
+            assert la[:m] == ref[:m]
+        await stop_all(apps)
+
+    asyncio.run(run())
+
+
+def test_new_leader_one_behind_catches_up_in_view_change(tmp_path):
+    """The next leader missed the last decision; during the view change it
+    must learn it from the quorum's ViewData (the checkLastDecision ladder)
+    and then lead (basic_test.go:TestLeaderCatchingUpAfterViewChange)."""
+
+    async def run():
+        apps, scheduler, *_ = make_nodes(4, tmp_path, config_fn=vc_config)
+        await start_all(apps)
+        # node 2 (the next leader) misses the decision: drop commits to it
+        apps[1].node.add_filter(lambda msg, src: not isinstance(msg, Commit))
+        await apps[0].submit("c", "r0")
+        await wait_for(
+            lambda: all(a.height() >= 1 for a in (apps[0], apps[2], apps[3])),
+            scheduler, timeout=120.0,
+        )
+        assert apps[1].height() == 0
+        apps[1].node.clear_filters()
+
+        apps[0].disconnect()  # depose leader 1 -> leader 2 must catch up
+        await wait_for(
+            lambda: all(a.consensus.get_leader_id() == 2 for a in apps[1:]),
+            scheduler, timeout=240.0,
+        )
+        await wait_for(lambda: apps[1].height() >= 1, scheduler, timeout=120.0)
+        await apps[1].submit("c", "r1")
+        await wait_for(lambda: all(a.height() >= 2 for a in apps[1:]),
+                       scheduler, timeout=120.0)
+        ref = [d.proposal for d in apps[2].ledger()]
+        assert [d.proposal for d in apps[1].ledger()] == ref
+        await stop_all(apps)
+
+    asyncio.run(run())
+
+
+def test_follower_autonomous_sync_via_heartbeat_evidence(tmp_path):
+    """A reconnected follower that sees leader heartbeats with a higher
+    sequence syncs by itself after num_of_ticks_behind_before_syncing ticks,
+    with NO new requests arriving
+    (basic_test.go:TestCatchingUpWithSyncAutonomous)."""
+
+    async def run():
+        apps, scheduler, *_ = make_nodes(4, tmp_path, config_fn=vc_config)
+        await start_all(apps)
+        apps[3].disconnect()  # a follower goes dark
+        for k in range(3):
+            await apps[0].submit("c", f"r{k}")
+            await wait_for(lambda: all(a.height() >= k + 1 for a in apps[:3]),
+                           scheduler, timeout=120.0)
+        assert apps[3].height() == 0
+        apps[3].connect()
+        # no new traffic: only heartbeats carry the seq evidence
+        await wait_for(lambda: apps[3].height() >= 3, scheduler, timeout=360.0)
+        assert [d.proposal for d in apps[3].ledger()] == [
+            d.proposal for d in apps[0].ledger()
+        ]
+        await stop_all(apps)
+
+    asyncio.run(run())
+
+
+def test_blacklist_redemption_under_rotation(tmp_path):
+    """With leader rotation on, a deposed node lands on the blacklist; after
+    it reconnects and acknowledges prepares again, the deterministic
+    blacklist update redeems it (basic_test.go:TestBlacklistAndRedemption)."""
+
+    async def run():
+        apps, scheduler, *_ = make_nodes(4, tmp_path, config_fn=rotation_config)
+        await start_all(apps)
+        await apps[0].submit("c", "warm")
+        await wait_for(lambda: all(a.height() >= 1 for a in apps),
+                       scheduler, timeout=120.0)
+
+        victim = apps[1].consensus.get_leader_id()
+        vic_app = apps[victim - 1]
+        vic_app.disconnect()
+        await wait_for(
+            lambda: all(
+                a.consensus.get_leader_id() != victim
+                for a in apps if a is not vic_app
+            ),
+            scheduler, timeout=240.0,
+        )
+        live = [a for a in apps if a is not vic_app]
+        h0 = max(a.height() for a in live)
+        await live[0].submit("c", "post-vc")
+        await wait_for(lambda: all(a.height() >= h0 + 1 for a in live),
+                       scheduler, timeout=240.0)
+        # a skipped leader was blacklisted.  With f=1 the list is capped at
+        # ONE entry, and a cascading view change can skip several leaders in
+        # one go — the cap then keeps only the latest skipped leader, which
+        # may not be the victim itself.  What must hold: somebody is on the
+        # list, and every blacklisted id was a skipped leader.
+        assert ever_blacklisted(live[0]), "view change blacklisted nobody"
+
+        vic_app.connect()
+        # keep ordering; prepare acks from reconnected/live nodes are
+        # witnessed by >f replicas and the deterministic update prunes them —
+        # the list must drain to empty (full redemption)
+        for k in range(8):
+            h = max(a.height() for a in live)
+            await live[0].submit("c", f"redeem-{k}")
+            await wait_for(lambda: all(a.height() >= h + 1 for a in live),
+                           scheduler, timeout=240.0)
+            if not black_list_of(live[0]):
+                break
+        assert black_list_of(live[0]) == []
+        await stop_all(apps)
+
+    asyncio.run(run())
