@@ -157,13 +157,19 @@ class JaxVerifyEngine:
             out.extend(self._verify_chunk(items[off : off + cap]))
         return out
 
+    def _place(self, a):
+        """Hook for subclasses to place padded inputs (e.g. mesh-sharded)."""
+        return a
+
     def _verify_chunk(self, items) -> list[bool]:
         n = len(items)
         size = self._pad_to(n)
         arrays = self.scheme.verify_inputs(items)
 
         def pad(a):
-            return np.concatenate([a, np.zeros((size - n,) + a.shape[1:], a.dtype)])
+            return self._place(
+                np.concatenate([a, np.zeros((size - n,) + a.shape[1:], a.dtype)])
+            )
 
         t0 = time.perf_counter()
         mask = np.asarray(self._kernel(*(pad(a) for a in arrays)))

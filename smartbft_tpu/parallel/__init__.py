@@ -1,0 +1,24 @@
+"""Device-mesh parallelism for the crypto plane.
+
+The reference scales by adding replicas (one process each); its only
+in-process parallelism is goroutine fan-out per signature
+(/root/reference/internal/bft/view.go:537-541).  Here the same work is data
+parallel over kernel lanes, so it shards over a TPU pod slice with
+`jax.sharding` — no NCCL/MPI analog needed: XLA inserts the collectives.
+
+Two products:
+
+* :class:`ShardedVerifyEngine` — a drop-in verify engine (same surface as
+  ``JaxVerifyEngine``) that annotates the batch axis with a 1D 'lane' mesh
+  sharding; XLA partitions the vmap'd kernel across devices with zero
+  communication (verification is embarrassingly parallel until the final
+  host-side mask read).
+* :func:`quorum_decide` — the 2D (seq x vote) quorum step: each device
+  verifies its (sequences, votes) tile, vote counts reduce with a `psum`
+  over the 'vote' axis, and the decided mask shards over 'seq'.  This is
+  the flagship multi-chip step `__graft_entry__.dryrun_multichip` compiles.
+"""
+
+from .engine import ShardedVerifyEngine, build_mesh, quorum_decide
+
+__all__ = ["ShardedVerifyEngine", "build_mesh", "quorum_decide"]

@@ -1,0 +1,128 @@
+"""Mesh-sharded signature verification and the distributed quorum step.
+
+Design notes (TPU-first):
+
+* Verification lanes are independent — the ideal SPMD workload.  The
+  engine pads each batch to a lane count divisible by the mesh and places
+  inputs with ``NamedSharding(mesh, P('lane'))``; ``jax.jit`` then
+  partitions the whole kernel body across devices without any hand-written
+  collectives.
+* The quorum step is the one place a cross-device reduction exists: vote
+  counts sum over the 'vote' mesh axis (``lax.psum`` riding ICI), the
+  cheapest possible collective (one scalar per in-flight sequence).
+* Both paths reuse the scheme modules' single-chip kernels unchanged —
+  sharding is an annotation, not a rewrite.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..crypto import p256
+from ..crypto.provider import JaxVerifyEngine
+
+
+def build_mesh(shape: Optional[tuple[int, ...]] = None,
+               axis_names: tuple[str, ...] = ("lane",),
+               devices=None):
+    """A `jax.sharding.Mesh` over the first prod(shape) devices.
+
+    Default: all visible devices on a 1D 'lane' axis.  For the quorum step
+    pass ``shape=(seq_par, vote_par)`` and ``axis_names=('seq', 'vote')``.
+    """
+    import jax
+
+    devices = list(jax.devices() if devices is None else devices)
+    if shape is None:
+        shape = (len(devices),)
+    n = int(np.prod(shape))
+    if n > len(devices):
+        raise ValueError(f"mesh shape {shape} needs {n} devices, "
+                         f"have {len(devices)}")
+    from jax.sharding import Mesh
+
+    return Mesh(np.asarray(devices[:n]).reshape(shape), axis_names)
+
+
+class ShardedVerifyEngine(JaxVerifyEngine):
+    """`JaxVerifyEngine` with batch lanes sharded over a 1D device mesh.
+
+    Same engine surface, so it plugs into ``CryptoProvider`` and the async
+    coalescer unchanged.  Pad sizes are rounded up to multiples of the mesh
+    size so every device gets equal, static tiles; padded inputs are placed
+    with a lane sharding and XLA partitions the kernel.
+    """
+
+    def __init__(self, mesh=None,
+                 pad_sizes: tuple[int, ...] = (64, 256, 1024), scheme=p256):
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        self.mesh = mesh if mesh is not None else build_mesh()
+        if len(self.mesh.axis_names) != 1:
+            raise ValueError("ShardedVerifyEngine wants a 1D mesh; use "
+                             "quorum_decide for 2D (seq x vote) meshes")
+        self.lanes = int(np.prod(self.mesh.devices.shape))
+        rounded = sorted({-(-s // self.lanes) * self.lanes for s in pad_sizes})
+        super().__init__(pad_sizes=rounded, scheme=scheme)
+        self._sharding = NamedSharding(
+            self.mesh, PartitionSpec(self.mesh.axis_names[0])
+        )
+
+    def _place(self, a):
+        return self._jax.device_put(a, self._sharding)
+
+
+def quorum_decide(mesh, quorum: int, scheme=p256):
+    """The distributed quorum step: (S, V, ...) vote block -> (S,) decided.
+
+    Shards sequences over 'seq' and votes over 'vote'; each device runs the
+    scheme's verify kernel on its tile, then vote counts `psum` across the
+    'vote' axis.  Returns a function over device arrays placed with
+    ``NamedSharding(mesh, P('seq', 'vote', *))``.
+
+    Scheme-generic: kernel inputs may be per-vote vectors (rank 3 as a
+    quorum block) or per-vote scalars like the host-validity masks of
+    ed25519/bls12381 (rank 2); partition specs are derived from the actual
+    ranks at first call and the wrapped shard_map is cached per rank tuple.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    if tuple(mesh.axis_names) != ("seq", "vote"):
+        raise ValueError("quorum_decide wants a ('seq', 'vote') mesh")
+
+    def step(*arrays):
+        local = scheme.verify_kernel(*arrays)  # (S/seq, V/vote)
+        counts = jax.lax.psum(jnp.sum(local, axis=-1), "vote")
+        return counts >= quorum
+
+    cache: dict[tuple[int, ...], object] = {}
+
+    def wrap(ranks: tuple[int, ...]):
+        if any(r not in (2, 3) for r in ranks):
+            raise ValueError(f"quorum-block inputs must be rank 2 or 3, got {ranks}")
+        specs = tuple(
+            P("seq", "vote", None) if r == 3 else P("seq", "vote") for r in ranks
+        )
+        # check_vma=False: the bignum carry-chain scans initialize carries
+        # from unvarying constants, which the varying-manual-axes checker
+        # rejects; the computation is elementwise over lanes + one psum.
+        try:
+            sharded = jax.shard_map(step, mesh=mesh, in_specs=specs,
+                                    out_specs=P("seq"), check_vma=False)
+        except TypeError:  # older jax spells it check_rep
+            sharded = jax.shard_map(step, mesh=mesh, in_specs=specs,
+                                    out_specs=P("seq"), check_rep=False)
+        return jax.jit(sharded)
+
+    def decide(*arrays):
+        ranks = tuple(np.ndim(a) for a in arrays)
+        fn = cache.get(ranks)
+        if fn is None:
+            fn = cache[ranks] = wrap(ranks)
+        return fn(*arrays)
+
+    return decide
