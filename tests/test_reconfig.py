@@ -31,6 +31,22 @@ def test_config_mirror_roundtrip():
     assert detect_reconfig(b"not a reconfig") is None
 
 
+
+async def grow_to_five(apps, network, shared, scheduler, tmp_path):
+    """Join choreography shared by the add/remove scenarios: reconfig the
+    membership to [1..5], start node 5 (sync_on_start), wait for it to catch
+    up.  Returns the new App."""
+    cfg5 = dataclasses.replace(fast_config(5), sync_on_start=True)
+    app5 = App(5, network, shared, scheduler,
+               wal_dir=str(tmp_path / "wal-5"), config=cfg5)
+    await apps[0].submit_reconfig("rc-add", [1, 2, 3, 4, 5])
+    await wait_for(lambda: all(a.consensus.num_nodes == 5 for a in apps),
+                   scheduler, timeout=120.0)
+    await app5.start()
+    await wait_for(lambda: app5.height() >= 2, scheduler, timeout=240.0)
+    return app5
+
+
 def test_add_node(tmp_path):
     """reconfig_test.go:TestBasicReconfigWithAddedNode — grow 4 -> 5; the new
     node syncs the existing chain and participates."""
@@ -42,18 +58,7 @@ def test_add_node(tmp_path):
         await wait_for(lambda: all(a.height() >= 1 for a in apps), scheduler)
 
         # create node 5 (joins the transport now, starts after the reconfig)
-        cfg5 = dataclasses.replace(fast_config(5), sync_on_start=True)
-        app5 = App(5, network, shared, scheduler,
-                   wal_dir=str(tmp_path / "wal-5"), config=cfg5)
-
-        await apps[0].submit_reconfig("rc-add", [1, 2, 3, 4, 5])
-        await wait_for(
-            lambda: all(a.consensus.num_nodes == 5 for a in apps),
-            scheduler, timeout=120.0,
-        )
-
-        await app5.start()
-        await wait_for(lambda: app5.height() >= 2, scheduler, timeout=240.0)
+        app5 = await grow_to_five(apps, network, shared, scheduler, tmp_path)
 
         await apps[0].submit("c", "r1")
         everyone = apps + [app5]
@@ -183,6 +188,77 @@ def test_rotation_then_add_node(tmp_path):
         await wait_for(
             lambda: all(a.height() >= 7 for a in everyone), scheduler, timeout=240.0
         )
+        await stop_all(everyone)
+
+    asyncio.run(run())
+
+
+def test_add_then_remove_nodes(tmp_path):
+    """reconfig_test.go:TestAddRemoveNodes — grow 4 -> 5, then shrink 5 -> 4
+    by evicting the ORIGINAL first node; ordering continues across both
+    epochs and the survivor set agrees."""
+
+    async def run():
+        apps, scheduler, network, shared = make_nodes(4, tmp_path)
+        await start_all(apps)
+        await apps[0].submit("c", "r0")
+        await wait_for(lambda: all(a.height() >= 1 for a in apps), scheduler)
+
+        app5 = await grow_to_five(apps, network, shared, scheduler, tmp_path)
+
+        # now evict node 1 (the current leader's id set changes)
+        await apps[0].submit_reconfig("rc-rm", [2, 3, 4, 5])
+        rest = [apps[1], apps[2], apps[3], app5]
+        await wait_for(
+            lambda: all(a.consensus.num_nodes == 4 for a in rest)
+            and not apps[0].consensus._running,
+            scheduler, timeout=240.0,
+        )
+        await rest[0].submit("c", "r1")
+        await wait_for(lambda: all(a.height() >= 4 for a in rest),
+                       scheduler, timeout=240.0)
+        ref = [d.proposal for d in rest[0].ledger()]
+        for a in rest[1:]:
+            assert [d.proposal for d in a.ledger()] == ref
+        await stop_all(apps + [app5])
+
+    asyncio.run(run())
+
+
+def test_add_remove_add_nodes(tmp_path):
+    """reconfig_test.go:TestAddRemoveAddNodes — add 5, remove 5, add it BACK
+    (rejoining with its old WAL); membership epochs must compose."""
+
+    async def run():
+        apps, scheduler, network, shared = make_nodes(4, tmp_path)
+        await start_all(apps)
+        await apps[0].submit("c", "r0")
+        await wait_for(lambda: all(a.height() >= 1 for a in apps), scheduler)
+
+        app5 = await grow_to_five(apps, network, shared, scheduler, tmp_path)
+
+        await apps[0].submit_reconfig("rc-rm", [1, 2, 3, 4])
+        await wait_for(
+            lambda: all(a.consensus.num_nodes == 4 for a in apps)
+            and not app5.consensus._running,
+            scheduler, timeout=240.0,
+        )
+        await apps[0].submit("c", "mid")
+        await wait_for(lambda: all(a.height() >= 4 for a in apps),
+                       scheduler, timeout=120.0)
+
+        await apps[0].submit_reconfig("rc-re-add", [1, 2, 3, 4, 5])
+        await wait_for(lambda: all(a.consensus.num_nodes == 5 for a in apps),
+                       scheduler, timeout=240.0)
+        await app5.restart()  # rejoin with its old WAL + sync
+        await wait_for(lambda: app5.height() >= 5, scheduler, timeout=240.0)
+
+        await apps[0].submit("c", "r1")
+        everyone = apps + [app5]
+        await wait_for(lambda: all(a.height() >= 6 for a in everyone),
+                       scheduler, timeout=240.0)
+        ref = [d.proposal for d in apps[0].ledger()]
+        assert [d.proposal for d in app5.ledger()] == ref
         await stop_all(everyone)
 
     asyncio.run(run())
