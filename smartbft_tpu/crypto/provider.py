@@ -107,9 +107,13 @@ class HostVerifyEngine:
         self.stats = VerifyStats()
         self._lock = threading.Lock()
 
+    def _verify_one(self, item) -> bool:
+        """Per-item hook; subclasses swap in other sequential backends."""
+        return self.scheme.verify_item(item)
+
     def verify(self, items) -> list[bool]:
         t0 = time.perf_counter()
-        out = [self.scheme.verify_item(item) for item in items]
+        out = [self._verify_one(item) for item in items]
         dt = time.perf_counter() - t0
         with self._lock:
             self.stats.launches += 1

@@ -476,10 +476,16 @@ def test_leader_heartbeats_suppressed_by_real_traffic(tmp_path):
     suppressed (real traffic is the sign of life); when the cluster idles,
     heartbeats resume (basic_test.go:TestLeaderStopSendHeartbeat,
     heartbeatmonitor.go:352-376)."""
-    from smartbft_tpu.messages import HeartBeat
+
+    def hb_config(i):
+        # heartbeat period (timeout/count = 1.0s) must be much longer than
+        # the monitor tick (0.2s) for suppression to be observable: each
+        # sign-of-life postpones the next heartbeat to a full period after
+        # the last tick
+        return dataclasses.replace(vc_config(i), leader_heartbeat_timeout=10.0)
 
     async def run():
-        apps, scheduler, *_ = make_nodes(4, tmp_path, config_fn=vc_config)
+        apps, scheduler, *_ = make_nodes(4, tmp_path, config_fn=hb_config)
         counts = {"busy": 0, "idle": 0, "phase": "busy"}
 
         def count_hb(msg, src):
@@ -490,22 +496,34 @@ def test_leader_heartbeats_suppressed_by_real_traffic(tmp_path):
         apps[1].node.add_filter(count_hb)
         await start_all(apps)
 
-        # busy phase: continuous ordering for 20 logical seconds
-        for k in range(10):
-            await apps[0].submit("c", f"busy-{k}")
-            await wait_for(lambda: all(a.height() >= k + 1 for a in apps),
-                           scheduler, timeout=120.0)
-        busy = counts["busy"]
+        # busy phase: keep the leader continuously ordering until the window
+        # has spanned at least 3 heartbeat periods (1.0s each) — otherwise
+        # the suppression assertion could pass vacuously on a short burst
+        busy_start = scheduler.now()
+        k = 0
+        while scheduler.now() - busy_start < 3.0:
+            for _ in range(10):
+                await apps[0].submit("c", f"busy-{k}")
+                k += 1
+            await wait_for(
+                lambda: all(a.height() >= k // 10 for a in apps),
+                scheduler, timeout=240.0,
+            )
+        busy_span = scheduler.now() - busy_start
+        busy_rate = counts["busy"] / busy_span
 
-        # idle phase: same logical duration, no traffic
+        # idle phase: at least as long, and >= ~4 heartbeat periods of silence
         counts["phase"] = "idle"
-        for _ in range(40):
-            scheduler.advance_by(0.5)
+        idle_span = max(busy_span, 4.0)
+        idle_start = scheduler.now()
+        while scheduler.now() - idle_start < idle_span:
+            scheduler.advance_by(0.1)
             await asyncio.sleep(0.002)
-        idle = counts["idle"]
+        idle_rate = counts["idle"] / idle_span
 
-        assert idle > busy, (
-            f"heartbeats should be suppressed under traffic: busy={busy} idle={idle}"
+        assert idle_rate > 1.5 * busy_rate, (
+            f"heartbeats should be suppressed under traffic: "
+            f"busy={busy_rate:.2f}/s idle={idle_rate:.2f}/s"
         )
         await stop_all(apps)
 
