@@ -150,6 +150,44 @@ def bits_msb(a, nbits: int):
     return (a[..., limb] >> jnp.asarray(off, DTYPE)) & jnp.uint32(1)
 
 
+def digits_msb(a, ndigits: int, width: int = 2):
+    """Fixed-width digit decomposition, most-significant digit first.
+
+    (..., n) -> (..., ndigits), each digit in [0, 2**width).
+    """
+    bits = bits_msb(a, ndigits * width)
+    bits = bits.reshape(bits.shape[:-1] + (ndigits, width))
+    weights = jnp.asarray([1 << (width - 1 - k) for k in range(width)], DTYPE)
+    return jnp.sum(bits * weights, axis=-1, dtype=DTYPE)
+
+
+def shamir_scan_w(point_add, table, ident, d1, d2, width: int = 2):
+    """Windowed Strauss–Shamir double-scalar mult.
+
+    Per digit: ``width`` doublings + one gather + one addition — for w=2
+    that is 3 point ops per 2 bits versus 4 for the bitwise scan, 25%
+    fewer sequential point operations.  ``table`` is (..., 4**width, C, n)
+    with entry i * 2**width + j holding i*P1 + j*P2; d1/d2 are
+    (..., ndigits) MSB-first digits from :func:`digits_msb`.
+    ``point_add`` must be complete (identity-safe).
+    """
+    xs = (jnp.moveaxis(d1, -1, 0), jnp.moveaxis(d2, -1, 0))
+    base = jnp.uint32(1 << width)
+
+    def step(acc, ds):
+        i, j = ds
+        for _ in range(width):
+            acc = point_add(acc, acc)
+        idx = (i * base + j).astype(jnp.int32)
+        sel = jnp.take_along_axis(
+            table, idx[..., None, None, None], axis=-3
+        )[..., 0, :, :]
+        return point_add(acc, sel), None
+
+    acc, _ = lax.scan(step, ident, xs)
+    return acc
+
+
 def shamir_scan(point_add, table, ident, bits1, bits2):
     """Strauss–Shamir double-scalar-mult scan shared by every curve.
 
@@ -261,15 +299,10 @@ class MontCtx:
     # -- core ops -----------------------------------------------------------
 
     def mul(self, a, b):
-        """Montgomery product: returns a*b*R^-1 mod N."""
-        n = self.n
-        t = mul_full(a, b)  # (..., 2n)
-        m = mul_full(t[..., :n], jnp.asarray(self.Nprime))[..., :n]
-        mN = mul_full(m, jnp.asarray(self.N))  # (..., 2n)
-        s = carry_propagate(t + mN, 2 * n + 1)
-        r = s[..., n : 2 * n + 1]  # (..., n+1), value < 2N
-        d, borrow = sub_borrow(r, jnp.asarray(self.N_ext))
-        return select(borrow, r, d)[..., :n]
+        """Montgomery product a*b*R^-1 mod N — the k=1 case of
+        :meth:`redc_cols`: 4 sequential carry chains instead of the naive
+        five (three normalized mul_fulls + accumulate + subtract)."""
+        return self.redc_cols(mul_columns(a, b))
 
     def square(self, a):
         return self.mul(a, a)

@@ -11,9 +11,10 @@ jitted call:
   (Algorithm 4, a = -3) in homogeneous projective coordinates — branch-free
   and identity-safe, exactly what XLA wants: one straight-line formula for
   add, double, and infinity alike.
-* Double-scalar multiplication u1*G + u2*Q: Strauss–Shamir interleaving as
-  a single ``lax.scan`` over 256 bits, one table gather + one complete
-  addition per bit.  No data-dependent control flow anywhere.
+* Double-scalar multiplication u1*G + u2*Q: 2-bit-windowed Strauss–Shamir
+  as a single ``lax.scan`` over 128 digit pairs — two doublings, one gather
+  from the 16-entry joint table {i*G + j*Q}, one complete addition per
+  digit.  No data-dependent control flow anywhere.
 
 Signing stays on the host (one signature per decision — never a hot path)
 with RFC 6979 deterministic nonces.
@@ -54,60 +55,71 @@ _INF_MONT = np.stack([FP.zero, FP.one_mont, FP.zero])
 # projective curve ops (points are (..., 3, NLIMBS) Montgomery-domain arrays)
 # ---------------------------------------------------------------------------
 
+def _grouped(op, pairs):
+    """Run independent binary field ops as ONE stacked call.
+
+    The Montgomery ops' sequential carry chains broadcast over leading
+    axes, so stacking k independent (a, b) pairs along a new axis shares
+    the chains: k ops for the sequential cost of one.
+    """
+    shape = jnp.broadcast_shapes(*(jnp.shape(x) for pr in pairs for x in pr))
+    a = jnp.stack([jnp.broadcast_to(x, shape) for x, _ in pairs])
+    b = jnp.stack([jnp.broadcast_to(y, shape) for _, y in pairs])
+    out = op(a, b)
+    return tuple(out[i] for i in range(len(pairs)))
+
+
 def point_add(p, q):
     """Complete addition, RCB15 Algorithm 4 (a = -3).
 
     Valid for every input pair: distinct points, doubling, and the identity
-    (0 : 1 : 0).  12 field mults + 2 mults by b + 29 add/subs.
+    (0 : 1 : 0).  12 field mults + 2 mults by b + 29 add/subs — but
+    level-scheduled: independent ops stack into single grouped Montgomery
+    calls (4 mul groups + 11 add/sub groups of sequential depth), ~3x
+    fewer carry chains than executing the algorithm's 43 ops in sequence.
+    The math is the original sequence SSA-renamed; nothing is reordered
+    across a data dependency.
     """
     f = FP
     b_m = jnp.asarray(_B_MONT)
     x1, y1, z1 = p[..., 0, :], p[..., 1, :], p[..., 2, :]
     x2, y2, z2 = q[..., 0, :], q[..., 1, :], q[..., 2, :]
 
-    t0 = f.mul(x1, x2)
-    t1 = f.mul(y1, y2)
-    t2 = f.mul(z1, z2)
-    t3 = f.add(x1, y1)
-    t4 = f.add(x2, y2)
-    t3 = f.mul(t3, t4)
-    t4 = f.add(t0, t1)
-    t3 = f.sub(t3, t4)
-    t4 = f.add(y1, z1)
-    x3 = f.add(y2, z2)
-    t4 = f.mul(t4, x3)
-    x3 = f.add(t1, t2)
-    t4 = f.sub(t4, x3)
-    x3 = f.add(x1, z1)
-    y3 = f.add(x2, z2)
-    x3 = f.mul(x3, y3)
-    y3 = f.add(t0, t2)
-    y3 = f.sub(x3, y3)
-    z3 = f.mul(b_m, t2)
-    x3 = f.sub(y3, z3)
-    z3 = f.add(x3, x3)
-    x3 = f.add(x3, z3)
-    z3 = f.sub(t1, x3)
-    x3 = f.add(t1, x3)
-    y3 = f.mul(b_m, y3)
-    t1 = f.add(t2, t2)
-    t2 = f.add(t1, t2)
-    y3 = f.sub(y3, t2)
-    y3 = f.sub(y3, t0)
-    t1 = f.add(y3, y3)
-    y3 = f.add(t1, y3)
-    t1 = f.add(t0, t0)
-    t0 = f.add(t1, t0)
-    t0 = f.sub(t0, t2)
-    t1 = f.mul(t4, y3)
-    t2 = f.mul(t0, y3)
-    y3 = f.mul(x3, z3)
-    y3 = f.add(y3, t2)
-    x3 = f.mul(t3, x3)
-    x3 = f.sub(x3, t1)
-    z3 = f.mul(t4, z3)
-    t1 = f.mul(t3, t0)
-    z3 = f.add(z3, t1)
+    # L1: cross-term preadds
+    a1, a2, a4, a5, a7, a8 = _grouped(
+        f.add, [(x1, y1), (x2, y2), (y1, z1), (y2, z2), (x1, z1), (x2, z2)]
+    )
+    # L2: all six products of the inputs
+    t0, t1, t2, m1, m2, m3 = _grouped(
+        f.mul, [(x1, x2), (y1, y2), (z1, z2), (a1, a2), (a4, a5), (a7, a8)]
+    )
+    # L3: pair sums + first doublings
+    a3, a6, a9, u1, w1 = _grouped(
+        f.add, [(t0, t1), (t1, t2), (t0, t2), (t2, t2), (t0, t0)]
+    )
+    # L4: Karatsuba recoveries
+    t3, t4, y3a = _grouped(f.sub, [(m1, a3), (m2, a6), (m3, a9)])
+    u2, w2 = _grouped(f.add, [(u1, t2), (w1, t0)])  # 3*t2, 3*t0
+    # L5: the two b-multiples
+    zb, yb = _grouped(f.mul, [(b_m, t2), (b_m, y3a)])
+    # L6
+    x3a, t0b, y3b = _grouped(f.sub, [(y3a, zb), (w2, u2), (yb, u2)])
+    # L7
+    z3a = f.add(x3a, x3a)
+    y3c = f.sub(y3b, t0)
+    # L8
+    x3b, v1 = _grouped(f.add, [(x3a, z3a), (y3c, y3c)])
+    # L9
+    x3c, y3d = _grouped(f.add, [(t1, x3b), (v1, y3c)])
+    z3b = f.sub(t1, x3b)
+    # L10: all six closing products
+    p1, p2, p3, p4, p5, p6 = _grouped(
+        f.mul,
+        [(t4, y3d), (t0b, y3d), (x3c, z3b), (t3, x3c), (t4, z3b), (t3, t0b)],
+    )
+    # L11
+    y3, z3 = _grouped(f.add, [(p3, p2), (p5, p6)])
+    x3 = f.sub(p4, p1)
     return jnp.stack([x3, y3, z3], axis=-2)
 
 
@@ -121,16 +133,26 @@ def is_on_curve(xm, ym):
     return bn.eq(lhs, rhs)
 
 
-def shamir_double_scalar(u1_bits, u2_bits, q):
-    """u1*G + u2*Q with one scan: per bit, 1 doubling + 1 table add.
+def shamir_double_scalar(u1, u2, q):
+    """u1*G + u2*Q, 2-bit-windowed Shamir: 128 digits x (2 dbl + 1 add).
 
-    u1_bits/u2_bits: (..., 256) MSB-first; q: (..., 3, NLIMBS) Mont domain.
+    u1/u2: (..., NLIMBS) standard-domain scalars; q: (..., 3, NLIMBS) Mont
+    domain.  The 16-entry joint table {i*G + j*Q} builds in three stacked
+    point_add depths (the 16 combination adds share ONE grouped call).
     """
     g = jnp.broadcast_to(jnp.asarray(_G_MONT), q.shape)
     inf = jnp.broadcast_to(jnp.asarray(_INF_MONT), q.shape)
-    gq = point_add(g, q)
-    table = jnp.stack([inf, g, q, gq], axis=-3)  # (..., 4, 3, n)
-    return bn.shamir_scan(point_add, table, inf, u1_bits, u2_bits)
+    two = point_add(jnp.stack([g, q]), jnp.stack([g, q]))
+    three = point_add(two, jnp.stack([g, q]))
+    gs = [inf, g, two[0], three[0]]
+    qs = [inf, q, two[1], three[1]]
+    lhs = jnp.stack([gs[i] for i in range(4) for _ in range(4)], axis=-3)
+    rhs = jnp.stack([qs[j] for _ in range(4) for j in range(4)], axis=-3)
+    table = point_add(lhs, rhs)  # (..., 16, 3, n); entry 4i+j = i*G + j*Q
+    return bn.shamir_scan_w(
+        point_add, table, inf,
+        bn.digits_msb(u1, 128, 2), bn.digits_msb(u2, 128, 2), width=2,
+    )
 
 
 def ecdsa_verify_kernel(e, r, s, qx, qy):
@@ -160,7 +182,7 @@ def ecdsa_verify_kernel(e, r, s, qx, qy):
     oncurve = is_on_curve(xm, ym)
     qpt = jnp.stack([xm, ym, jnp.broadcast_to(jnp.asarray(FP.one_mont), xm.shape)],
                     axis=-2)
-    acc = shamir_double_scalar(bn.bits_msb(u1, 256), bn.bits_msb(u2, 256), qpt)
+    acc = shamir_double_scalar(u1, u2, qpt)
 
     xr, zr = acc[..., 0, :], acc[..., 2, :]
     not_inf = jnp.uint32(1) - bn.is_zero(zr)
