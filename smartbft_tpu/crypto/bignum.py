@@ -150,6 +150,21 @@ def bits_msb(a, nbits: int):
     return (a[..., limb] >> jnp.asarray(off, DTYPE)) & jnp.uint32(1)
 
 
+def grouped(op, pairs):
+    """Run independent binary field ops as ONE stacked call.
+
+    The Montgomery ops' sequential carry chains broadcast over leading
+    axes, so stacking k independent (a, b) pairs along a new axis shares
+    the chains: k ops for the sequential cost of one.  This is the
+    level-scheduling primitive behind the fast curve formulas.
+    """
+    shape = jnp.broadcast_shapes(*(jnp.shape(x) for pr in pairs for x in pr))
+    a = jnp.stack([jnp.broadcast_to(x, shape) for x, _ in pairs])
+    b = jnp.stack([jnp.broadcast_to(y, shape) for _, y in pairs])
+    out = op(a, b)
+    return tuple(out[i] for i in range(len(pairs)))
+
+
 def digits_msb(a, ndigits: int, width: int = 2):
     """Fixed-width digit decomposition, most-significant digit first.
 
@@ -159,6 +174,14 @@ def digits_msb(a, ndigits: int, width: int = 2):
     bits = bits.reshape(bits.shape[:-1] + (ndigits, width))
     weights = jnp.asarray([1 << (width - 1 - k) for k in range(width)], DTYPE)
     return jnp.sum(bits * weights, axis=-1, dtype=DTYPE)
+
+
+def joint_table(point_add, ps, qs):
+    """Cross-join table for :func:`shamir_scan_w`: entry len(qs)*i + j is
+    ps[i] + qs[j], all combination adds in ONE stacked point_add call."""
+    lhs = jnp.stack([p for p in ps for _ in qs], axis=-3)
+    rhs = jnp.stack([q for _ in ps for q in qs], axis=-3)
+    return point_add(lhs, rhs)
 
 
 def shamir_scan_w(point_add, table, ident, d1, d2, width: int = 2):

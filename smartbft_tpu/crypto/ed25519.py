@@ -14,9 +14,11 @@ here a whole quorum of EdDSA votes is ONE jitted kernel launch:
   the formula is complete: one branch-free straight-line block covers
   addition, doubling, and the identity — ideal for XLA.
 * Verification equation (cofactorless, as in Go's crypto/ed25519):
-  [S]B == R + [h]A, evaluated as [S]B + [h](-A) == R with Strauss-Shamir
-  interleaving: a single ``lax.scan`` over 253 bits, one table gather + one
-  unified addition per bit.
+  [S]B == R + [h]A, evaluated as [S]B + [h](-A) == R with 2-bit-windowed
+  Strauss-Shamir interleaving: a single ``lax.scan`` over 127 digit pairs —
+  two doublings, one gather from the 16-entry joint table {iB + j(-A)}
+  (the B multiples are host-precomputed constants), one unified addition
+  per digit.
 
 Hashing (SHA-512) and point decompression are host-side marshalling —
 exactly like SHA-256 digesting in the P-256 path; the kernel re-checks both
@@ -50,13 +52,30 @@ NLIMBS = 16
 FP = MontCtx(P, NLIMBS)
 FL = MontCtx(L, NLIMBS)
 
-SCALAR_BITS = 253  # L < 2^253
-
 _D_MONT = FP.encode(D)
 _D2_MONT = FP.encode((2 * D) % P)
-_B_MONT = np.stack([
-    FP.encode(BX), FP.encode(BY), FP.one_mont, FP.encode(BX * BY % P)
-])
+
+
+def _aff_add(p1, p2):
+    """Host affine Edwards addition (for the fixed-base table constants)."""
+    x1, y1 = p1
+    x2, y2 = p2
+    den = D * x1 * x2 * y1 * y2 % P
+    return ((x1 * y2 + x2 * y1) * pow(1 + den, -1, P) % P,
+            (y1 * y2 + x1 * x2) * pow(1 - den, -1, P) % P)
+
+
+def _ext_mont(x: int, y: int) -> np.ndarray:
+    """Host affine ints -> extended (X:Y:1:XY) Montgomery limb stack."""
+    return np.stack([FP.encode(x), FP.encode(y), FP.one_mont,
+                     FP.encode(x * y % P)])
+
+
+_B2_AFF = _aff_add((BX, BY), (BX, BY))
+_B3_AFF = _aff_add(_B2_AFF, (BX, BY))
+_B_MONT = _ext_mont(BX, BY)
+_B2_MONT = _ext_mont(*_B2_AFF)
+_B3_MONT = _ext_mont(*_B3_AFF)
 # identity in extended coordinates: (0 : 1 : 1 : 0)
 _ID_MONT = np.stack([FP.zero, FP.one_mont, FP.one_mont, FP.zero])
 
@@ -68,24 +87,27 @@ _ID_MONT = np.stack([FP.zero, FP.one_mont, FP.one_mont, FP.zero])
 def point_add(p, q):
     """Unified addition, add-2008-hwcd-3 (a = -1).  Complete on this curve.
 
-    8 field mults + 1 mult by the 2d constant.
+    8 field mults + 1 mult by the 2d constant — level-scheduled: the
+    independent ops of each dataflow level stack into single grouped
+    Montgomery calls (3 mul groups + 4 add/sub groups of sequential
+    depth; see :func:`bignum.grouped`).
     """
     f = FP
     x1, y1, z1, t1 = (p[..., i, :] for i in range(4))
     x2, y2, z2, t2 = (q[..., i, :] for i in range(4))
 
-    a = f.mul(f.sub(y1, x1), f.sub(y2, x2))
-    b = f.mul(f.add(y1, x1), f.add(y2, x2))
-    c = f.mul(f.mul(t1, jnp.asarray(_D2_MONT)), t2)
-    d = f.mul(f.dbl(z1), z2)
-    e = f.sub(b, a)
-    ff = f.sub(d, c)
-    g = f.add(d, c)
-    h = f.add(b, a)
-    x3 = f.mul(e, ff)
-    y3 = f.mul(g, h)
-    t3 = f.mul(e, h)
-    z3 = f.mul(ff, g)
+    s1, s2 = bn.grouped(f.sub, [(y1, x1), (y2, x2)])
+    a1, a2, z1d = bn.grouped(f.add, [(y1, x1), (y2, x2), (z1, z1)])
+    a, b, c1, d = bn.grouped(
+        f.mul,
+        [(s1, s2), (a1, a2), (t1, jnp.asarray(_D2_MONT)), (z1d, z2)],
+    )
+    c = f.mul(c1, t2)
+    e, ff = bn.grouped(f.sub, [(b, a), (d, c)])
+    g, h = bn.grouped(f.add, [(d, c), (b, a)])
+    x3, y3, t3, z3 = bn.grouped(
+        f.mul, [(e, ff), (g, h), (e, h), (ff, g)]
+    )
     return jnp.stack([x3, y3, z3, t3], axis=-2)
 
 
@@ -113,16 +135,28 @@ def _extended(xm, ym):
     return jnp.stack([xm, ym, one, FP.mul(xm, ym)], axis=-2)
 
 
-def shamir_double_scalar(s_bits, h_bits, nega):
-    """[s]B + [h]*nega with one scan: per bit, 1 doubling + 1 table add.
+def shamir_double_scalar(s, h, nega):
+    """[s]B + [h]*nega, 2-bit-windowed Shamir: 127 digits x (2 dbl + 1 add).
 
-    s_bits/h_bits: (..., 253) MSB-first; nega: (..., 4, NLIMBS) Mont domain.
+    s/h: (..., NLIMBS) standard-domain scalars (< 2^253 < 2^254); nega:
+    (..., 4, NLIMBS) Mont domain.  B is fixed, so its window multiples are
+    host-precomputed constants; the -A multiples build in two point_add
+    depths and the 16 combination adds share ONE grouped call.
     """
-    b = jnp.broadcast_to(jnp.asarray(_B_MONT), nega.shape)
     ident = jnp.broadcast_to(jnp.asarray(_ID_MONT), nega.shape)
-    b_na = point_add(b, nega)
-    table = jnp.stack([ident, b, nega, b_na], axis=-3)  # (..., 4, 4, n)
-    return bn.shamir_scan(point_add, table, ident, s_bits, h_bits)
+    bs = [ident] + [
+        jnp.broadcast_to(jnp.asarray(c), nega.shape)
+        for c in (_B_MONT, _B2_MONT, _B3_MONT)
+    ]
+    na2 = point_add(nega, nega)
+    na3 = point_add(na2, nega)
+    table = bn.joint_table(
+        point_add, bs, [ident, nega, na2, na3]
+    )  # (..., 16, 4, n); entry 4i+j = iB + j*nega
+    return bn.shamir_scan_w(
+        point_add, table, ident,
+        bn.digits_msb(s, 127, 2), bn.digits_msb(h, 127, 2), width=2,
+    )
 
 
 def eddsa_verify_kernel(s, h, rx, ry, ax, ay, ok_in):
@@ -144,9 +178,8 @@ def eddsa_verify_kernel(s, h, rx, ry, ax, ay, ok_in):
     oncurve = is_on_curve(rxm, rym) * is_on_curve(axm, aym)
 
     nega = point_neg(_extended(axm, aym))
-    acc = shamir_double_scalar(
-        bn.bits_msb(s, SCALAR_BITS), bn.bits_msb(h, SCALAR_BITS), nega
-    )  # [s]B - [h]A, extended coords; Z != 0 by completeness
+    acc = shamir_double_scalar(s, h, nega)
+    # [s]B - [h]A, extended coords; Z != 0 by completeness
 
     xz = acc[..., 0, :]
     yz = acc[..., 1, :]
@@ -159,14 +192,8 @@ def eddsa_verify_kernel(s, h, rx, ry, ax, ay, ok_in):
 # host-side reference arithmetic (Python ints) — keygen, sign, CPU verify
 # ---------------------------------------------------------------------------
 
-def _edwards_add_int(p1, p2):
-    """Affine Edwards addition over GF(P); (0, 1) is the identity."""
-    x1, y1 = p1
-    x2, y2 = p2
-    den = D * x1 * x2 * y1 * y2 % P
-    x3 = (x1 * y2 + x2 * y1) * pow(1 + den, -1, P) % P
-    y3 = (y1 * y2 + x1 * x2) * pow(1 - den, -1, P) % P
-    return (x3, y3)
+# affine Edwards addition over GF(P); (0, 1) is the identity
+_edwards_add_int = _aff_add
 
 
 def scalar_mult_int(k: int, point):

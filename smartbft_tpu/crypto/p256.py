@@ -55,20 +55,6 @@ _INF_MONT = np.stack([FP.zero, FP.one_mont, FP.zero])
 # projective curve ops (points are (..., 3, NLIMBS) Montgomery-domain arrays)
 # ---------------------------------------------------------------------------
 
-def _grouped(op, pairs):
-    """Run independent binary field ops as ONE stacked call.
-
-    The Montgomery ops' sequential carry chains broadcast over leading
-    axes, so stacking k independent (a, b) pairs along a new axis shares
-    the chains: k ops for the sequential cost of one.
-    """
-    shape = jnp.broadcast_shapes(*(jnp.shape(x) for pr in pairs for x in pr))
-    a = jnp.stack([jnp.broadcast_to(x, shape) for x, _ in pairs])
-    b = jnp.stack([jnp.broadcast_to(y, shape) for _, y in pairs])
-    out = op(a, b)
-    return tuple(out[i] for i in range(len(pairs)))
-
-
 def point_add(p, q):
     """Complete addition, RCB15 Algorithm 4 (a = -3).
 
@@ -86,39 +72,39 @@ def point_add(p, q):
     x2, y2, z2 = q[..., 0, :], q[..., 1, :], q[..., 2, :]
 
     # L1: cross-term preadds
-    a1, a2, a4, a5, a7, a8 = _grouped(
+    a1, a2, a4, a5, a7, a8 = bn.grouped(
         f.add, [(x1, y1), (x2, y2), (y1, z1), (y2, z2), (x1, z1), (x2, z2)]
     )
     # L2: all six products of the inputs
-    t0, t1, t2, m1, m2, m3 = _grouped(
+    t0, t1, t2, m1, m2, m3 = bn.grouped(
         f.mul, [(x1, x2), (y1, y2), (z1, z2), (a1, a2), (a4, a5), (a7, a8)]
     )
     # L3: pair sums + first doublings
-    a3, a6, a9, u1, w1 = _grouped(
+    a3, a6, a9, u1, w1 = bn.grouped(
         f.add, [(t0, t1), (t1, t2), (t0, t2), (t2, t2), (t0, t0)]
     )
     # L4: Karatsuba recoveries
-    t3, t4, y3a = _grouped(f.sub, [(m1, a3), (m2, a6), (m3, a9)])
-    u2, w2 = _grouped(f.add, [(u1, t2), (w1, t0)])  # 3*t2, 3*t0
+    t3, t4, y3a = bn.grouped(f.sub, [(m1, a3), (m2, a6), (m3, a9)])
+    u2, w2 = bn.grouped(f.add, [(u1, t2), (w1, t0)])  # 3*t2, 3*t0
     # L5: the two b-multiples
-    zb, yb = _grouped(f.mul, [(b_m, t2), (b_m, y3a)])
+    zb, yb = bn.grouped(f.mul, [(b_m, t2), (b_m, y3a)])
     # L6
-    x3a, t0b, y3b = _grouped(f.sub, [(y3a, zb), (w2, u2), (yb, u2)])
+    x3a, t0b, y3b = bn.grouped(f.sub, [(y3a, zb), (w2, u2), (yb, u2)])
     # L7
     z3a = f.add(x3a, x3a)
     y3c = f.sub(y3b, t0)
     # L8
-    x3b, v1 = _grouped(f.add, [(x3a, z3a), (y3c, y3c)])
+    x3b, v1 = bn.grouped(f.add, [(x3a, z3a), (y3c, y3c)])
     # L9
-    x3c, y3d = _grouped(f.add, [(t1, x3b), (v1, y3c)])
+    x3c, y3d = bn.grouped(f.add, [(t1, x3b), (v1, y3c)])
     z3b = f.sub(t1, x3b)
     # L10: all six closing products
-    p1, p2, p3, p4, p5, p6 = _grouped(
+    p1, p2, p3, p4, p5, p6 = bn.grouped(
         f.mul,
         [(t4, y3d), (t0b, y3d), (x3c, z3b), (t3, x3c), (t4, z3b), (t3, t0b)],
     )
     # L11
-    y3, z3 = _grouped(f.add, [(p3, p2), (p5, p6)])
+    y3, z3 = bn.grouped(f.add, [(p3, p2), (p5, p6)])
     x3 = f.sub(p4, p1)
     return jnp.stack([x3, y3, z3], axis=-2)
 
@@ -144,11 +130,9 @@ def shamir_double_scalar(u1, u2, q):
     inf = jnp.broadcast_to(jnp.asarray(_INF_MONT), q.shape)
     two = point_add(jnp.stack([g, q]), jnp.stack([g, q]))
     three = point_add(two, jnp.stack([g, q]))
-    gs = [inf, g, two[0], three[0]]
-    qs = [inf, q, two[1], three[1]]
-    lhs = jnp.stack([gs[i] for i in range(4) for _ in range(4)], axis=-3)
-    rhs = jnp.stack([qs[j] for _ in range(4) for j in range(4)], axis=-3)
-    table = point_add(lhs, rhs)  # (..., 16, 3, n); entry 4i+j = i*G + j*Q
+    table = bn.joint_table(
+        point_add, [inf, g, two[0], three[0]], [inf, q, two[1], three[1]]
+    )  # (..., 16, 3, n); entry 4i+j = i*G + j*Q
     return bn.shamir_scan_w(
         point_add, table, inf,
         bn.digits_msb(u1, 128, 2), bn.digits_msb(u2, 128, 2), width=2,
