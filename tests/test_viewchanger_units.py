@@ -1,0 +1,218 @@
+"""Unit matrix for the view changer's pure decision functions.
+
+Models the reference's largest unit suite (viewchanger_test.go, 23 tests):
+ViewData validation ladders, the agreed-in-flight decision rule
+(CheckInFlight conditions A and B, viewchanger.go:813-908), and last-
+decision quorum validation (viewchanger.go:681-727).
+"""
+
+import asyncio
+
+import pytest
+
+from smartbft_tpu.codec import encode
+from smartbft_tpu.core.viewchanger import (
+    check_in_flight,
+    max_last_decision_sequence,
+    validate_in_flight,
+    validate_last_decision,
+)
+from smartbft_tpu.messages import Proposal, Signature, ViewData, ViewMetadata
+
+
+def proposal(seq: int, view: int = 0, payload: bytes = b"batch") -> Proposal:
+    return Proposal(
+        payload=payload,
+        metadata=encode(ViewMetadata(view_id=view, latest_sequence=seq)),
+    )
+
+
+def sigs(*signers: int) -> list[Signature]:
+    return [Signature(signer=s, value=b"v", msg=b"aux-%d" % s) for s in signers]
+
+
+class FakeVerifier:
+    """Batch verifier: aux for good signers, None for bad ones."""
+
+    def __init__(self, bad_signers=()):
+        self.bad = set(bad_signers)
+
+    def verify_consenter_sigs_batch(self, signatures, prop):
+        return [None if s.signer in self.bad else s.msg for s in signatures]
+
+
+# -- validate_in_flight ------------------------------------------------------
+
+def test_in_flight_none_is_valid():
+    validate_in_flight(None, 5)
+
+
+def test_in_flight_nil_metadata_rejected():
+    with pytest.raises(ValueError, match="metadata is nil"):
+        validate_in_flight(Proposal(payload=b"x"), 5)
+
+
+def test_in_flight_wrong_sequence_rejected():
+    with pytest.raises(ValueError, match="sequence is 7"):
+        validate_in_flight(proposal(7), 5)
+
+
+def test_in_flight_next_sequence_accepted():
+    validate_in_flight(proposal(6), 5)
+
+
+# -- max_last_decision_sequence ---------------------------------------------
+
+def test_max_sequence_over_mixed_view_data():
+    msgs = [
+        ViewData(next_view=1, last_decision=proposal(3)),
+        ViewData(next_view=1, last_decision=Proposal()),  # genesis: skipped
+        ViewData(next_view=1, last_decision=proposal(7)),
+    ]
+    assert max_last_decision_sequence(msgs) == 7
+
+
+def test_max_sequence_missing_decision_rejected():
+    with pytest.raises(ValueError, match="not set"):
+        max_last_decision_sequence([ViewData(next_view=1)])
+
+
+# -- validate_last_decision --------------------------------------------------
+
+def run_validate(vd, quorum=3, n=4, verifier=None):
+    return asyncio.run(
+        validate_last_decision(vd, quorum, n, verifier or FakeVerifier())
+    )
+
+
+def test_last_decision_genesis_returns_zero():
+    vd = ViewData(next_view=1, last_decision=Proposal())
+    assert run_validate(vd) == 0
+
+
+def test_last_decision_missing_rejected():
+    with pytest.raises(ValueError, match="not set"):
+        run_validate(ViewData(next_view=1))
+
+
+def test_last_decision_from_future_view_rejected():
+    vd = ViewData(next_view=1, last_decision=proposal(3, view=1),
+                  last_decision_signatures=sigs(1, 2, 3))
+    with pytest.raises(ValueError, match="greater or equal"):
+        run_validate(vd)
+
+
+def test_last_decision_too_few_signatures_rejected():
+    vd = ViewData(next_view=1, last_decision=proposal(3),
+                  last_decision_signatures=sigs(1, 2))
+    with pytest.raises(ValueError, match="only 2 last decision signatures"):
+        run_validate(vd)
+
+
+def test_last_decision_duplicate_signers_not_counted_twice():
+    vd = ViewData(next_view=1, last_decision=proposal(3),
+                  last_decision_signatures=sigs(1, 2, 2))
+    # 3 signatures pass the count gate, but only 2 unique -> below quorum
+    with pytest.raises(ValueError, match="only 2 valid"):
+        run_validate(vd)
+
+
+def test_last_decision_invalid_signature_rejected():
+    vd = ViewData(next_view=1, last_decision=proposal(3),
+                  last_decision_signatures=sigs(1, 2, 3))
+    with pytest.raises(ValueError, match="invalid"):
+        run_validate(vd, verifier=FakeVerifier(bad_signers={2}))
+
+
+def test_last_decision_valid_quorum_returns_sequence():
+    vd = ViewData(next_view=1, last_decision=proposal(9),
+                  last_decision_signatures=sigs(1, 2, 3))
+    assert run_validate(vd) == 9
+
+
+# -- check_in_flight ---------------------------------------------------------
+# n=4: f=1, quorum=3.  Expected in-flight sequence = max last decision + 1.
+
+def vd_with(last_seq: int, in_flight=None, prepared=False) -> ViewData:
+    return ViewData(
+        next_view=1,
+        last_decision=proposal(last_seq),
+        in_flight_proposal=in_flight,
+        in_flight_prepared=prepared,
+    )
+
+
+def check(msgs):
+    return check_in_flight(msgs, f=1, quorum=3, n=4, verifier=FakeVerifier())
+
+
+def test_condition_b_quorum_says_nothing_in_flight():
+    ok, none_in_flight, prop = check([vd_with(5), vd_with(5), vd_with(5)])
+    assert (ok, none_in_flight, prop) == (True, True, None)
+
+
+def test_condition_a_agreed_prepared_proposal():
+    p = proposal(6)
+    msgs = [
+        vd_with(5, in_flight=p, prepared=True),
+        vd_with(5, in_flight=p, prepared=True),
+        vd_with(5),  # no argument
+    ]
+    ok, none_in_flight, prop = check(msgs)
+    assert ok and not none_in_flight and prop == p
+
+
+def test_no_decision_when_witnesses_below_quorum():
+    p = proposal(6)
+    msgs = [
+        vd_with(5, in_flight=p, prepared=True),
+        vd_with(5, in_flight=p, prepared=True),
+    ]
+    # A2 holds (2 >= f+1) but A1 fails (2 < quorum); B fails (0 < quorum)
+    assert check(msgs) == (False, False, None)
+
+
+def test_stale_in_flight_counts_as_no_argument():
+    stale = proposal(5)  # at the already-decided sequence
+    msgs = [vd_with(5, in_flight=stale, prepared=True), vd_with(5), vd_with(5)]
+    ok, none_in_flight, prop = check(msgs)
+    assert (ok, none_in_flight, prop) == (True, True, None)
+
+
+def test_unprepared_in_flight_counts_as_no_argument():
+    p = proposal(6)
+    msgs = [vd_with(5, in_flight=p, prepared=False), vd_with(5), vd_with(5)]
+    ok, none_in_flight, prop = check(msgs)
+    assert (ok, none_in_flight, prop) == (True, True, None)
+
+
+def test_in_flight_nil_metadata_raises():
+    msgs = [vd_with(5, in_flight=Proposal(payload=b"x"), prepared=True),
+            vd_with(5), vd_with(5)]
+    with pytest.raises(ValueError, match="nil metadata"):
+        check(msgs)
+
+
+def test_competing_proposals_neither_reaches_quorum():
+    p1, p2 = proposal(6, payload=b"a"), proposal(6, payload=b"b")
+    msgs = [
+        vd_with(5, in_flight=p1, prepared=True),
+        vd_with(5, in_flight=p1, prepared=True),
+        vd_with(5, in_flight=p2, prepared=True),
+        vd_with(5, in_flight=p2, prepared=True),
+    ]
+    # each has 2 preprepared witnesses (>= f+1) but only 2 no-argument
+    # votes (< quorum); and only 0 say nothing-in-flight
+    assert check(msgs) == (False, False, None)
+
+
+def test_agreed_proposal_with_mixed_supporters():
+    p = proposal(6)
+    msgs = [
+        vd_with(5, in_flight=p, prepared=True),
+        vd_with(5, in_flight=p, prepared=True),
+        vd_with(5),                                  # abstains: no argument
+        vd_with(5, in_flight=proposal(5), prepared=True),  # stale: no argument
+    ]
+    ok, none_in_flight, prop = check(msgs)
+    assert ok and not none_in_flight and prop == p
