@@ -165,6 +165,15 @@ def grouped(op, pairs):
     return tuple(out[i] for i in range(len(pairs)))
 
 
+def grouped1(op, items):
+    """Unary sibling of :func:`grouped` — k independent one-operand ops
+    (squarings, negations) stacked into one call sharing the carry chains."""
+    shape = jnp.broadcast_shapes(*(jnp.shape(x) for x in items))
+    a = jnp.stack([jnp.broadcast_to(x, shape) for x in items])
+    out = op(a)
+    return tuple(out[i] for i in range(len(items)))
+
+
 def digits_msb(a, ndigits: int, width: int = 2):
     """Fixed-width digit decomposition, most-significant digit first.
 
@@ -184,7 +193,8 @@ def joint_table(point_add, ps, qs):
     return point_add(lhs, rhs)
 
 
-def shamir_scan_w(point_add, table, ident, d1, d2, width: int = 2):
+def shamir_scan_w(point_add, table, ident, d1, d2, width: int = 2,
+                  point_double=None):
     """Windowed Strauss–Shamir double-scalar mult.
 
     Per digit: ``width`` doublings + one gather + one addition — for w=2
@@ -192,15 +202,19 @@ def shamir_scan_w(point_add, table, ident, d1, d2, width: int = 2):
     fewer sequential point operations.  ``table`` is (..., 4**width, C, n)
     with entry i * 2**width + j holding i*P1 + j*P2; d1/d2 are
     (..., ndigits) MSB-first digits from :func:`digits_msb`.
-    ``point_add`` must be complete (identity-safe).
+    ``point_add`` must be complete (identity-safe); ``point_double``, when
+    given, must be a complete dedicated doubling (cheaper than the general
+    addition — squarings replace cross products).
     """
+    dbl = point_double if point_double is not None else (
+        lambda p: point_add(p, p))
     xs = (jnp.moveaxis(d1, -1, 0), jnp.moveaxis(d2, -1, 0))
     base = jnp.uint32(1 << width)
 
     def step(acc, ds):
         i, j = ds
         for _ in range(width):
-            acc = point_add(acc, acc)
+            acc = dbl(acc)
         idx = (i * base + j).astype(jnp.int32)
         sel = jnp.take_along_axis(
             table, idx[..., None, None, None], axis=-3
@@ -257,6 +271,28 @@ def mul_columns(a, b):
         p = a[..., i : i + 1] * b
         acc = acc.at[..., i : i + n].add(p & LIMB_MASK)
         acc = acc.at[..., i + 1 : i + n + 1].add(p >> LIMB_BITS)
+    return acc
+
+
+def square_columns(a):
+    """Raw squaring columns: (..., n) -> (..., 2n) UNNORMALIZED.
+
+    Same contract as :func:`mul_columns` with b = a, but computes only the
+    n(n+1)/2 upper-triangle partial products and weights the off-diagonal
+    ones by 2 (the halves are doubled *after* the 16-bit split, so nothing
+    overflows a uint32 lane) — 136 lane-mults instead of 256 at n = 16.
+    Column sums stay < 2^23, well inside :func:`carry_propagate`'s budget,
+    and the output is valid input for :meth:`MontCtx.redc_cols`.
+    """
+    n = a.shape[-1]
+    acc = jnp.zeros(a.shape[:-1] + (2 * n,), DTYPE)
+    for i in range(n):
+        row = a[..., i : i + 1] * a[..., i:]  # j = i..n-1 -> column i+j
+        w = np.full(n - i, 2, dtype=np.uint32)
+        w[0] = 1  # the diagonal term a_i^2 counts once
+        wj = jnp.asarray(w)
+        acc = acc.at[..., 2 * i : i + n].add((row & LIMB_MASK) * wj)
+        acc = acc.at[..., 2 * i + 1 : i + n + 1].add((row >> LIMB_BITS) * wj)
     return acc
 
 
@@ -328,7 +364,9 @@ class MontCtx:
         return self.redc_cols(mul_columns(a, b))
 
     def square(self, a):
-        return self.mul(a, a)
+        """Montgomery square via :func:`square_columns` — ~47% fewer lane
+        mults than :meth:`mul`; same 4 sequential carry chains."""
+        return self.redc_cols(square_columns(a))
 
     def redc_cols(self, cols):
         """Montgomery-reduce raw product columns: (..., 2n) -> (..., n) < N.
@@ -381,27 +419,55 @@ class MontCtx:
 
     # -- exponentiation (static exponent) ------------------------------------
 
-    def exp(self, a, e: int):
+    def exp(self, a, e: int, window: int = 4):
         """a^e mod N for a *static* Python-int exponent; a in Mont domain.
 
-        Square-and-multiply as a ``lax.scan`` over the exponent's bits
-        (MSB first) so the compiled graph stays small.
+        Fixed-window exponentiation as a ``lax.scan`` over the exponent's
+        base-2^w digits (MSB first): w cheap squarings + one gather from
+        the 2^w-entry power table + one multiply per digit.  Digit 0
+        gathers a^0 = 1~ whose Montgomery product is the identity, so the
+        body needs no select.  Versus bitwise square-and-multiply this
+        trades 256 always-on multiplies for ~64 + a 14-mult table build.
         """
         if e < 0:
             raise ValueError("negative exponent")
-        nbits = max(e.bit_length(), 1)
-        bits = np.array(
-            [(e >> i) & 1 for i in range(nbits - 1, -1, -1)], dtype=np.uint32
-        )
         one = jnp.broadcast_to(jnp.asarray(self.one_mont), a.shape)
+        if e == 0:
+            return one
+        if e.bit_length() <= window:  # tiny exponent: straightline
+            out = a
+            for bit in bin(e)[3:]:
+                out = self.square(out)
+                if bit == "1":
+                    out = self.mul(out, a)
+            return out
 
-        def step(acc, bit):
-            acc = self.mul(acc, acc)
-            acc = select(bit * jnp.ones(acc.shape[:-1], DTYPE),
-                         self.mul(acc, a), acc)
-            return acc, None
+        # power table a^0 .. a^(2^w - 1), built in log depth with grouped
+        # calls: each round squares/multiplies everything derivable so far.
+        pows: list = [one, a]
+        while len(pows) < (1 << window):
+            have = len(pows)
+            take = min(have - 1, (1 << window) - have)
+            new = grouped(self.mul, [(pows[have - 1], pows[i + 1])
+                                     for i in range(take)])
+            pows.extend(new)
+        table = jnp.stack(pows, axis=-2)  # (..., 2^w, n)
 
-        out, _ = lax.scan(step, one, jnp.asarray(bits))
+        ndig = (e.bit_length() + window - 1) // window
+        digs = np.array(
+            [(e >> (window * i)) & ((1 << window) - 1)
+             for i in range(ndig - 1, -1, -1)], dtype=np.int32,
+        )
+
+        def step(acc, dig):
+            for _ in range(window):
+                acc = self.square(acc)
+            sel = jnp.take(table, dig, axis=-2)  # digit is batch-uniform
+            return self.mul(acc, sel), None
+
+        # first digit is nonzero (e > 0): seed with its table entry
+        acc0 = jnp.broadcast_to(table[..., int(digs[0]), :], a.shape)
+        out, _ = lax.scan(step, acc0, jnp.asarray(digs[1:]))
         return out
 
     def inv(self, a):

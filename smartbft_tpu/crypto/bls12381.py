@@ -850,7 +850,7 @@ class DeviceFp:
         return self.ctx.mul(a, b)
 
     def sqr(self, a):
-        return self.ctx.mul(a, a)
+        return self.ctx.square(a)  # square_columns: ~47% fewer lane mults
 
     def neg(self, a):
         return self.ctx.neg(a)

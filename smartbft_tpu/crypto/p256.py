@@ -109,6 +109,44 @@ def point_add(p, q):
     return jnp.stack([x3, y3, z3], axis=-2)
 
 
+def point_double(p):
+    """Complete doubling, RCB15 Algorithm 6 (a = -3).
+
+    Valid for every input, including the identity.  8M + 3S + 2 mults by b
+    versus the general addition's 12M + 2mb — and the squarings go through
+    :func:`bignum.square_columns` at ~half the lane-mult cost.  Level-
+    scheduled like :func:`point_add`: 4 mul groups + 8 add/sub groups.
+    """
+    f = FP
+    b_m = jnp.asarray(_B_MONT)
+    x, y, z = p[..., 0, :], p[..., 1, :], p[..., 2, :]
+
+    t0, t1, t2 = bn.grouped1(f.square, [x, y, z])
+    xy, xz, yz = bn.grouped(f.mul, [(x, y), (x, z), (y, z)])
+    # doublings + first steps of the 3x chains
+    t3, z3a, yz2, t2a, t0a = bn.grouped(
+        f.add, [(xy, xy), (xz, xz), (yz, yz), (t2, t2), (t0, t0)]
+    )
+    t2_3, t0_3 = bn.grouped(f.add, [(t2a, t2), (t0a, t0)])
+    bt2, bz3 = bn.grouped(f.mul, [(b_m, t2), (b_m, z3a)])
+    y3a, z3b, t0d = bn.grouped(
+        f.sub, [(bt2, z3a), (bz3, t2_3), (t0_3, t2_3)]
+    )
+    y3a2 = f.add(y3a, y3a)
+    z3c = f.sub(z3b, t0)
+    y3b, z3c2 = bn.grouped(f.add, [(y3a2, y3a), (z3c, z3c)])
+    z3d, y3c = bn.grouped(f.add, [(z3c2, z3c), (t1, y3b)])
+    x3a = f.sub(t1, y3b)
+    y3d, x3b, t0b, zz, zt = bn.grouped(
+        f.mul,
+        [(x3a, y3c), (x3a, t3), (t0d, z3d), (yz2, z3d), (yz2, t1)],
+    )
+    y3, zt2 = bn.grouped(f.add, [(y3d, t0b), (zt, zt)])
+    x3 = f.sub(x3b, zz)
+    z3 = f.add(zt2, zt2)
+    return jnp.stack([x3, y3, z3], axis=-2)
+
+
 def is_on_curve(xm, ym):
     """y^2 == x^3 - 3x + b in Montgomery domain; (...,) uint32 mask."""
     f = FP
@@ -136,6 +174,7 @@ def shamir_double_scalar(u1, u2, q):
     return bn.shamir_scan_w(
         point_add, table, inf,
         bn.digits_msb(u1, 128, 2), bn.digits_msb(u2, 128, 2), width=2,
+        point_double=point_double,
     )
 
 
@@ -170,13 +209,20 @@ def ecdsa_verify_kernel(e, r, s, qx, qy):
 
     xr, zr = acc[..., 0, :], acc[..., 2, :]
     not_inf = jnp.uint32(1) - bn.is_zero(zr)
-    x_aff = FP.from_mont(FP.mul(xr, FP.inv(zr)))  # garbage if zr == 0; masked
-    # x mod n: p < 2n so one conditional subtract
-    d, borrow = bn.sub_borrow(x_aff, n_arr)
-    x_mod_n = bn.select(borrow, x_aff, d)
-
-    # r is already < n when r_ok; compare
-    match = bn.eq(x_mod_n, r)
+    # Projective comparison — no field inversion of zr.  With x_aff =
+    # xr/zr < p and p < 2n, "x_aff mod n == r" is exactly
+    # x_aff ∈ {r, r+n} ∩ [0, p), and each candidate c tests as
+    # c~ * zr == xr in the Montgomery domain (zr != 0 is masked above).
+    # This replaces a 256-bit Fermat inversion with four multiplies.
+    c = bn.add_raw(r, n_arr, NLIMBS + 1)
+    c_in_range = (c[..., NLIMBS] == 0).astype(jnp.uint32)
+    c16 = c[..., :NLIMBS]
+    _, c_borrow = bn.sub_borrow(c16, jnp.asarray(FP.N))
+    c_ok = c_in_range * c_borrow  # r + n < p
+    r2 = jnp.asarray(FP.R2)
+    r_m, c_m = bn.grouped(FP.mul, [(r, r2), (c16, r2)])
+    m_r, m_c = bn.grouped(FP.mul, [(r_m, zr), (c_m, zr)])
+    match = jnp.maximum(bn.eq(m_r, xr), c_ok * bn.eq(m_c, xr))
     return match * not_inf * r_ok * s_ok * oncurve
 
 
