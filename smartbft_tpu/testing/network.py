@@ -109,7 +109,18 @@ class Node:
         self.filters.clear()
 
     def _drops(self, peer: int) -> bool:
+        """Sender-side check: per-peer loss (disconnect_from) OR global loss.
+
+        Per-peer loss is consulted on the SENDER only, matching the
+        reference (network.go): DisconnectFrom(x) stops my sends to x but
+        x's messages still reach me unless x also disconnects.
+        """
         p = self.peer_loss_probability.get(peer, self.loss_probability if self.lossy else 0.0)
+        return p > 0 and self.rng.random() < p
+
+    def _drops_inbound(self, peer: int) -> bool:
+        """Receiver-side check: only the node-wide loss state applies."""
+        p = self.loss_probability if self.lossy else 0.0
         return p > 0 and self.rng.random() < p
 
 
@@ -151,7 +162,7 @@ class Network:
             if msg is None:
                 return
         # receiver-side faults
-        if dst._drops(source):
+        if dst._drops_inbound(source):
             return
         for f in dst.filters:
             if not f(msg, source):
@@ -163,6 +174,6 @@ class Network:
         dst = self.nodes.get(target)
         if src is None or dst is None:
             return
-        if src._drops(target) or dst._drops(source):
+        if src._drops(target) or dst._drops_inbound(source):
             return
         dst._offer("request", source, request)

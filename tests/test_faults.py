@@ -336,7 +336,7 @@ def test_blacklist_after_view_change(tmp_path):
                 timeout=240.0,
             )
 
-        for k in range(4):
+        for k in range(8):
             await drive(k)
             md = decode(
                 ViewMetadata, apps[1].ledger()[-1].proposal.metadata
