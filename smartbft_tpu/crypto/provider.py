@@ -26,6 +26,7 @@ Wire format of a consenter signature (Signature.msg): canonical encoding of
 from __future__ import annotations
 
 import asyncio
+import os
 import threading
 import time
 from dataclasses import dataclass
@@ -141,6 +142,12 @@ class JaxVerifyEngine:
         self.scheme = scheme
         self.pad_sizes = tuple(sorted(pad_sizes))
         self._kernel = jax.jit(scheme.verify_kernel)
+        # SMARTBFT_PALLAS=1 opts the P-256 path into the fused limb-major
+        # Pallas kernel (pallas_ecdsa.ecdsa_verify) — TPU only.
+        if os.environ.get("SMARTBFT_PALLAS") == "1" and scheme is p256:
+            from . import pallas_ecdsa
+
+            self._kernel = pallas_ecdsa.ecdsa_verify
         self._lock = threading.Lock()
         self.stats = VerifyStats()
 

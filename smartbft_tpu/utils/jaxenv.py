@@ -59,9 +59,18 @@ def force_cpu(virtual_devices: int | None = None) -> None:
                 file=sys.stderr,
             )
             return
+        cpu_entry = factories.get("cpu")
         for name in list(factories):
             if name != "cpu":
-                factories.pop(name, None)
+                if cpu_entry is not None:
+                    # Alias the name to the CPU factory instead of popping:
+                    # the platform stays "known" (pallas/checkify register
+                    # per-platform lowerings at import and hard-fail on
+                    # unknown names) but JAX_PLATFORMS=cpu means the entry
+                    # is never initialized, so nothing dials the tunnel.
+                    factories[name] = cpu_entry
+                else:  # pragma: no cover — defensive
+                    factories.pop(name, None)
     except ImportError as exc:
         print(
             f"smartbft_tpu.utils.jaxenv: cannot purge PJRT factories ({exc}); "
