@@ -120,9 +120,9 @@ def main() -> None:
         force_cpu()
     import jax
 
-    jax.config.update(
-        "jax_compilation_cache_dir", os.path.expanduser("~/.smartbft_jax_cache")
-    )
+    from smartbft_tpu.utils.jaxenv import enable_compile_cache
+
+    enable_compile_cache()
     import jax.numpy as jnp
 
     from smartbft_tpu.crypto import p256
@@ -150,7 +150,7 @@ def main() -> None:
 
         from smartbft_tpu.crypto import pallas_ecdsa
 
-        tile = int(os.environ.get("SMARTBFT_BENCH_TILE", "64"))
+        tile = int(os.environ.get("SMARTBFT_BENCH_TILE", "128"))
         kern = functools.partial(pallas_ecdsa.ecdsa_verify, tile=tile)
         try:
             t0 = time.perf_counter()

@@ -85,8 +85,10 @@ def batch_to_limbs(xs, nlimbs: int) -> np.ndarray:
 def carry_propagate(cols, out_len: int):
     """Normalize column sums (< 2^31 each) into 16-bit limbs.
 
-    ``cols``: (..., m) uint32.  Returns (..., out_len) with out_len >= m;
-    the caller guarantees the final carry is zero (bounded inputs).
+    ``cols``: (..., m) uint32.  Returns (..., out_len) with out_len >= m.
+    Any final carry out of limb out_len-1 is DISCARDED: callers either
+    bound their inputs so it is zero, or rely on the mod-2^(16*out_len)
+    truncation (redc_cols' m-computation does this deliberately).
     """
     m = cols.shape[-1]
     if out_len > m:

@@ -485,15 +485,20 @@ def _kernel(digs_ref, e_ref, r_ref, s_ref, qx_ref, qy_ref, out_ref,
 
 
 @functools.partial(jax.jit, static_argnames=("tile", "interpret"))
-def ecdsa_verify(e, r, s, qx, qy, tile: int = 64, interpret: bool = False):
+def ecdsa_verify(e, r, s, qx, qy, tile: int = 128, interpret: bool = False):
     """Batched P-256 ECDSA verify as one fused Pallas kernel.
 
     Inputs are the same (B, 16) standard-domain uint32 limb arrays as
     :func:`p256.ecdsa_verify_kernel`; returns the same (B,) mask.  The
     batch is transposed to limb-major once at the boundary and processed
-    in ``tile``-lane grid steps.
+    in ``tile``-lane grid steps.  ``tile`` must be a multiple of 128: the
+    batch axis fills the VPU lane dimension, and Mosaic requires block
+    last-dims to be whole multiples of the 128-lane register width.
     """
     from jax.experimental.pallas import tpu as pltpu
+
+    if tile % 128 and not interpret:
+        raise ValueError(f"tile must be a multiple of 128 lanes, got {tile}")
 
     bsz = e.shape[0]
     pad = (-bsz) % tile

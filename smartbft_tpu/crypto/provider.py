@@ -143,11 +143,31 @@ class JaxVerifyEngine:
         self.pad_sizes = tuple(sorted(pad_sizes))
         self._kernel = jax.jit(scheme.verify_kernel)
         # SMARTBFT_PALLAS=1 opts the P-256 path into the fused limb-major
-        # Pallas kernel (pallas_ecdsa.ecdsa_verify) — TPU only.
+        # Pallas kernel (pallas_ecdsa.ecdsa_verify) — TPU only.  The first
+        # call probes it; a Mosaic/compile failure (non-TPU backend, older
+        # toolchain) falls back to the XLA kernel instead of taking down the
+        # consensus verify path.
         if os.environ.get("SMARTBFT_PALLAS") == "1" and scheme is p256:
             from . import pallas_ecdsa
 
-            self._kernel = pallas_ecdsa.ecdsa_verify
+            xla_kernel = self._kernel
+
+            def probing_kernel(*arrays):
+                try:
+                    out = pallas_ecdsa.ecdsa_verify(*arrays)
+                except Exception as exc:  # noqa: BLE001 — lowering/compile/OOM
+                    import logging
+
+                    logging.getLogger("smartbft_tpu.crypto").warning(
+                        "pallas kernel unavailable (%s: %s); engine falls "
+                        "back to the XLA kernel", type(exc).__name__, exc,
+                    )
+                    self._kernel = xla_kernel
+                    return xla_kernel(*arrays)
+                self._kernel = pallas_ecdsa.ecdsa_verify
+                return out
+
+            self._kernel = probing_kernel
         self._lock = threading.Lock()
         self.stats = VerifyStats()
 

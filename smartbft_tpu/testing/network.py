@@ -115,7 +115,10 @@ class Node:
         reference (network.go): DisconnectFrom(x) stops my sends to x but
         x's messages still reach me unless x also disconnects.
         """
-        p = self.peer_loss_probability.get(peer, self.loss_probability if self.lossy else 0.0)
+        # max(): like the reference's independent r < q OR r < w checks, a
+        # per-peer probability never shields a peer from the global loss
+        p = max(self.peer_loss_probability.get(peer, 0.0),
+                self.loss_probability if self.lossy else 0.0)
         return p > 0 and self.rng.random() < p
 
     def _drops_inbound(self, peer: int) -> bool:

@@ -15,8 +15,43 @@ initialization (first ``jnp`` op / ``jax.devices()``), ideally right after
 
 from __future__ import annotations
 
+import hashlib
 import os
 import sys
+
+
+def cache_dir() -> str:
+    """Persistent-compilation-cache dir, keyed by a machine fingerprint.
+
+    XLA:CPU stores AOT-compiled code keyed only by the computation; loading
+    a cache entry compiled on a host with different CPU features (the
+    driver's machine vs this one) emits `cpu_aot_loader.cc` feature-mismatch
+    warnings and can SIGILL mid-suite.  Keying the directory by the host's
+    CPU-flags hash confines each cache to machines that can execute it.
+    """
+    src = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                # x86 spells it 'flags'; aarch64 spells it 'Features'
+                if line.startswith(("flags", "Features")):
+                    src = line
+                    break
+    except OSError:
+        pass
+    if not src:  # no /proc (macOS) or unrecognized format
+        import platform
+
+        src = f"{platform.machine()}-{platform.processor()}"
+    tag = hashlib.sha256(src.encode()).hexdigest()[:12]
+    return os.path.expanduser(f"~/.smartbft_jax_cache/{tag}")
+
+
+def enable_compile_cache() -> None:
+    """Point jax's persistent compilation cache at the fingerprinted dir."""
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", cache_dir())
 
 
 def force_cpu(virtual_devices: int | None = None) -> None:
@@ -39,9 +74,7 @@ def force_cpu(virtual_devices: int | None = None) -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update(
-        "jax_compilation_cache_dir", os.path.expanduser("~/.smartbft_jax_cache")
-    )
+    enable_compile_cache()
     # The sitecustomize hook has already registered the axon factory by the
     # time any library code runs; JAX_PLATFORMS=cpu alone still errors on
     # backend init ("Unable to initialize backend 'axon'").  Drop every
