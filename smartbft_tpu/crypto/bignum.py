@@ -79,8 +79,44 @@ def batch_to_limbs(xs, nlimbs: int) -> np.ndarray:
 
 
 # ---------------------------------------------------------------------------
-# carry / borrow chains (lax.scan along the limb axis)
+# carry / borrow chains
+#
+# Two interchangeable implementations, selected by SMARTBFT_BN_CHAIN:
+#   'prefix' (default) — Kogge–Stone carry-lookahead: two local
+#     redistribution passes reduce every residual carry to 0/1, then a
+#     log2(m)-step (generate, propagate) parallel prefix resolves them.
+#     ~12 data-dependent levels instead of m sequential scan steps, and —
+#     critically — NO while-loop in the HLO: graphs with hundreds of
+#     Montgomery ops compile minutes faster on XLA:CPU (copy-insertion is
+#     superlinear in while-op count) and the TPU VPU pipeline stays full.
+#   'scan' — the original lax.scan along the limb axis (kept for A/B and
+#     as a hedge against Mosaic/XLA regressions).
 # ---------------------------------------------------------------------------
+
+CHAIN = _os.environ.get("SMARTBFT_BN_CHAIN", "prefix")
+
+
+def _shift_up(x, s: int):
+    """Limb shift toward higher index along the last axis (zero fill)."""
+    pad = [(0, 0)] * (x.ndim - 1) + [(s, 0)]
+    return jnp.pad(x, pad)[..., : x.shape[-1]]
+
+
+def _resolve_prefix(x, m: int):
+    """Resolve 0/1 residual carries of ``x`` (values <= 2^16) via
+    Kogge–Stone prefix over (generate, propagate); returns (limbs, carry)
+    with carry the (...,) carry out of limb m-1."""
+    g = x >> LIMB_BITS  # 0/1 by precondition
+    b = x & LIMB_MASK
+    p = (b == LIMB_MASK).astype(DTYPE)
+    G, P = g, p
+    s = 1
+    while s < m:
+        G = G | (P & _shift_up(G, s))
+        P = P & _shift_up(P, s)
+        s <<= 1
+    return (b + _shift_up(G, 1)) & LIMB_MASK, G[..., m - 1]
+
 
 def carry_propagate(cols, out_len: int):
     """Normalize column sums (< 2^31 each) into 16-bit limbs.
@@ -94,6 +130,14 @@ def carry_propagate(cols, out_len: int):
     if out_len > m:
         pad = [(0, 0)] * (cols.ndim - 1) + [(0, out_len - m)]
         cols = jnp.pad(cols, pad)
+    if CHAIN == "prefix":
+        x = cols
+        # two local passes: 2^31 -> carries < 2^15 -> values <= 2^16,
+        # residual carries in {0, 1}
+        for _ in range(2):
+            x = (x & LIMB_MASK) + _shift_up(x >> LIMB_BITS, 1)
+        limbs, _ = _resolve_prefix(x, out_len)
+        return limbs
     x = jnp.moveaxis(cols, -1, 0)  # (out_len, ...)
 
     def step(c, col):
@@ -109,6 +153,22 @@ def sub_borrow(a, b):
 
     borrow_out is (...,) uint32: 1 when a < b.
     """
+    if CHAIN == "prefix":
+        b = jnp.broadcast_to(b, a.shape)
+        n = a.shape[-1]
+        # a - b = a + ~b + 1 (two's complement); carry-out <=> a >= b
+        x = a + (jnp.uint32(LIMB_MASK) - b)
+        x = jnp.concatenate(
+            [x[..., :1] + jnp.uint32(1), x[..., 1:]], axis=-1
+        )
+        # one local pass: values < 2^17 -> <= 2^16, residual carries 0/1.
+        # The top limb's local carry leaves the array here — it IS a carry
+        # out of limb n-1, so it joins the prefix stage's (at most one of
+        # the two can be set: the true carry-out is a single bit).
+        hi = x >> LIMB_BITS
+        x = (x & LIMB_MASK) + _shift_up(hi, 1)
+        diff, carry = _resolve_prefix(x, n)
+        return diff, jnp.uint32(1) - (carry | hi[..., n - 1])
     xa = jnp.moveaxis(a, -1, 0)
     xb = jnp.moveaxis(jnp.broadcast_to(b, a.shape), -1, 0)
 
@@ -254,6 +314,16 @@ def shamir_scan(point_add, table, ident, bits1, bits2):
 # multiplication
 # ---------------------------------------------------------------------------
 
+def _put(x, off: int, total: int):
+    """Zero-pad ``x`` to ``total`` columns with ``off`` leading zeros.
+
+    The pad+add accumulation primitive (mirrors pallas_ecdsa._pad_rows):
+    scatter-free HLO, since XLA:CPU expands ``.at[].add`` scatters into
+    slow-to-compile, slow-to-run update loops."""
+    pad = [(0, 0)] * (x.ndim - 1) + [(off, total - off - x.shape[-1])]
+    return jnp.pad(x, pad)
+
+
 def mul_columns(a, b):
     """Raw product columns: (..., n) x (..., n) -> (..., 2n) UNNORMALIZED.
 
@@ -271,8 +341,26 @@ def mul_columns(a, b):
     acc = jnp.zeros(bshape + (2 * n,), DTYPE)
     for i in range(n):
         p = a[..., i : i + 1] * b
-        acc = acc.at[..., i : i + n].add(p & LIMB_MASK)
-        acc = acc.at[..., i + 1 : i + n + 1].add(p >> LIMB_BITS)
+        acc = acc + _put(p & LIMB_MASK, i, 2 * n) + _put(
+            p >> LIMB_BITS, i + 1, 2 * n
+        )
+    return acc
+
+
+def mul_columns_low(a, b):
+    """Low-n product columns only: a*b mod 2^(16n), unnormalized.
+
+    The Montgomery m-step (m = T_lo * N' mod R) discards the high half of
+    the product; skipping partial products landing at column >= n halves
+    this step's lane-mult count."""
+    n = a.shape[-1]
+    bshape = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
+    acc = jnp.zeros(bshape + (n,), DTYPE)
+    for i in range(n):
+        p = a[..., i : i + 1] * b[..., : n - i]  # columns i..n-1
+        acc = acc + _put(p & LIMB_MASK, i, n)
+        if i + 1 < n:
+            acc = acc + _put((p >> LIMB_BITS)[..., : n - i - 1], i + 1, n)
     return acc
 
 
@@ -293,8 +381,9 @@ def square_columns(a):
         w = np.full(n - i, 2, dtype=np.uint32)
         w[0] = 1  # the diagonal term a_i^2 counts once
         wj = jnp.asarray(w)
-        acc = acc.at[..., 2 * i : i + n].add((row & LIMB_MASK) * wj)
-        acc = acc.at[..., 2 * i + 1 : i + n + 1].add((row >> LIMB_BITS) * wj)
+        acc = acc + _put((row & LIMB_MASK) * wj, 2 * i, 2 * n) + _put(
+            (row >> LIMB_BITS) * wj, 2 * i + 1, 2 * n
+        )
     return acc
 
 
@@ -384,8 +473,8 @@ class MontCtx:
         """
         n = self.n
         T = carry_propagate(cols, 2 * n + 1)
-        m = mul_columns(T[..., :n], jnp.asarray(self.Nprime))
-        m = carry_propagate(m[..., :n], n)  # low n limbs: mod R
+        m = mul_columns_low(T[..., :n], jnp.asarray(self.Nprime))
+        m = carry_propagate(m, n)  # low n limbs: mod R
         s = carry_propagate(
             jnp.pad(T, [(0, 0)] * (T.ndim - 1) + [(0, 1)])
             + jnp.pad(mul_columns(m, jnp.asarray(self.N)),

@@ -151,23 +151,28 @@ class JaxVerifyEngine:
             from . import pallas_ecdsa
 
             xla_kernel = self._kernel
+            state = {"pallas": True}
 
-            def probing_kernel(*arrays):
-                try:
-                    out = pallas_ecdsa.ecdsa_verify(*arrays)
-                except Exception as exc:  # noqa: BLE001 — lowering/compile/OOM
-                    import logging
+            def guarded_kernel(*arrays):
+                # permanent guard, not a first-call probe: every padded
+                # batch size jit-compiles the Pallas kernel afresh, and a
+                # Mosaic/OOM failure at ANY size must degrade to the XLA
+                # kernel instead of taking down the consensus verify path
+                if state["pallas"]:
+                    try:
+                        return pallas_ecdsa.ecdsa_verify(*arrays)
+                    except Exception as exc:  # noqa: BLE001 — compile/OOM
+                        import logging
 
-                    logging.getLogger("smartbft_tpu.crypto").warning(
-                        "pallas kernel unavailable (%s: %s); engine falls "
-                        "back to the XLA kernel", type(exc).__name__, exc,
-                    )
-                    self._kernel = xla_kernel
-                    return xla_kernel(*arrays)
-                self._kernel = pallas_ecdsa.ecdsa_verify
-                return out
+                        logging.getLogger("smartbft_tpu.crypto").warning(
+                            "pallas kernel unavailable (%s: %s); engine "
+                            "falls back to the XLA kernel",
+                            type(exc).__name__, exc,
+                        )
+                        state["pallas"] = False
+                return xla_kernel(*arrays)
 
-            self._kernel = probing_kernel
+            self._kernel = guarded_kernel
         self._lock = threading.Lock()
         self.stats = VerifyStats()
 

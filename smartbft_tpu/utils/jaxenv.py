@@ -59,14 +59,25 @@ def force_cpu(virtual_devices: int | None = None) -> None:
 
     ``virtual_devices``: optionally fake an N-device host platform
     (``--xla_force_host_platform_device_count``) for Mesh/sharding tests.
-    Only effective if no XLA flags conflict and jax hasn't initialized yet.
+    A smaller pre-existing count in XLA_FLAGS is raised to the requested
+    one (a larger one is kept — extra devices never hurt).  Only effective
+    if jax hasn't initialized yet.
     """
+    import re
+
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
     os.environ.pop("PALLAS_AXON_REMOTE_COMPILE", None)
     if virtual_devices is not None:
         flags = os.environ.get("XLA_FLAGS", "")
-        if "xla_force_host_platform_device_count" not in flags:
+        m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+        if m and int(m.group(1)) < virtual_devices:
+            flags = flags.replace(
+                m.group(0),
+                f"--xla_force_host_platform_device_count={virtual_devices}",
+            )
+            os.environ["XLA_FLAGS"] = flags
+        elif not m:
             os.environ["XLA_FLAGS"] = (
                 flags + f" --xla_force_host_platform_device_count={virtual_devices}"
             ).strip()

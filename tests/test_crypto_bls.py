@@ -5,6 +5,8 @@ The host implementation is validated against algebraic ground truth
 then validated against the host implementation.
 """
 
+import os
+
 import numpy as np
 import pytest
 
@@ -133,8 +135,18 @@ class TestScheme:
 
 
 class TestKernel:
-    """Device kernel vs host; one fixed batch shape so the jit caches."""
+    """Device kernel vs host; one fixed batch shape so the jit caches.
 
+    The pairing kernel's cold compile takes minutes on a 1-core CPU host
+    (deep Miller scan + final exponentiation), so the device-vs-host check
+    is gated like the Pallas e2e test; it runs on TPU rounds
+    (SMARTBFT_SLOW_TESTS=1) and its measured result is recorded in
+    PERF.md.  The host pairing algebra above runs unconditionally."""
+
+    @pytest.mark.skipif(
+        os.environ.get("SMARTBFT_SLOW_TESTS") != "1",
+        reason="pairing-kernel compile takes minutes on a 1-core CPU host",
+    )
     def test_kernel_matches_host(self):
         import jax
         import jax.numpy as jnp
@@ -155,6 +167,77 @@ class TestKernel:
         bad = [(b"m", b"\x00" * bls.SIG_BYTES, b"\x01" * bls.PUB_BYTES)]
         *_, ok = bls.verify_inputs(bad)
         assert ok.tolist() == [0]
+
+
+class TestStackedOps:
+    """The stacked (device) Fp12 machinery vs the host tower, run eagerly —
+    small graphs, so these cover the _mul12_tensor / frobenius / inv12
+    building blocks on every default CI pass even though the full pairing
+    kernel is compile-gated above."""
+
+    def _rand_fp12(self, rng):
+        return tuple(
+            tuple((rng.randrange(P), rng.randrange(P)) for _ in range(3))
+            for _ in range(2)
+        )
+
+    def _encode(self, xs):
+        import jax.numpy as jnp
+
+        rows = [bls._stk_from_tuple(
+            tuple(tuple((jnp.asarray(bls.CTX.encode(c0)),
+                         jnp.asarray(bls.CTX.encode(c1)))
+                        for c0, c1 in half) for half in x)
+        ) for x in xs]
+        return jnp.stack(rows)
+
+    def _decode(self, out, i):
+        a = np.asarray(out)
+        return tuple(
+            tuple((bls.CTX.decode(a[i, 2 * (3 * h + k)]),
+                   bls.CTX.decode(a[i, 2 * (3 * h + k) + 1]))
+                  for k in range(3))
+            for h in range(2)
+        )
+
+    def setup_method(self):
+        import random
+
+        rng = random.Random(99)
+        self.xs = [self._rand_fp12(rng) for _ in range(2)]
+        self.ys = [self._rand_fp12(rng) for _ in range(2)]
+        self.sx = self._encode(self.xs)
+        self.sy = self._encode(self.ys)
+
+    def test_mul12_matches_host(self):
+        out = bls.mul12(self.sx, self.sy)
+        for i, (x, y) in enumerate(zip(self.xs, self.ys)):
+            assert self._decode(out, i) == bls.fp12_mul(HOST, x, y)
+
+    def test_sqr12_matches_host(self):
+        out = bls.sqr12(self.sx)
+        for i, x in enumerate(self.xs):
+            assert self._decode(out, i) == bls.fp12_mul(HOST, x, x)
+
+    def test_frob12_conj12_match_host(self):
+        fr = bls.frob12(self.sx)
+        cj = bls.conj12(self.sx)
+        for i, x in enumerate(self.xs):
+            want = bls.fp12_frob(HOST, x, bls._G1F, bls._G2F, bls._G4F)
+            assert self._decode(fr, i) == want
+            assert self._decode(cj, i) == bls.fp12_conj(HOST, x)
+
+    @pytest.mark.skipif(
+        os.environ.get("SMARTBFT_SLOW_TESTS") != "1",
+        reason="the eager Fermat exp inside inv12 takes ~2 min on 1 CPU "
+               "core; its Montgomery exp core is covered by "
+               "test_mont_inv_prime_field, the tensor calls by the tests "
+               "above, and the full composition on TPU rounds",
+    )
+    def test_inv12_matches_host(self):
+        out = bls.inv12(self.sx)
+        for i, x in enumerate(self.xs):
+            assert self._decode(out, i) == bls.fp12_inv(HOST, x)
 
 
 class TestProofOfPossession:

@@ -145,6 +145,48 @@ def test_digits_msb():
     assert got == exp
 
 
+def test_pallas_ops_plumbing_interpret():
+    """The Mosaic-path dynamic lookups (_PallasOps: VMEM idx scratch via
+    pl.ds, SMEM digit reads) exercised through a real pallas_call in
+    interpret mode — a tiny graph, so it runs on every CPU CI pass even
+    though the full fused kernel is gated below."""
+    import jax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    nb = 4
+    n_rows = 8
+
+    def kernel(digs_ref, a_ref, out_ref, idx_scratch):
+        ops = pe._PallasOps(digs_ref, idx_scratch)
+        ops.stash_idx([a_ref[0, :] + jnp.uint32(k) for k in range(n_rows)])
+
+        def body(i, acc):
+            return acc + ops.idx_at(i)
+
+        acc = jax.lax.fori_loop(
+            0, n_rows, body, jnp.zeros((nb,), jnp.uint32)
+        )
+        out_ref[0, :] = acc + ops.dig_at(0)
+
+    digs = jnp.asarray(pe.INV_DIGITS).reshape(1, -1)
+    a = jnp.arange(nb, dtype=jnp.uint32).reshape(1, nb)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((1, nb), jnp.uint32),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, nb), lambda: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, nb), lambda: (0, 0)),
+        scratch_shapes=[pltpu.VMEM((n_rows, nb), jnp.uint32)],
+        interpret=True,
+    )(digs, a)
+    base = np.arange(nb, dtype=np.uint32)
+    want = sum(base + k for k in range(n_rows)) + int(pe.INV_DIGITS[0])
+    assert np.asarray(out)[0].tolist() == want.tolist()
+
+
 @pytest.mark.skipif(
     os.environ.get("SMARTBFT_SLOW_TESTS") != "1",
     reason="full fused-kernel compile takes minutes on CPU",
