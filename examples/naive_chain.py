@@ -1,10 +1,15 @@
-"""naive_chain — a minimal hash-chained blockchain over smartbft_tpu.
+"""naive_chain — a minimal hash-chained blockchain embedding smartbft_tpu.
 
 Re-design of /root/reference/examples/naive_chain/ (chain.go:92-99,
 node.go:90-273): four in-process nodes order client transactions into
-blocks chained by the previous block's digest, with no-op crypto.  Runs in
-production mode (wall-clock scheduler), unlike the logical-clock test
-harness.
+blocks chained by the previous block's digest.  Like the reference
+example, every node implements the WHOLE plugin SPI itself — Application,
+Comm, Assembler, Signer, Verifier, MembershipNotifier, RequestInspector,
+Synchronizer — over its own asyncio channel mesh, with zero imports from
+the ``smartbft_tpu.testing`` harness.  Unlike the reference's no-op crypto
+(node.go:90-110), commit votes here carry REAL P-256 signatures via the
+library's ``P256CryptoProvider``, so this is also a working template for a
+production embedding.
 
 Run:  python examples/naive_chain.py
 """
@@ -19,12 +24,47 @@ import tempfile
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from smartbft_tpu import wal as walmod
+from smartbft_tpu.api import (
+    Application,
+    Assembler,
+    Comm,
+    MembershipNotifier,
+    RequestInspector,
+    Signer,
+    Synchronizer,
+    Verifier,
+)
 from smartbft_tpu.codec import decode, encode, wiremsg
-from smartbft_tpu.messages import Proposal
-from smartbft_tpu.testing.app import App, BatchPayload, SharedLedgers, TestRequest, fast_config
-from smartbft_tpu.testing.network import Network
-from smartbft_tpu.types import Decision, Reconfig
+from smartbft_tpu.config import Configuration
+from smartbft_tpu.consensus import Consensus
+from smartbft_tpu.crypto.provider import Keyring, P256CryptoProvider
+from smartbft_tpu.messages import Message, Proposal, Signature, ViewMetadata
+from smartbft_tpu.types import Decision, Reconfig, RequestInfo, SyncResponse
 from smartbft_tpu.utils.clock import Scheduler, WallClockDriver
+from smartbft_tpu.utils.logging import StdLogger
+
+
+# --------------------------------------------------------------------------
+# wire types owned by the application (the library never sees their schema)
+# --------------------------------------------------------------------------
+
+@wiremsg
+class Transaction:
+    """A client transaction (chain.go's Transaction equivalent)."""
+
+    client_id: str = ""
+    tx_id: str = ""
+    payload: bytes = b""
+
+
+@wiremsg
+class BlockData:
+    transactions: list[bytes] = None  # type: ignore[assignment]
+
+    def __post_init__(self):
+        if self.transactions is None:
+            object.__setattr__(self, "transactions", [])
 
 
 @wiremsg
@@ -34,23 +74,106 @@ class BlockHeader:
     data_hash: bytes = b""
 
 
-class ChainNode(App):
-    """An App whose assembled proposals are hash-chained blocks
-    (node.go:112-158)."""
+# --------------------------------------------------------------------------
+# the embedder's own transport: an asyncio channel mesh (chain_test.go's
+# channel network, re-built here because the library owns no transport)
+# --------------------------------------------------------------------------
 
-    def __init__(self, *args, **kw):
-        super().__init__(*args, **kw)
-        self.blocks: list[tuple[BlockHeader, list[bytes]]] = []
+class ChannelMesh:
+    """node-id -> inbox queue; each node drains its own inbox task."""
+
+    def __init__(self) -> None:
+        self.inboxes: dict[int, asyncio.Queue] = {}
+
+    def register(self, node_id: int) -> asyncio.Queue:
+        q: asyncio.Queue = asyncio.Queue(maxsize=1000)
+        self.inboxes[node_id] = q
+        return q
+
+    def node_ids(self) -> list[int]:
+        return sorted(self.inboxes.keys())
+
+    def post(self, target: int, item) -> None:
+        q = self.inboxes.get(target)
+        if q is None:
+            return
+        try:
+            q.put_nowait(item)
+        except asyncio.QueueFull:
+            pass  # drop on overflow, like any real bounded transport
+
+
+class NodeComm(Comm):
+    """The Comm SPI for one node over the mesh."""
+
+    def __init__(self, self_id: int, mesh: ChannelMesh):
+        self.self_id = self_id
+        self.mesh = mesh
+
+    def send_consensus(self, target_id: int, msg: Message) -> None:
+        self.mesh.post(target_id, ("consensus", self.self_id, msg))
+
+    def send_transaction(self, target_id: int, request: bytes) -> None:
+        self.mesh.post(target_id, ("request", self.self_id, request))
+
+    def nodes(self) -> list[int]:
+        return self.mesh.node_ids()
+
+
+# --------------------------------------------------------------------------
+# the chain node: implements every remaining SPI interface itself
+# --------------------------------------------------------------------------
+
+class ChainNode(Application, Assembler, Signer, Verifier, RequestInspector,
+                Synchronizer, MembershipNotifier):
+    """One replica of the blockchain (node.go:90-273 equivalent)."""
+
+    def __init__(self, node_id: int, mesh: ChannelMesh, scheduler: Scheduler,
+                 keyring: Keyring, wal_dir: str):
+        self.id = node_id
+        self.mesh = mesh
+        self.scheduler = scheduler
+        self.comm = NodeComm(node_id, mesh)
+        self.crypto = P256CryptoProvider(keyring)
+        # the View's batched-verify seam goes through the provider too
+        self.verify_consenter_sigs_batch = self.crypto.verify_consenter_sigs_batch
+        self.verify_consenter_sigs_batch_async = (
+            self.crypto.verify_consenter_sigs_batch_async
+        )
+        self.wal_dir = wal_dir
+        self.logger = StdLogger(f"chain-{node_id}")
+        self.blocks: list[tuple[BlockHeader, list[bytes], tuple[Signature, ...]]] = []
+        self.decisions: list[Decision] = []  # full committed decisions
         self.block_listeners: list[asyncio.Queue] = []
+        self.consensus: Consensus | None = None
+        # register in the mesh at construction: every node must see the full
+        # membership via Comm.nodes() before any consensus instance starts
+        self._inbox: asyncio.Queue = mesh.register(node_id)
+        self._inbox_task: asyncio.Task | None = None
+        self._wal = None
+
+    # -- Application -------------------------------------------------------
+
+    def deliver(self, proposal: Proposal, signatures) -> Reconfig:
+        header = decode(BlockHeader, proposal.header)
+        data = decode(BlockData, proposal.payload)
+        self.blocks.append((header, list(data.transactions), tuple(signatures)))
+        self.decisions.append(
+            Decision(proposal=proposal, signatures=tuple(signatures))
+        )
+        for q in self.block_listeners:
+            q.put_nowait((header, list(data.transactions)))
+        return Reconfig(in_latest_decision=False)
+
+    # -- Assembler ---------------------------------------------------------
 
     def _prev_hash(self) -> bytes:
         if not self.blocks:
             return b"genesis"
-        hdr = self.blocks[-1][0]
-        return hashlib.sha256(encode(hdr)).digest()
+        return hashlib.sha256(encode(self.blocks[-1][0])).digest()
 
     def assemble_proposal(self, metadata: bytes, requests) -> Proposal:
-        payload = encode(BatchPayload(requests=list(requests)))
+        payload = encode(BlockData(transactions=list(requests)))
         header = BlockHeader(
             sequence=len(self.blocks) + 1,
             prev_hash=self._prev_hash(),
@@ -60,28 +183,174 @@ class ChainNode(App):
             header=encode(header),
             payload=payload,
             metadata=metadata,
-            verification_sequence=self.verification_seq,
+            verification_sequence=self.verification_sequence(),
         )
 
-    def deliver(self, proposal: Proposal, signatures) -> Reconfig:
-        header = decode(BlockHeader, proposal.header)
-        batch = decode(BatchPayload, proposal.payload)
-        self.blocks.append((header, list(batch.requests)))
-        self.shared.append(self.id, Decision(proposal=proposal, signatures=tuple(signatures)))
-        for q in self.block_listeners:
-            q.put_nowait((header, list(batch.requests)))
-        return Reconfig(in_latest_decision=False)
+    # -- Signer / Verifier: crypto via the library provider, semantics ours --
 
+    def sign(self, data: bytes) -> bytes:
+        return self.crypto.sign(data)
+
+    def sign_proposal(self, proposal: Proposal, auxiliary_input: bytes) -> Signature:
+        return self.crypto.sign_proposal(proposal, auxiliary_input)
+
+    def verify_proposal(self, proposal: Proposal) -> list[RequestInfo]:
+        header = decode(BlockHeader, proposal.header)
+        data = decode(BlockData, proposal.payload)
+        if header.data_hash != hashlib.sha256(proposal.payload).digest():
+            raise ValueError("block data hash mismatch")
+        if proposal.verification_sequence != self.verification_sequence():
+            raise ValueError("wrong verification sequence")
+        # chain linkage: the proposal must extend OUR chain tip (a lagging
+        # replica syncs first; the protocol retries after catch-up)
+        if header.sequence == len(self.blocks) + 1 and \
+                header.prev_hash != self._prev_hash():
+            raise ValueError("block does not extend the chain tip")
+        return [self.request_id(r) for r in data.transactions]
+
+    def verify_request(self, raw_request: bytes) -> RequestInfo:
+        return self.request_id(raw_request)
+
+    def verify_consenter_sig(self, signature: Signature, proposal: Proposal) -> bytes:
+        return self.crypto.verify_consenter_sig(signature, proposal)
+
+    def verify_signature(self, signature: Signature) -> None:
+        self.crypto.verify_signature(signature)
+
+    def verification_sequence(self) -> int:
+        return 0  # static membership: the config epoch never advances
+
+    def requests_from_proposal(self, proposal: Proposal) -> list[RequestInfo]:
+        data = decode(BlockData, proposal.payload)
+        return [self.request_id(r) for r in data.transactions]
+
+    def auxiliary_data(self, msg: bytes) -> bytes:
+        return self.crypto.auxiliary_data(msg)
+
+    # -- RequestInspector --------------------------------------------------
+
+    def request_id(self, raw_request: bytes) -> RequestInfo:
+        tx = decode(Transaction, raw_request)
+        return RequestInfo(client_id=tx.client_id, request_id=tx.tx_id)
+
+    # -- MembershipNotifier ------------------------------------------------
+
+    def membership_change(self) -> bool:
+        return False
+
+    # -- Synchronizer ------------------------------------------------------
+
+    def sync(self) -> SyncResponse:
+        """Naive, like the reference example: report the local tip (a real
+        embedder fetches blocks from peers here)."""
+        if not self.blocks:
+            return SyncResponse(latest=Decision(proposal=Proposal()),
+                                reconfig=Reconfig(in_latest_decision=False))
+        header, txns, sigs = self.blocks[-1]
+        proposal = Proposal(
+            header=encode(header),
+            payload=encode(BlockData(transactions=txns)),
+            metadata=b"",  # metadata is not retained block-side in this demo
+            verification_sequence=0,
+        )
+        return SyncResponse(latest=Decision(proposal=proposal, signatures=sigs),
+                            reconfig=Reconfig(in_latest_decision=False))
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def _serve_inbox(self) -> None:
+        while True:
+            item = await self._inbox.get()
+            if item is None:
+                return
+            kind, sender, payload = item
+            if self.consensus is None:
+                continue
+            if kind == "consensus":
+                self.consensus.handle_message(sender, payload)
+            else:
+                await self.consensus.handle_request(sender, payload)
+
+    def _latest_metadata(self) -> tuple[ViewMetadata, Proposal, list[Signature]]:
+        if not self.blocks:
+            return ViewMetadata(), Proposal(), []
+        latest = self.sync().latest
+        md = (decode(ViewMetadata, latest.proposal.metadata)
+              if latest.proposal.metadata else ViewMetadata())
+        return md, latest.proposal, list(latest.signatures)
+
+    async def start(self) -> None:
+        self._inbox_task = asyncio.get_running_loop().create_task(
+            self._serve_inbox(), name=f"chain-inbox-{self.id}"
+        )
+        self._wal, entries = walmod.initialize_and_read_all(self.wal_dir, self.logger)
+        md, last_proposal, last_sigs = self._latest_metadata()
+        self.consensus = Consensus(
+            config=self._config(),
+            application=self,
+            assembler=self,
+            wal=self._wal,
+            wal_initial_content=entries,
+            comm=self.comm,
+            signer=self,
+            verifier=self,
+            membership_notifier=self,
+            request_inspector=self,
+            synchronizer=self,
+            logger=self.logger,
+            metadata=md,
+            last_proposal=last_proposal,
+            last_signatures=last_sigs,
+            scheduler=self.scheduler,
+            viewchanger_tick_interval=0.2,
+            heartbeat_tick_interval=0.2,
+        )
+        await self.consensus.start()
+
+    async def stop(self) -> None:
+        if self.consensus is not None:
+            await self.consensus.stop()
+        if self._inbox_task is not None:
+            self._inbox.put_nowait(None)
+            await self._inbox_task
+            self._inbox_task = None
+        if self._wal is not None:
+            self._wal.close()
+
+    def _config(self) -> Configuration:
+        return Configuration(
+            self_id=self.id,
+            request_batch_max_count=10,
+            request_batch_max_interval=0.05,
+            request_forward_timeout=2.0,
+            request_complain_timeout=4.0,
+            request_auto_remove_timeout=30.0,
+            view_change_resend_interval=1.0,
+            view_change_timeout=10.0,
+            leader_heartbeat_timeout=15.0,
+            leader_heartbeat_count=10,
+            collect_timeout=1.0,
+            sync_on_start=False,
+        )
+
+    async def submit(self, client_id: str, tx_id: str, payload: bytes) -> None:
+        tx = encode(Transaction(client_id=client_id, tx_id=tx_id, payload=payload))
+        await self.consensus.submit_request(tx)
+
+
+# --------------------------------------------------------------------------
+# demo main: 4 nodes, 10 blocks, chain-link verification
+# --------------------------------------------------------------------------
 
 async def main(num_blocks: int = 10) -> None:
     scheduler = Scheduler()
     driver = WallClockDriver(scheduler, tick_interval=0.01)
-    network = Network(seed=7)
-    shared = SharedLedgers()
+    mesh = ChannelMesh()
+    keyrings = Keyring.generate([1, 2, 3, 4], seed=b"naive-chain")
     tmp = tempfile.mkdtemp(prefix="naive_chain_wal_")
 
     nodes = [
-        ChainNode(i, network, shared, scheduler, wal_dir=os.path.join(tmp, f"wal-{i}"))
+        ChainNode(i, mesh, scheduler, keyrings[i], os.path.join(tmp, f"wal-{i}"))
         for i in range(1, 5)
     ]
     driver.start()
@@ -91,23 +360,33 @@ async def main(num_blocks: int = 10) -> None:
     listener: asyncio.Queue = asyncio.Queue()
     nodes[0].block_listeners.append(listener)
 
-    print(f"chain started: 4 nodes, leader={nodes[0].consensus.get_leader_id()}")
+    print(f"chain started: 4 nodes, real P-256 votes, "
+          f"leader={nodes[0].consensus.get_leader_id()}")
     for k in range(num_blocks):
         await nodes[0].submit("alice", f"txn-{k}", payload=f"transfer #{k}".encode())
         header, txns = await asyncio.wait_for(listener.get(), timeout=30)
-        txt = decode(TestRequest, txns[0])
+        tx = decode(Transaction, txns[0])
         print(
             f"block {header.sequence}: prev={header.prev_hash.hex()[:12]} "
-            f"txns={len(txns)} first={txt.client_id}:{txt.request_id}"
+            f"txns={len(txns)} first={tx.client_id}:{tx.tx_id}"
         )
 
-    # verify the chain links
-    for i in range(1, len(nodes[0].blocks)):
-        prev_hdr = nodes[0].blocks[i - 1][0]
-        want = hashlib.sha256(encode(prev_hdr)).digest()
-        assert nodes[0].blocks[i][0].prev_hash == want, "chain broken!"
+    # verify chain links + re-verify every commit signature offline
+    verifier = P256CryptoProvider(keyrings[2])
+    for node in nodes:
+        for i in range(1, len(node.blocks)):
+            prev_hdr = node.blocks[i - 1][0]
+            want = hashlib.sha256(encode(prev_hdr)).digest()
+            assert node.blocks[i][0].prev_hash == want, "chain broken!"
+    n_sigs = 0
+    for decision in nodes[0].decisions:
+        assert len(decision.signatures) >= 3  # quorum for n=4
+        for sig in decision.signatures:
+            verifier.verify_consenter_sig(sig, decision.proposal)
+            n_sigs += 1
     heights = [len(n.blocks) for n in nodes]
-    print(f"chain verified: heights={heights}")
+    print(f"chain verified: heights={heights}, "
+          f"{n_sigs} commit signatures re-verified offline")
 
     for n in nodes:
         await n.stop()
