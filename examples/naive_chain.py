@@ -241,19 +241,13 @@ class ChainNode(Application, Assembler, Signer, Verifier, RequestInspector,
     # -- Synchronizer ------------------------------------------------------
 
     def sync(self) -> SyncResponse:
-        """Naive, like the reference example: report the local tip (a real
-        embedder fetches blocks from peers here)."""
-        if not self.blocks:
+        """Naive, like the reference example: report the local tip with its
+        original metadata and signatures (a real embedder fetches missing
+        blocks from peers here)."""
+        if not self.decisions:
             return SyncResponse(latest=Decision(proposal=Proposal()),
                                 reconfig=Reconfig(in_latest_decision=False))
-        header, txns, sigs = self.blocks[-1]
-        proposal = Proposal(
-            header=encode(header),
-            payload=encode(BlockData(transactions=txns)),
-            metadata=b"",  # metadata is not retained block-side in this demo
-            verification_sequence=0,
-        )
-        return SyncResponse(latest=Decision(proposal=proposal, signatures=sigs),
+        return SyncResponse(latest=self.decisions[-1],
                             reconfig=Reconfig(in_latest_decision=False))
 
     # -- lifecycle ---------------------------------------------------------
@@ -272,9 +266,9 @@ class ChainNode(Application, Assembler, Signer, Verifier, RequestInspector,
                 await self.consensus.handle_request(sender, payload)
 
     def _latest_metadata(self) -> tuple[ViewMetadata, Proposal, list[Signature]]:
-        if not self.blocks:
+        if not self.decisions:
             return ViewMetadata(), Proposal(), []
-        latest = self.sync().latest
+        latest = self.decisions[-1]
         md = (decode(ViewMetadata, latest.proposal.metadata)
               if latest.proposal.metadata else ViewMetadata())
         return md, latest.proposal, list(latest.signatures)
@@ -311,7 +305,9 @@ class ChainNode(Application, Assembler, Signer, Verifier, RequestInspector,
         if self.consensus is not None:
             await self.consensus.stop()
         if self._inbox_task is not None:
-            self._inbox.put_nowait(None)
+            # await (not put_nowait): a flooded bounded inbox would raise
+            # QueueFull and leave the task unjoined / the WAL open
+            await self._inbox.put(None)
             await self._inbox_task
             self._inbox_task = None
         if self._wal is not None:

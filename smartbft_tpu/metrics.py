@@ -26,6 +26,9 @@ class MetricOpts:
     help: str = ""
     label_names: tuple[str, ...] = ()
     buckets: tuple[float, ...] = ()
+    #: statsd naming format with %{#namespace}/%{#subsystem}/%{#name} and
+    #: %{label} placeholders (pkg/metrics/namer.go); empty = dotted default
+    statsd_format: str = ""
 
     @property
     def full_name(self) -> str:
@@ -119,23 +122,39 @@ class DisabledProvider(Provider):
 # ---------------------------------------------------------------------------
 
 
+def _label_suffix(label_names: tuple, label_values: tuple) -> str:
+    """Label key suffix.  With declared names: Prometheus-style
+    {name="value",...}; without: the legacy {v1,v2} value form."""
+    if label_names:
+        pairs = ",".join(
+            f'{n}="{v}"' for n, v in zip(label_names, label_values)
+        )
+        return "{" + pairs + "}"
+    return "{" + ",".join(str(v) for v in label_values) + "}"
+
+
 class _MemCounter(Counter):
-    def __init__(self, store: dict, key: str):
+    def __init__(self, store: dict, key: str, label_names: tuple = ()):
         self._store = store
         self._key = key
+        self._label_names = label_names
         store.setdefault(key, 0.0)
 
     def add(self, delta: float) -> None:
         self._store[self._key] = self._store.get(self._key, 0.0) + delta
 
     def with_labels(self, *label_values: str) -> Counter:
-        return _MemCounter(self._store, self._key + "{" + ",".join(label_values) + "}")
+        return _MemCounter(
+            self._store,
+            self._key + _label_suffix(self._label_names, label_values),
+        )
 
 
 class _MemGauge(Gauge):
-    def __init__(self, store: dict, key: str):
+    def __init__(self, store: dict, key: str, label_names: tuple = ()):
         self._store = store
         self._key = key
+        self._label_names = label_names
         store.setdefault(key, 0.0)
 
     def set(self, value: float) -> None:
@@ -145,20 +164,27 @@ class _MemGauge(Gauge):
         self._store[self._key] = self._store.get(self._key, 0.0) + delta
 
     def with_labels(self, *label_values: str) -> Gauge:
-        return _MemGauge(self._store, self._key + "{" + ",".join(label_values) + "}")
+        return _MemGauge(
+            self._store,
+            self._key + _label_suffix(self._label_names, label_values),
+        )
 
 
 class _MemHistogram(Histogram):
-    def __init__(self, store: dict, key: str):
+    def __init__(self, store: dict, key: str, label_names: tuple = ()):
         self._store = store
         self._key = key
+        self._label_names = label_names
         store.setdefault(key, [])
 
     def observe(self, value: float) -> None:
         self._store.setdefault(self._key, []).append(value)
 
     def with_labels(self, *label_values: str) -> Histogram:
-        return _MemHistogram(self._store, self._key + "{" + ",".join(label_values) + "}")
+        return _MemHistogram(
+            self._store,
+            self._key + _label_suffix(self._label_names, label_values),
+        )
 
 
 class InMemoryProvider(Provider):
@@ -184,6 +210,189 @@ class InMemoryProvider(Provider):
             return None
         idx = min(len(vals) - 1, int(q * len(vals)))
         return vals[idx]
+
+
+# ---------------------------------------------------------------------------
+# Naming / format plumbing + exporters
+# (pkg/metrics/provider.go:19-127, namer.go: the reference carries
+# statsd-format strings and Prometheus naming on MetricOpts; here the same
+# capability is two concrete exporter providers with no external deps)
+# ---------------------------------------------------------------------------
+
+
+def statsd_name(opts: MetricOpts, label_values: Sequence[str] = ()) -> str:
+    """Expand a statsd naming format.
+
+    ``opts.statsd_format`` supports the reference's placeholders:
+    ``%{#namespace}``, ``%{#subsystem}``, ``%{#name}`` and ``%{label}`` for
+    each declared label name.  Default format: dotted fqname plus dotted
+    label values in declaration order.
+    """
+    fmt = opts.statsd_format
+    if not fmt:
+        parts = [p for p in (opts.namespace, opts.subsystem, opts.name) if p]
+        return ".".join(list(parts) + [str(v) for v in label_values])
+    out = (fmt.replace("%{#namespace}", opts.namespace)
+              .replace("%{#subsystem}", opts.subsystem)
+              .replace("%{#name}", opts.name))
+    for lname, lval in zip(opts.label_names, label_values):
+        out = out.replace("%%{%s}" % lname, str(lval))
+    return out
+
+
+def prometheus_name(opts: MetricOpts) -> str:
+    """Prometheus fqname: namespace_subsystem_name, snake-cased."""
+    parts = [p for p in (opts.namespace, opts.subsystem, opts.name) if p]
+    return "_".join(parts).replace(".", "_").replace("-", "_")
+
+
+class _StatsdMetric:
+    def __init__(self, provider: "StatsdProvider", opts: MetricOpts,
+                 kind: str, label_values: tuple = ()):
+        self._p = provider
+        self._opts = opts
+        self._kind = kind
+        self._labels = label_values
+
+    def _emit(self, value: float) -> None:
+        self._p.emit(
+            f"{statsd_name(self._opts, self._labels)}:{value:g}|{self._kind}"
+        )
+
+
+class _StatsdCounter(_StatsdMetric, Counter):
+    def add(self, delta: float) -> None:
+        self._emit(delta)
+
+    def with_labels(self, *label_values: str) -> Counter:
+        return _StatsdCounter(self._p, self._opts, self._kind, label_values)
+
+
+class _StatsdGauge(_StatsdMetric, Gauge):
+    def set(self, value: float) -> None:
+        name = statsd_name(self._opts, self._labels)
+        if value < 0:
+            # bare negative values are deltas in the statsd protocol; an
+            # absolute negative set needs a zero-reset first (the standard
+            # emitter workaround)
+            self._p.emit(f"{name}:0|g")
+        self._p.emit(f"{name}:{value:g}|g")
+
+    def add(self, delta: float) -> None:
+        self._p.emit(
+            f"{statsd_name(self._opts, self._labels)}:{'+' if delta >= 0 else ''}{delta:g}|g"
+        )
+
+    def with_labels(self, *label_values: str) -> Gauge:
+        return _StatsdGauge(self._p, self._opts, self._kind, label_values)
+
+
+class _StatsdHistogram(_StatsdMetric, Histogram):
+    def observe(self, value: float) -> None:
+        # the library records latencies in SECONDS (time.monotonic deltas);
+        # statsd timers are milliseconds by convention
+        self._emit(value * 1000.0)
+
+    def with_labels(self, *label_values: str) -> Histogram:
+        return _StatsdHistogram(self._p, self._opts, self._kind, label_values)
+
+
+class StatsdProvider(Provider):
+    """Emits statsd wire lines (``name:value|c|g|ms``) to a sink callable.
+
+    The embedder supplies ``sink`` (e.g. a UDP socket's sendto); the default
+    collects lines in ``self.lines`` for inspection.  Naming honors
+    ``MetricOpts.statsd_format`` placeholders exactly like the reference's
+    statsd namer (pkg/metrics/namer.go).
+    """
+
+    def __init__(self, sink=None):
+        self.lines: list[str] = []
+        self._sink = sink if sink is not None else self.lines.append
+        self._lock = threading.Lock()
+
+    def emit(self, line: str) -> None:
+        with self._lock:
+            self._sink(line)
+
+    def new_counter(self, opts: MetricOpts) -> Counter:
+        return _StatsdCounter(self, opts, "c")
+
+    def new_gauge(self, opts: MetricOpts) -> Gauge:
+        return _StatsdGauge(self, opts, "g")
+
+    def new_histogram(self, opts: MetricOpts) -> Histogram:
+        return _StatsdHistogram(self, opts, "ms")
+
+
+class PrometheusProvider(InMemoryProvider):
+    """In-memory provider with a Prometheus text-format exposition surface.
+
+    ``expose()`` renders every registered metric in the text format a
+    Prometheus scrape endpoint serves (# HELP / # TYPE + samples); the
+    embedder mounts it behind its own HTTP handler.
+    """
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._meta: dict[str, tuple[str, str]] = {}  # fqname -> (type, help)
+
+    def _register(self, opts: MetricOpts, kind: str) -> str:
+        fq = prometheus_name(opts)
+        self._meta[fq] = (kind, opts.help)
+        return fq
+
+    def new_counter(self, opts: MetricOpts) -> Counter:
+        return _MemCounter(self.counters, self._register(opts, "counter"),
+                           tuple(opts.label_names))
+
+    def new_gauge(self, opts: MetricOpts) -> Gauge:
+        return _MemGauge(self.gauges, self._register(opts, "gauge"),
+                         tuple(opts.label_names))
+
+    def new_histogram(self, opts: MetricOpts) -> Histogram:
+        return _MemHistogram(self.histograms, self._register(opts, "histogram"),
+                             tuple(opts.label_names))
+
+    @staticmethod
+    def _split(key: str) -> tuple[str, str]:
+        """'fq{a,b}' -> (fq, 'a,b'); plain keys have no label suffix."""
+        if key.endswith("}") and "{" in key:
+            base, labels = key[:-1].split("{", 1)
+            return base, labels
+        return key, ""
+
+    def expose(self) -> str:
+        out: list[str] = []
+        emitted: set[str] = set()
+
+        def header(fq: str) -> None:
+            if fq in emitted or fq not in self._meta:
+                return
+            kind, help_ = self._meta[fq]
+            if help_:
+                out.append(f"# HELP {fq} {help_}")
+            out.append(f"# TYPE {fq} {kind}")
+            emitted.add(fq)
+
+        for key, val in sorted(self.counters.items()):
+            fq, labels = self._split(key)
+            header(fq)
+            out.append(f"{fq}{{{labels}}} {val:g}" if labels else f"{fq} {val:g}")
+        for key, val in sorted(self.gauges.items()):
+            fq, labels = self._split(key)
+            header(fq)
+            out.append(f"{fq}{{{labels}}} {val:g}" if labels else f"{fq} {val:g}")
+        for key, vals in sorted(self.histograms.items()):
+            fq, labels = self._split(key)
+            header(fq)
+            suffix = f"{{{labels}}}" if labels else ""
+            # a catch-all le bucket keeps strict parsers / promtool happy
+            inf_labels = (labels + "," if labels else "") + 'le="+Inf"'
+            out.append(f"{fq}_bucket{{{inf_labels}}} {len(vals):g}")
+            out.append(f"{fq}_count{suffix} {len(vals):g}")
+            out.append(f"{fq}_sum{suffix} {sum(vals):g}")
+        return "\n".join(out) + "\n"
 
 
 # ---------------------------------------------------------------------------

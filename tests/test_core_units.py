@@ -340,3 +340,71 @@ def test_batcher_full_and_timeout():
         assert not b2.closed()
 
     asyncio.run(run())
+
+
+# ---------------------------------------------------------------- metrics formats
+
+
+def test_statsd_provider_naming_and_lines():
+    from smartbft_tpu.metrics import MetricOpts, StatsdProvider, statsd_name
+
+    p = StatsdProvider()
+    opts = MetricOpts(namespace="consensus", subsystem="pool", name="count",
+                      label_names=("node",),
+                      statsd_format="%{#namespace}.%{#subsystem}.%{node}.%{#name}")
+    c = p.new_counter(opts)
+    c.add(2)
+    c.with_labels("7").add(1)
+    g = p.new_gauge(MetricOpts(namespace="ns", name="depth"))
+    g.set(5)
+    g.add(-2)
+    h = p.new_histogram(MetricOpts(name="lat"))
+    h.observe(0.0125)  # seconds in -> milliseconds on the wire
+    g.set(-3)  # negative absolute set needs the zero-reset prefix
+    assert p.lines == [
+        "consensus.pool.%{node}.count:2|c",  # unlabeled: placeholder stays
+        "consensus.pool.7.count:1|c",
+        "ns.depth:5|g",
+        "ns.depth:-2|g",
+        "lat:12.5|ms",
+        "ns.depth:0|g",
+        "ns.depth:-3|g",
+    ]
+    # default format: dotted fqname + label values
+    assert statsd_name(MetricOpts(namespace="a", name="b"), ("x",)) == "a.b.x"
+
+
+def test_prometheus_provider_exposition():
+    from smartbft_tpu.metrics import MetricOpts, PrometheusProvider
+
+    p = PrometheusProvider()
+    c = p.new_counter(MetricOpts(namespace="consensus", subsystem="view",
+                                 name="count_batch_all", help="batches"))
+    c.add(3)
+    g = p.new_gauge(MetricOpts(namespace="consensus", name="leader"))
+    g.set(2)
+    h = p.new_histogram(MetricOpts(name="latency_sync"))
+    h.observe(0.5)
+    h.observe(1.5)
+    lc = p.new_counter(MetricOpts(namespace="ns", name="lbl",
+                                  label_names=("node",)))
+    lc.with_labels("7").add(1)
+    text = p.expose()
+    assert "# HELP consensus_view_count_batch_all batches" in text
+    assert "# TYPE consensus_view_count_batch_all counter" in text
+    assert "consensus_view_count_batch_all 3" in text
+    assert "consensus_leader 2" in text
+    assert 'ns_lbl{node="7"} 1' in text  # valid exposition label pairs
+    assert 'latency_sync_bucket{le="+Inf"} 2' in text
+    assert "latency_sync_count 2" in text
+    assert "latency_sync_sum 2" in text
+
+
+def test_metrics_bundle_works_on_any_provider():
+    from smartbft_tpu.metrics import MetricsBundle, PrometheusProvider, StatsdProvider
+
+    for provider in (StatsdProvider(), PrometheusProvider()):
+        b = MetricsBundle(provider)
+        b.view.count_batch_all.add(1)
+        b.pool.count_of_requests.set(4)
+        b.consensus.latency_sync.observe(0.1)

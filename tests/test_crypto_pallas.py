@@ -7,18 +7,31 @@ end-to-end mask equivalence runs where it is cheap — on the TPU bench
 (bench_pallas) and behind SMARTBFT_SLOW_TESTS=1 here.
 """
 
+import functools
 import os
 import random
 
 import numpy as np
 import pytest
 
+import jax
 import jax.numpy as jnp
 
 from smartbft_tpu.crypto import p256
 from smartbft_tpu.crypto import pallas_ecdsa as pe
 
 rng = random.Random(7)
+
+# jit the building blocks under test: eager dispatch of their unrolled
+# chains costs ~40-60s per test on 1 CPU core, while the jitted versions
+# hit the persistent compile cache on every run after the first
+_jit_point_add = jax.jit(pe._point_add, static_argnums=0)
+_jit_point_double = jax.jit(pe._point_double, static_argnums=0)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 3))
+def _jit_inv_n(fn, one_n, sm, ops):
+    return pe._inv_n(fn, one_n, sm, ops)
 
 
 def to_cols(vals, nl=pe.NL):
@@ -95,8 +108,8 @@ def test_point_double_matches_add(fp):
         to_cols([q1[1] * R % p256.P, q2[1] * R % p256.P]),
         one_p,
     ], axis=-3)
-    dbl = pe._point_double(fld, b_m, pt)
-    add = pe._point_add(fld, b_m, pt, pt)
+    dbl = _jit_point_double(fld, b_m, pt)
+    add = _jit_point_add(fld, b_m, pt, pt)
     assert affine(dbl) == affine(add)
     # ...and both agree with the host reference doubling
     for got, q in zip(affine(dbl), (q1, q2)):
@@ -117,9 +130,9 @@ def test_point_identity_cases(fp):
         axis=-3,
     )
     # inf + P = P;  dbl(inf) = inf
-    s = pe._point_add(fld, b_m, inf, pt)
+    s = _jit_point_add(fld, b_m, inf, pt)
     assert affine(s) == [q]
-    di = pe._point_double(fld, b_m, inf)
+    di = _jit_point_double(fld, b_m, inf)
     assert from_cols(di[..., 2, :, :])[0] == 0
 
 
@@ -130,7 +143,7 @@ def test_inv_n():
     ss = [rng.randrange(1, p256.N) for _ in range(nb)]
     R = pe.R
     sm = to_cols([s * R % p256.N for s in ss])
-    inv = pe._inv_n(fn, one_n, sm, pe._JaxOps(jnp.asarray(pe.INV_DIGITS)))
+    inv = _jit_inv_n(fn, one_n, sm, pe._JaxOps(jnp.asarray(pe.INV_DIGITS)))
     got = from_cols(inv)
     exp = [pow(s, -1, p256.N) * R % p256.N for s in ss]
     assert got == exp

@@ -1,0 +1,180 @@
+"""Self-test of the in-process network simulator's fault semantics
+(mirrors /root/reference/test/network_test.go:1-51 — the harness itself is
+load-bearing for 49 integration scenarios, so its drop / mutation /
+overflow behavior gets direct coverage)."""
+
+from __future__ import annotations
+
+import asyncio
+
+from smartbft_tpu.messages import Prepare
+from smartbft_tpu.testing.network import INCOMING_BUFFER, Network
+
+
+class _Sink:
+    def __init__(self):
+        self.msgs: list[tuple[int, object]] = []
+        self.reqs: list[tuple[int, bytes]] = []
+
+    def handle_message(self, sender, m):
+        self.msgs.append((sender, m))
+
+    async def handle_request(self, sender, req):
+        self.reqs.append((sender, req))
+
+
+def _mesh(n=2, seed=3):
+    net = Network(seed=seed)
+    sinks = {}
+    for i in range(1, n + 1):
+        node = net.add_node(i)
+        node.consensus = sinks.setdefault(i, _Sink())
+    return net, sinks
+
+
+async def _drain(net):
+    await asyncio.sleep(0.05)
+    await net.stop()
+
+
+def test_messages_and_requests_flow():
+    async def run():
+        net, sinks = _mesh()
+        net.start()
+        m = Prepare(view=0, seq=1, digest="d")
+        net.send_consensus(1, 2, m)
+        net.send_transaction(1, 2, b"req")
+        await _drain(net)
+        assert sinks[2].msgs == [(1, m)]
+        assert sinks[2].reqs == [(1, b"req")]
+
+    asyncio.run(run())
+
+
+def test_sender_side_disconnect_from_is_asymmetric():
+    """DisconnectFrom(x) stops MY sends to x; x's messages still reach me
+    (network.go sender-side semantics)."""
+    async def run():
+        net, sinks = _mesh()
+        net.start()
+        net.nodes[1].disconnect_from(2)
+        net.send_consensus(1, 2, Prepare(view=0, seq=1, digest="a"))
+        net.send_consensus(2, 1, Prepare(view=0, seq=1, digest="b"))
+        await _drain(net)
+        assert sinks[2].msgs == []
+        assert [m.digest for _, m in sinks[1].msgs] == ["b"]
+
+    asyncio.run(run())
+
+
+def test_global_loss_not_shielded_by_lower_per_peer_probability():
+    """ADVICE r1: max(global, per-peer) — a 0.0 per-peer entry must not
+    bypass a full disconnect."""
+    async def run():
+        net, sinks = _mesh()
+        net.start()
+        node = net.nodes[1]
+        node.lose_messages(1.0)
+        node.peer_loss_probability[2] = 0.0
+        for _ in range(10):
+            net.send_consensus(1, 2, Prepare(view=0, seq=1, digest="d"))
+        await _drain(net)
+        assert sinks[2].msgs == []
+
+    asyncio.run(run())
+
+
+def test_receiver_side_loss_applies_only_node_wide_state():
+    async def run():
+        net, sinks = _mesh()
+        net.start()
+        net.nodes[2].disconnect()  # receiver drops everything inbound
+        net.send_consensus(1, 2, Prepare(view=0, seq=1, digest="d"))
+        await _drain(net)
+        assert sinks[2].msgs == []
+
+    asyncio.run(run())
+
+
+def test_connect_clears_all_loss_state():
+    async def run():
+        net, sinks = _mesh()
+        net.start()
+        node = net.nodes[1]
+        node.disconnect()
+        node.disconnect_from(2)
+        node.connect()
+        net.send_consensus(1, 2, Prepare(view=0, seq=1, digest="d"))
+        await _drain(net)
+        assert len(sinks[2].msgs) == 1
+
+    asyncio.run(run())
+
+
+def test_mutation_hook_rewrites_and_filters():
+    """MutateSend can rewrite or swallow outbound messages
+    (test_app.go:179-195 semantics)."""
+    async def run():
+        net, sinks = _mesh()
+        net.start()
+
+        def mutate(target, msg):
+            if msg.digest == "kill":
+                return None
+            return Prepare(view=msg.view, seq=msg.seq, digest="mutated")
+
+        net.nodes[1].mutate_send = mutate
+        net.send_consensus(1, 2, Prepare(view=0, seq=1, digest="orig"))
+        net.send_consensus(1, 2, Prepare(view=0, seq=1, digest="kill"))
+        await _drain(net)
+        assert [m.digest for _, m in sinks[2].msgs] == ["mutated"]
+
+    asyncio.run(run())
+
+
+def test_receiver_filters_keep_iff_all_pass():
+    async def run():
+        net, sinks = _mesh()
+        net.start()
+        net.nodes[2].add_filter(lambda m, sender: m.digest != "blocked")
+        net.send_consensus(1, 2, Prepare(view=0, seq=1, digest="ok"))
+        net.send_consensus(1, 2, Prepare(view=0, seq=1, digest="blocked"))
+        await _drain(net)
+        assert [m.digest for _, m in sinks[2].msgs] == ["ok"]
+        net2, sinks2 = _mesh()
+        net2.start()
+        net2.nodes[2].add_filter(lambda m, s: True)
+        net2.nodes[2].add_filter(lambda m, s: False)
+        net2.send_consensus(1, 2, Prepare(view=0, seq=1, digest="x"))
+        await _drain(net2)
+        assert sinks2[2].msgs == []
+
+    asyncio.run(run())
+
+
+def test_overflow_drops_and_counts():
+    """Bounded inbox: put INCOMING_BUFFER+k messages before the serve task
+    runs; the excess is dropped and counted (network.go:135-139)."""
+    async def run():
+        net, sinks = _mesh()
+        # node NOT started: the inbox fills without draining
+        node = net.nodes[2]
+        node.running = True  # accept offers without the serve task
+        for i in range(INCOMING_BUFFER + 7):
+            net.send_consensus(1, 2, Prepare(view=0, seq=i, digest="d"))
+        assert node.dropped == 7
+        assert node._inbox.qsize() == INCOMING_BUFFER
+
+    asyncio.run(run())
+
+
+def test_unknown_endpoints_ignored():
+    async def run():
+        net, sinks = _mesh()
+        net.start()
+        net.send_consensus(1, 99, Prepare(view=0, seq=1, digest="d"))
+        net.send_consensus(99, 1, Prepare(view=0, seq=1, digest="d"))
+        await _drain(net)
+        assert sinks[1].msgs == []
+
+    asyncio.run(run())

@@ -1,0 +1,399 @@
+"""Direct unit tests for the View: per-message rejection matrix, the
+1-slot pre-prepare stashes, lagging-replica assists, the f+1 future-vote
+sync trigger, and the proposal verification ladder.
+
+Mirrors /root/reference/internal/bft/view_test.go — real View, hand-rolled
+fakes, no network.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+import pytest
+
+from smartbft_tpu.codec import encode
+from smartbft_tpu.core.state import COMMITTED, PROPOSED
+from smartbft_tpu.core.view import View, ViewAborted, ViewSequencesHolder
+from smartbft_tpu.messages import (
+    Commit,
+    PrePrepare,
+    Prepare,
+    Signature,
+    ViewMetadata,
+)
+from smartbft_tpu.types import Proposal, RequestInfo
+from smartbft_tpu.utils.logging import RecordingLogger
+
+
+# ---------------------------------------------------------------- fakes
+
+
+class FakeComm:
+    def __init__(self):
+        self.broadcast: list = []
+        self.sent: list[tuple[int, object]] = []
+
+    def broadcast_consensus(self, m):
+        self.broadcast.append(m)
+
+    def send_consensus(self, target, m):
+        self.sent.append((target, m))
+
+
+class FakeFailureDetector:
+    def __init__(self):
+        self.complaints: list[tuple[int, bool]] = []
+
+    def complain(self, view, stop_view):
+        self.complaints.append((view, stop_view))
+
+
+class FakeSynchronizer:
+    def __init__(self):
+        self.syncs = 0
+
+    def sync(self):
+        self.syncs += 1
+
+
+class FakeVerifier:
+    def __init__(self, vseq: int = 0, bad_proposal: Optional[str] = None):
+        self.vseq = vseq
+        self.bad_proposal = bad_proposal
+
+    def verify_proposal(self, proposal):
+        if self.bad_proposal:
+            raise ValueError(self.bad_proposal)
+        return [RequestInfo(client_id="c", request_id="r")]
+
+    def verification_sequence(self):
+        return self.vseq
+
+    def auxiliary_data(self, msg):
+        return b""
+
+    def verify_consenter_sigs_batch(self, sigs, proposal):
+        return [s.msg for s in sigs]
+
+
+class FakeState:
+    def __init__(self):
+        self.saved: list = []
+
+    def save(self, record):
+        self.saved.append(record)
+
+
+class FakeSigner:
+    def sign_proposal(self, proposal, aux):
+        return Signature(signer=2, value=b"v", msg=aux)
+
+
+def make_view(
+    *,
+    self_id=2,
+    leader_id=1,
+    number=1,
+    proposal_sequence=5,
+    decisions_in_view=0,
+    n=4,
+    verifier=None,
+    decisions_per_leader=0,
+):
+    checkpoint_prop = Proposal(metadata=encode(ViewMetadata()), verification_sequence=0)
+    return View(
+        self_id=self_id,
+        n=n,
+        nodes_list=list(range(1, n + 1)),
+        leader_id=leader_id,
+        quorum=3,
+        number=number,
+        decider=None,
+        failure_detector=FakeFailureDetector(),
+        synchronizer=FakeSynchronizer(),
+        logger=RecordingLogger("view"),
+        comm=FakeComm(),
+        verifier=verifier or FakeVerifier(),
+        signer=FakeSigner(),
+        membership_notifier=None,
+        proposal_sequence=proposal_sequence,
+        decisions_in_view=decisions_in_view,
+        state=FakeState(),
+        retrieve_checkpoint=lambda: (checkpoint_prop, []),
+        decisions_per_leader=decisions_per_leader,
+        view_sequences=ViewSequencesHolder(),
+    )
+
+
+def proposal_for(view: View, vseq: int = 0, **md_overrides) -> Proposal:
+    md = ViewMetadata(
+        view_id=md_overrides.pop("view_id", view.number),
+        latest_sequence=md_overrides.pop("latest_sequence", view.proposal_sequence),
+        decisions_in_view=md_overrides.pop("decisions_in_view", view.decisions_in_view),
+        **md_overrides,
+    )
+    return Proposal(payload=b"p", metadata=encode(md), verification_sequence=vseq)
+
+
+# ---------------------------------------------------------------- routing matrix
+
+
+def test_wrong_view_from_non_leader_is_not_fatal():
+    """view.go:208-212: only the histogram path runs; no complaint."""
+    v = make_view()
+    v._process_msg(3, Prepare(view=9, seq=5, digest="d"))
+    assert v.failure_detector.complaints == []
+    assert not v.stopped()
+
+
+def test_wrong_view_from_leader_complains_and_stops():
+    v = make_view()
+    v._process_msg(1, Prepare(view=0, seq=5, digest="d"))  # lower view
+    assert v.failure_detector.complaints == [(1, False)]
+    assert v.stopped()
+    assert v.synchronizer.syncs == 0  # lower view: no sync
+
+
+def test_higher_view_from_leader_triggers_sync():
+    v = make_view()
+    v._process_msg(1, Prepare(view=2, seq=5, digest="d"))
+    assert v.failure_detector.complaints == [(1, False)]
+    assert v.synchronizer.syncs == 1
+    assert v.stopped()
+
+
+def test_far_future_sequence_ignored():
+    """seq not in {curr-1, curr, curr+1} is dropped (view.go:227-236)."""
+    v = make_view()
+    v._process_msg(3, Prepare(view=1, seq=9, digest="d"))
+    assert len(v.prepares) == 0 and len(v.next_prepares) == 0
+
+
+def test_votes_land_in_current_and_next_sets():
+    v = make_view()
+    v._process_msg(3, Prepare(view=1, seq=5, digest="d"))
+    v._process_msg(4, Prepare(view=1, seq=6, digest="d"))
+    v._process_msg(3, Commit(view=1, seq=5, digest="d",
+                             signature=Signature(signer=3, value=b"x", msg=b"m")))
+    v._process_msg(4, Commit(view=1, seq=6, digest="d",
+                             signature=Signature(signer=4, value=b"x", msg=b"m")))
+    assert len(v.prepares) == 1 and len(v.next_prepares) == 1
+    assert len(v.commits) == 1 and len(v.next_commits) == 1
+
+
+def test_own_votes_ignored():
+    """view.go:238-241."""
+    v = make_view(self_id=2)
+    v._process_msg(2, Prepare(view=1, seq=5, digest="d"))
+    v._process_msg(2, Commit(view=1, seq=5, digest="d",
+                             signature=Signature(signer=2, value=b"x", msg=b"m")))
+    assert len(v.prepares) == 0 and len(v.commits) == 0
+
+
+def test_commit_with_mismatched_signer_rejected():
+    """Commit.signature.signer must equal the sender (view.go:160-171)."""
+    v = make_view()
+    v._process_msg(3, Commit(view=1, seq=5, digest="d",
+                             signature=Signature(signer=4, value=b"x", msg=b"m")))
+    assert len(v.commits) == 0
+
+
+def test_duplicate_vote_not_double_counted():
+    v = make_view()
+    p = Prepare(view=1, seq=5, digest="d")
+    v._process_msg(3, p)
+    v._process_msg(3, p)
+    assert len(v.prepares) == 1
+
+
+# ---------------------------------------------------------------- pre-prepare slot
+
+
+def test_pre_prepare_from_non_leader_rejected():
+    v = make_view()
+    pp = PrePrepare(view=1, seq=5, proposal=proposal_for(v))
+    v._process_msg(3, pp)
+    assert v._pre_prepare is None
+
+
+def test_pre_prepare_with_empty_proposal_rejected():
+    v = make_view()
+    v._process_msg(1, PrePrepare(view=1, seq=5, proposal=None))
+    assert v._pre_prepare is None
+
+
+def test_pre_prepare_one_slot_semantics():
+    """Second pre-prepare for the same slot is dropped (view.go:301-324)."""
+    v = make_view()
+    pp1 = PrePrepare(view=1, seq=5, proposal=proposal_for(v))
+    pp2 = PrePrepare(view=1, seq=5, proposal=Proposal(payload=b"other"))
+    v._process_msg(1, pp1)
+    v._process_msg(1, pp2)
+    assert v._pre_prepare is pp1
+    # next-sequence slot is independent
+    ppn = PrePrepare(view=1, seq=6, proposal=Proposal(payload=b"next"))
+    v._process_msg(1, ppn)
+    assert v._next_pre_prepare is ppn
+
+
+def test_start_next_seq_promotes_next_slots():
+    v = make_view()
+    ppn = PrePrepare(view=1, seq=6, proposal=Proposal(payload=b"next"))
+    v._process_msg(1, ppn)
+    v._process_msg(3, Prepare(view=1, seq=6, digest="d"))
+    v._start_next_seq()
+    assert v.proposal_sequence == 6
+    assert v._pre_prepare is ppn and v._next_pre_prepare is None
+    assert len(v.prepares) == 1 and len(v.next_prepares) == 0
+
+
+# ---------------------------------------------------------------- assists
+
+
+def test_prev_seq_prepare_assist_resends_prev_prepare():
+    """view.go:718-756: a lagging replica's non-assist message gets our
+    previous prepare/commit resent."""
+    v = make_view()
+    v._prev_prepare_sent = Prepare(view=1, seq=4, digest="d", assist=True)
+    v._prev_commit_sent = Commit(view=1, seq=4, digest="d", assist=True)
+    v._process_msg(3, Prepare(view=1, seq=4, digest="d"))
+    assert v.comm.sent == [(3, v._prev_prepare_sent)]
+    v._process_msg(3, Commit(view=1, seq=4, digest="d",
+                             signature=Signature(signer=3, value=b"x", msg=b"m")))
+    assert v.comm.sent[-1] == (3, v._prev_commit_sent)
+
+
+def test_prev_seq_assist_messages_not_echoed():
+    """assist=True marks a resend; answering it would loop forever."""
+    v = make_view()
+    v._prev_prepare_sent = Prepare(view=1, seq=4, digest="d", assist=True)
+    v._process_msg(3, Prepare(view=1, seq=4, digest="d", assist=True))
+    assert v.comm.sent == []
+
+
+# ---------------------------------------------------------------- sync trigger
+
+
+def test_f_plus_one_future_commits_trigger_sync():
+    """view.go:758-818: f+1 matching future votes -> stop + sync."""
+    v = make_view(n=4)  # f = 1 -> threshold 2
+    future = dict(digest="d", view=1, seq=9)
+    v._discover_if_sync_needed(3, Commit(
+        **future, signature=Signature(signer=3, value=b"x", msg=b"m")))
+    assert v.synchronizer.syncs == 0
+    v._discover_if_sync_needed(4, Commit(
+        **future, signature=Signature(signer=4, value=b"x", msg=b"m")))
+    assert v.synchronizer.syncs == 1
+    assert v.stopped()
+
+
+def test_future_commit_histogram_needs_matching_votes():
+    v = make_view(n=4)
+    v._discover_if_sync_needed(3, Commit(view=1, seq=9, digest="a",
+                                         signature=Signature(signer=3, value=b"x", msg=b"m")))
+    v._discover_if_sync_needed(4, Commit(view=1, seq=8, digest="b",
+                                         signature=Signature(signer=4, value=b"x", msg=b"m")))
+    assert v.synchronizer.syncs == 0 and not v.stopped()
+
+
+def test_old_or_current_votes_never_trigger_sync():
+    v = make_view(n=4)
+    for sender, seq in ((3, 5), (4, 5)):  # current sequence, current view
+        v._discover_if_sync_needed(sender, Commit(
+            view=1, seq=seq, digest="d",
+            signature=Signature(signer=sender, value=b"x", msg=b"m")))
+    assert v.synchronizer.syncs == 0 and not v.stopped()
+
+
+# ---------------------------------------------------------------- verify ladder
+
+
+def run_verify(v: View, proposal: Proposal, prev_commits=()):
+    return asyncio.run(v._verify_proposal(proposal, list(prev_commits)))
+
+
+def test_verify_proposal_accepts_valid():
+    v = make_view()
+    assert len(run_verify(v, proposal_for(v))) == 1
+
+
+@pytest.mark.parametrize(
+    "md_overrides,fragment",
+    [
+        ({"view_id": 2}, "invalid view number"),
+        ({"latest_sequence": 6}, "invalid proposal sequence"),
+        ({"decisions_in_view": 3}, "invalid decisions in view"),
+    ],
+)
+def test_verify_proposal_metadata_mismatches(md_overrides, fragment):
+    v = make_view()
+    with pytest.raises(ValueError, match=fragment):
+        run_verify(v, proposal_for(v, **md_overrides))
+
+
+def test_verify_proposal_verification_sequence_mismatch():
+    v = make_view(verifier=FakeVerifier(vseq=3))
+    with pytest.raises(ValueError, match="verification sequence mismatch"):
+        run_verify(v, proposal_for(v, vseq=0))
+
+
+def test_verify_proposal_app_rejection_propagates():
+    v = make_view(verifier=FakeVerifier(bad_proposal="payload garbage"))
+    with pytest.raises(ValueError, match="payload garbage"):
+        run_verify(v, proposal_for(v))
+
+
+def test_verify_proposal_rejects_blacklist_without_rotation():
+    """view.go:649-660: rotation off -> any blacklist is invalid."""
+    v = make_view(decisions_per_leader=0)
+    with pytest.raises(ValueError, match="rotation is inactive"):
+        run_verify(v, proposal_for(v, black_list=[3]))
+
+
+def test_verify_proposal_rejects_bad_prev_commit_sig():
+    class RejectingVerifier(FakeVerifier):
+        def verify_consenter_sigs_batch(self, sigs, proposal):
+            return [None for _ in sigs]
+
+    v = make_view(verifier=RejectingVerifier())
+    bad_sig = Signature(signer=3, value=b"x", msg=b"m")
+    with pytest.raises(ValueError, match="failed verifying consenter signature"):
+        run_verify(v, proposal_for(v), prev_commits=[bad_sig])
+
+
+def test_bad_proposal_aborts_view_and_syncs():
+    """The full _process_proposal failure path: complain + sync + abort
+    (view.go:351-427)."""
+    async def run():
+        v = make_view(verifier=FakeVerifier(bad_proposal="bad block"))
+        pp = PrePrepare(view=1, seq=5, proposal=proposal_for(v))
+        v._process_msg(1, pp)
+        with pytest.raises(ViewAborted):
+            await v._process_proposal()
+        assert v.failure_detector.complaints == [(1, False)]
+        assert v.synchronizer.syncs == 1
+        assert v.stopped()
+
+    asyncio.run(run())
+
+
+def test_good_proposal_saves_wal_record_before_leader_broadcast():
+    """WAL-first ordering (view.go:404-423): the ProposedRecord is saved and
+    the leader broadcasts the pre-prepare after persisting."""
+    async def run():
+        v = make_view(self_id=1, leader_id=1)  # leader's own view
+        pp = PrePrepare(view=1, seq=5, proposal=proposal_for(v))
+        v._process_msg(1, pp)
+        await v._process_proposal()
+        assert v.phase == PROPOSED
+        assert len(v.state.saved) == 1
+        assert v.comm.broadcast == [pp]
+        # follower does not re-broadcast the pre-prepare
+        v2 = make_view(self_id=2, leader_id=1)
+        v2._process_msg(1, PrePrepare(view=1, seq=5, proposal=proposal_for(v2)))
+        await v2._process_proposal()
+        assert v2.comm.broadcast == []
+
+    asyncio.run(run())
