@@ -1,20 +1,28 @@
 """Cluster throughput benchmark: committed tx/sec with real crypto.
 
 The BASELINE.md north-star metric.  Spins an n-node cluster in one process
-(production wall-clock mode), every commit vote a real P-256 signature,
-and measures committed transactions per second end-to-end — submit,
-batch, three protocol phases, quorum signature verification, two fsync'd
-WAL appends per decision, deliver.
+(production wall-clock mode), every commit vote a real signature, and
+measures committed transactions per second end-to-end — submit, batch,
+three protocol phases, quorum signature verification, two fsync'd WAL
+appends per decision, deliver.
 
-Engines:
+Engines (--engines, comma-separated, one cluster run each):
   openssl — OpenSSL via the `cryptography` wheel (the fair stand-in for
-            the reference's Go crypto/ecdsa native path).
+            the reference's Go crypto/ecdsa native path).  p256 only.
   jax     — the batched device kernel + async coalescer (cross-sequence
             cross-replica batching).
   host    — pure-Python arithmetic (floor reference).
 
+Schemes (--scheme): p256 (default), ed25519 (BASELINE configs[3]),
+bls (configs[4]: aggregate quorum, one pairing equation per check).
+
+--share-engine (default on for jax): all replicas share ONE engine and ONE
+async coalescer — the single-chip deployment shape, where concurrent
+quorum checks from different replicas merge into shared kernel launches
+(the cross-replica half of configs[2]'s batching).
+
 Run:  python benchmarks/throughput.py [--nodes 4] [--requests 600]
-      [--batch 100] [--engines openssl,jax]
+      [--batch 100] [--engines openssl,jax] [--scheme p256]
 Prints one JSON line per engine plus a final comparison line.
 """
 
@@ -38,30 +46,62 @@ def _log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def build_engine(kind: str, pad_sizes):
-    from smartbft_tpu.crypto import p256
+def get_scheme(name: str):
+    if name == "p256":
+        from smartbft_tpu.crypto import p256
+
+        return p256
+    if name == "ed25519":
+        from smartbft_tpu.crypto import ed25519
+
+        return ed25519
+    if name == "bls":
+        from smartbft_tpu.crypto import bls12381
+
+        return bls12381
+    raise ValueError(f"unknown scheme {name}")
+
+
+def get_provider_cls(name: str):
+    from smartbft_tpu.crypto.provider import (
+        BlsCryptoProvider,
+        Ed25519CryptoProvider,
+        P256CryptoProvider,
+    )
+
+    return {"p256": P256CryptoProvider, "ed25519": Ed25519CryptoProvider,
+            "bls": BlsCryptoProvider}[name]
+
+
+def build_engine(kind: str, pad_sizes, scheme):
     from smartbft_tpu.crypto.provider import HostVerifyEngine, JaxVerifyEngine
 
     if kind == "openssl":
+        from smartbft_tpu.crypto import p256
         from smartbft_tpu.crypto.openssl_engine import OpenSSLVerifyEngine
 
-        return OpenSSLVerifyEngine(scheme=p256)
+        if scheme is not p256:
+            raise ValueError("the openssl engine is p256-only")
+        return OpenSSLVerifyEngine(scheme=scheme)
     if kind == "jax":
-        return JaxVerifyEngine(pad_sizes=pad_sizes, scheme=p256)
+        return JaxVerifyEngine(pad_sizes=pad_sizes, scheme=scheme)
     if kind == "host":
-        return HostVerifyEngine(scheme=p256)
+        return HostVerifyEngine(scheme=scheme)
     raise ValueError(f"unknown engine {kind}")
 
 
 async def run_cluster(engine_kind: str, n: int, requests: int, batch: int,
-                      pad_sizes) -> dict:
+                      pad_sizes, scheme_name: str = "p256",
+                      share_engine: bool = False) -> dict:
     import dataclasses
 
-    from smartbft_tpu.crypto import p256
-    from smartbft_tpu.crypto.provider import Keyring, P256CryptoProvider
+    from smartbft_tpu.crypto.provider import AsyncBatchCoalescer, Keyring
     from smartbft_tpu.testing.app import App, SharedLedgers, fast_config
     from smartbft_tpu.testing.network import Network
     from smartbft_tpu.utils.clock import Scheduler, WallClockDriver
+
+    scheme = get_scheme(scheme_name)
+    provider_cls = get_provider_cls(scheme_name)
 
     def cfg(i):
         return dataclasses.replace(
@@ -69,6 +109,7 @@ async def run_cluster(engine_kind: str, n: int, requests: int, batch: int,
             request_batch_max_count=batch,
             request_batch_max_interval=0.02,
             request_pool_size=max(2 * requests, 800),
+            incoming_message_buffer_size=max(2000, 40 * n),
             request_forward_timeout=300.0,
             request_complain_timeout=600.0,
             request_auto_remove_timeout=1200.0,
@@ -78,19 +119,48 @@ async def run_cluster(engine_kind: str, n: int, requests: int, batch: int,
         )
 
     node_ids = list(range(1, n + 1))
-    rings = Keyring.generate(node_ids, seed=b"bench-tput", scheme=p256)
-    engines = {i: build_engine(engine_kind, pad_sizes) for i in node_ids}
+    rings = Keyring.generate(node_ids, seed=b"bench-tput", scheme=scheme)
+    if share_engine:
+        one = build_engine(engine_kind, pad_sizes, scheme)
+        engines = {i: one for i in node_ids}
+        # wider fan-in window when a whole cluster shares one chip: a
+        # kernel launch costs ~100ms over the tunnel, so waiting ~20ms to
+        # merge every replica's quorum check into ONE launch is cheap
+        window = float(os.environ.get("SMARTBFT_BENCH_WINDOW", "0.02"))
+        coalescer = AsyncBatchCoalescer(one, window=window, max_batch=max(pad_sizes))
+        coalescers = {i: coalescer for i in node_ids}
+    else:
+        engines = {i: build_engine(engine_kind, pad_sizes, scheme)
+                   for i in node_ids}
+        coalescers = {i: None for i in node_ids}
 
-    # pre-warm every node's engine at every lane size so no XLA compile
-    # lands inside the timed window (each engine has its own jit wrapper)
+    # pre-warm every engine at every lane size so no XLA compile lands
+    # inside the timed window
     if engine_kind == "jax":
-        d, pub = p256.keygen(b"warm")
-        r, s = p256.sign(d, b"warm-msg")
-        for eng in engines.values():
+        sk, pub = scheme.keygen(b"warm")
+        item = scheme.make_item(
+            b"warm-msg", scheme.sign_raw(sk, b"warm-msg"), pub
+        )
+        t0 = time.perf_counter()
+        for eng in set(engines.values()):
             for size in pad_sizes:
-                eng.verify([(b"warm-msg", r, s, pub)] * size)
-        _log(f"bench[{engine_kind}]: pre-warmed pad sizes {tuple(pad_sizes)} "
-             f"on {len(engines)} engines")
+                eng.verify([item] * size)
+        _log(f"bench[{engine_kind}/{scheme_name}]: pre-warmed pad sizes "
+             f"{tuple(pad_sizes)} on {len(set(engines.values()))} engine(s) "
+             f"in {time.perf_counter() - t0:.1f}s")
+        # measure the steady-state per-launch overhead (tunnel RTT + pad):
+        # one warm launch at the smallest pad size
+        t0 = time.perf_counter()
+        for _ in range(3):
+            eng.verify([item])
+        launch_s = (time.perf_counter() - t0) / 3
+        _log(f"bench[{engine_kind}/{scheme_name}]: warm launch overhead "
+             f"{1e3 * launch_s:.1f} ms")
+        # drop warm-up traffic from the reported stats
+        from smartbft_tpu.crypto.provider import VerifyStats
+
+        for eng in set(engines.values()):
+            eng.stats = VerifyStats()
 
     scheduler = Scheduler()
     driver = WallClockDriver(scheduler, tick_interval=0.01)
@@ -100,7 +170,8 @@ async def run_cluster(engine_kind: str, n: int, requests: int, batch: int,
     apps = [
         App(i, network, shared, scheduler,
             wal_dir=os.path.join(tmp, f"wal-{i}"), config=cfg(i),
-            crypto=P256CryptoProvider(rings[i], engine=engines[i]))
+            crypto=provider_cls(rings[i], engine=engines[i],
+                                coalescer=coalescers[i]))
         for i in node_ids
     ]
     try:
@@ -129,14 +200,18 @@ async def run_cluster(engine_kind: str, n: int, requests: int, batch: int,
         elapsed = time.perf_counter() - t0
 
         decisions = len(apps[0].ledger())
-        stats = engines[node_ids[1]].stats  # a follower: pure verify duty
+        stats = engines[node_ids[1]].stats  # follower / shared engine
         return {
             "engine": engine_kind,
+            "scheme": scheme_name,
             "nodes": n,
+            "shared_engine": share_engine,
             "tx_per_sec": round(requests / elapsed, 1),
             "decisions": decisions,
             "batch_fill_pct": round(stats.batch_fill_pct, 1),
             "verify_us_per_sig": round(stats.us_per_sig, 1),
+            "launches": stats.launches,
+            "sigs_verified": stats.sigs_verified,
             "elapsed_s": round(elapsed, 2),
         }
     finally:
@@ -155,7 +230,13 @@ def main() -> None:
     ap.add_argument("--requests", type=int, default=600)
     ap.add_argument("--batch", type=int, default=100)
     ap.add_argument("--engines", default="openssl,jax")
+    ap.add_argument("--scheme", default="p256",
+                    choices=("p256", "ed25519", "bls"))
     ap.add_argument("--pad-sizes", default="8,32,128")
+    ap.add_argument("--share-engine", choices=("auto", "yes", "no"),
+                    default="auto",
+                    help="share one engine+coalescer across replicas "
+                         "(auto: yes for the jax engine)")
     ap.add_argument("--cpu", action="store_true",
                     help="pin JAX to the CPU backend")
     args = ap.parse_args()
@@ -166,9 +247,13 @@ def main() -> None:
 
     results = []
     for kind in args.engines.split(","):
+        share = (kind == "jax") if args.share_engine == "auto" \
+            else args.share_engine == "yes"
         try:
             res = asyncio.run(
-                run_cluster(kind, args.nodes, args.requests, args.batch, pad_sizes)
+                run_cluster(kind, args.nodes, args.requests, args.batch,
+                            pad_sizes, scheme_name=args.scheme,
+                            share_engine=share)
             )
         except TimeoutError as exc:
             _log(f"bench[{kind}]: FAILED — {exc}")

@@ -300,13 +300,27 @@ class CryptoProvider:
     scheme = p256
 
     def __init__(self, keyring: Keyring, engine=None,
-                 coalesce_window: Optional[float] = None):
+                 coalesce_window: Optional[float] = None,
+                 coalescer: Optional[AsyncBatchCoalescer] = None):
+        """``coalescer``: share one AsyncBatchCoalescer across providers —
+        the cross-REPLICA batching axis of BASELINE configs[2]: when many
+        replicas run against one chip, their concurrent quorum checks merge
+        into shared kernel launches instead of queueing per-replica ones."""
         self.keyring = keyring
-        self.engine = (engine if engine is not None
-                       else HostVerifyEngine(scheme=self.scheme))
+        if coalescer is not None and engine is not None \
+                and coalescer.engine is not engine:
+            raise ValueError("shared coalescer wraps a different engine")
+        self.engine = (
+            engine if engine is not None
+            else coalescer.engine if coalescer is not None
+            else HostVerifyEngine(scheme=self.scheme)
+        )
         eng_scheme = getattr(self.engine, "scheme", self.scheme)
         if eng_scheme is not self.scheme:
             raise ValueError("engine scheme does not match provider scheme")
+        if coalescer is not None:
+            self._coalescer = coalescer
+            return
         if coalesce_window is None:
             coalesce_window = getattr(
                 self.engine, "preferred_coalesce_window", 0.002
@@ -455,8 +469,8 @@ class BlsCryptoProvider(CryptoProvider):
 
     def __init__(self, keyring: Keyring, engine=None,
                  coalesce_window: Optional[float] = None,
-                 pops: Optional[dict[int, bytes]] = None):
-        super().__init__(keyring, engine, coalesce_window)
+                 coalescer=None, pops: Optional[dict[int, bytes]] = None):
+        super().__init__(keyring, engine, coalesce_window, coalescer)
         if pops is not None:
             for nid, pub in keyring.public_keys.items():
                 pop = pops.get(nid)
