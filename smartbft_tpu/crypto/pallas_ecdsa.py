@@ -78,6 +78,21 @@ INV_DIGITS = np.array(
     dtype=np.int32,
 )
 
+import os as _os_w
+
+#: joint Strauss-Shamir window width (2 or 3).  w=3 runs 86 iterations of
+#: (3 doublings + 1 add + sel64) vs w=2's 128 x (2 dbl + 1 add + sel16) —
+#: ~12% fewer sequential point-op levels, but the 64-way masked table
+#: select costs more than the saved levels on v5e (A/B, cached compiles,
+#: min-of-10: w2 31-35 us/sig vs w3 35+): the kernel is throughput-bound,
+#: so w=2 stays the default; w=3 is kept for latency-bound hardware.
+WINDOW = int(_os_w.environ.get("SMARTBFT_PALLAS_WINDOW", "2"))
+if WINDOW not in (2, 3):
+    raise ValueError("SMARTBFT_PALLAS_WINDOW must be 2 or 3")
+NDIGITS = -(-256 // WINDOW)  # MSB-first digit count for 256-bit scalars
+#: idx scratch rows, padded to a sublane multiple for the VMEM scratch
+_NDIG_PAD = -(-NDIGITS // 8) * 8
+
 
 # ---------------------------------------------------------------------------
 # limb-major bignum core.  Values are (..., NL, B) uint32: limb axis
@@ -394,6 +409,24 @@ def _digits2(a, ndig: int):
     return rows
 
 
+def _digits_w(a, ndig: int, width: int):
+    """(NL, B) scalar -> list of ndig (B,) MSB-first ``width``-bit digits.
+
+    Unlike :func:`_digits2`, windows may straddle a limb boundary (width 3
+    on 16-bit limbs), so each read spans two limbs."""
+    rows = []
+    nl = a.shape[-2]
+    mask = jnp.uint32((1 << width) - 1)
+    for k in range(ndig):
+        bitpos = width * (ndig - 1 - k)
+        limb, off = bitpos // LIMB_BITS, bitpos % LIMB_BITS
+        v = a[limb] >> jnp.uint32(off)
+        if off + width > LIMB_BITS and limb + 1 < nl:
+            v = v | (a[limb + 1] << jnp.uint32(LIMB_BITS - off))
+        rows.append(v & mask)
+    return rows
+
+
 class _JaxOps:
     """Dynamic-lookup strategy for the plain-JAX (validation) path."""
 
@@ -498,31 +531,60 @@ def _verify_block(ops, e, r, s, qx, qy):
     qpt = jnp.stack([xm, ym, jnp.broadcast_to(one_p, xm.shape)], axis=-3)
     inf = jnp.stack([zero, one_p, zero], axis=-3)
 
-    # G/Q doubles + triples: 2 stacked point ops
-    two = _point_double(fp, b_m, jnp.stack([gpt, qpt]))
-    three = _point_add(fp, b_m, two, jnp.stack([gpt, qpt]))
-    gs = [inf, gpt, two[0], three[0]]
-    qs = [inf, qpt, two[1], three[1]]
-    # joint table {i*G + j*Q}: all 16 combination adds in ONE stacked call
-    lhs = jnp.stack([g for g in gs for _ in range(4)])
-    rhs = jnp.stack([q for _ in range(4) for q in qs])
-    table = _point_add(fp, b_m, lhs, rhs)  # (16, 3, NL, B); entry 0 is
-    # inf+inf, which the complete formula correctly returns as inf
+    # joint table {i*G + j*Q : 0 <= i, j < 2^W}, built in O(W) stacked
+    # point-op levels (the complete formula handles P+P and inf, so every
+    # level is one grouped _point_add call); entry 0 is inf+inf = inf
+    if WINDOW == 2:
+        two = _point_double(fp, b_m, jnp.stack([gpt, qpt]))
+        three = _point_add(fp, b_m, two, jnp.stack([gpt, qpt]))
+        gs = [inf, gpt, two[0], three[0]]
+        qs = [inf, qpt, two[1], three[1]]
+    else:  # WINDOW == 3
+        two = _point_double(fp, b_m, jnp.stack([gpt, qpt]))
+        g2, q2 = two[0], two[1]
+        l2 = _point_add(
+            fp, b_m,
+            jnp.stack([gpt, g2, qpt, q2]),
+            jnp.stack([g2, g2, q2, q2]),
+        )  # 3G, 4G, 3Q, 4Q
+        g3, g4, q3, q4 = l2[0], l2[1], l2[2], l2[3]
+        l3 = _point_add(
+            fp, b_m,
+            jnp.stack([gpt, g2, g3, qpt, q2, q3]),
+            jnp.stack([g4, g4, g4, q4, q4, q4]),
+        )  # 5G, 6G, 7G, 5Q, 6Q, 7Q
+        gs = [inf, gpt, g2, g3, g4, l3[0], l3[1], l3[2]]
+        qs = [inf, qpt, q2, q3, q4, l3[3], l3[4], l3[5]]
+    base = 1 << WINDOW
+    if WINDOW == 2:
+        lhs = jnp.stack([g for g in gs for _ in range(base)])
+        rhs = jnp.stack([q for _ in range(base) for q in qs])
+        table = _point_add(fp, b_m, lhs, rhs)  # (16, 3, NL, B)
+    else:
+        # one 64-way stacked add would blow the 16MB VMEM budget (the
+        # grouped internals are ~6x the stack size); 8 sequential 8-way
+        # adds keep the live set at the w=2 scale for 7 extra one-time
+        # point-op levels
+        qstack = jnp.stack(qs)
+        rows = [_point_add(fp, b_m, jnp.stack([g] * base), qstack)
+                for g in gs]
+        table = jnp.concatenate(rows)  # (64, 3, NL, B)
 
-    d1 = _digits2(u1, 128)
-    d2 = _digits2(u2, 128)
-    ops.stash_idx([a * 4 + b for a, b in zip(d1, d2)])  # 128 x (B,)
+    d1 = _digits_w(u1, NDIGITS, WINDOW)
+    d2 = _digits_w(u2, NDIGITS, WINDOW)
+    ops.stash_idx([a * base + b for a, b in zip(d1, d2)])  # NDIGITS x (B,)
 
     def scan_body(i, acc):
-        acc = _point_double(fp, b_m, _point_double(fp, b_m, acc))
+        for _ in range(WINDOW):
+            acc = _point_double(fp, b_m, acc)
         idx = ops.idx_at(i)  # (B,), batch-varying
         sel = jnp.zeros((3, NL, nb), jnp.uint32)
-        for k in range(16):  # masked accumulation -- no per-lane gather
+        for k in range(base * base):  # masked accumulation -- no gather
             mk = (idx == k).astype(jnp.uint32)[None, None, :]
             sel = sel + table[k] * mk
         return _point_add(fp, b_m, acc, sel)
 
-    acc = lax.fori_loop(0, 128, scan_body, inf)
+    acc = lax.fori_loop(0, NDIGITS, scan_body, inf)
     xr, zr = acc[..., 0, :, :], acc[..., 2, :, :]
 
     not_inf = jnp.uint32(1) - _is_zero(zr)
@@ -591,7 +653,7 @@ def ecdsa_verify(e, r, s, qx, qy, tile: int = 128, interpret: bool = False):
         grid=(total // tile,),
         in_specs=[dig_spec] + [spec] * 5,
         out_specs=pl.BlockSpec((1, tile), lambda i: (0, i)),
-        scratch_shapes=[pltpu.VMEM((128, tile), jnp.uint32)],
+        scratch_shapes=[pltpu.VMEM((_NDIG_PAD, tile), jnp.uint32)],
         interpret=interpret,
     )(jnp.asarray(INV_DIGITS).reshape(1, -1), *args)
     return out[0, :bsz]

@@ -158,6 +158,24 @@ def test_digits_msb():
     assert got == exp
 
 
+def test_digits_w_crosses_limb_boundaries():
+    """3-bit windows straddle 16-bit limbs; every digit must still match
+    the Python-int reference."""
+    for _ in range(4):
+        v = rng.randrange(1 << 256)
+        a = to_cols([v])
+        ndig = -(-256 // 3)
+        rows = pe._digits_w(a, ndig, 3)
+        got = [int(np.asarray(r)[0]) for r in rows]
+        exp = [(v >> (3 * (ndig - 1 - k))) & 7 for k in range(ndig)]
+        assert got == exp
+    # width 2 agrees with the dedicated reader
+    v = rng.randrange(1 << 256)
+    a = to_cols([v])
+    assert [int(np.asarray(r)[0]) for r in pe._digits_w(a, 128, 2)] == \
+           [int(np.asarray(r)[0]) for r in pe._digits2(a, 128)]
+
+
 def test_pallas_ops_plumbing_interpret():
     """The Mosaic-path dynamic lookups (_PallasOps: VMEM idx scratch via
     pl.ds, SMEM digit reads) exercised through a real pallas_call in
