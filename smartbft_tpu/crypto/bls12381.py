@@ -1320,7 +1320,12 @@ def _dev_miller_fused(sig_x, sig_y, hm_x, hm_y, pk):
         T = _tree_select(mask_r, Ta, T2)
         return (f, T), None
 
-    (f, _), _ = lax.scan(body, (f0, T0), xs)
+    # unroll=2: the tunnel TPU compiler miscompiles the single-iteration
+    # loop-back of this scan at batch >= ~64 (the (B, 12, L) carry comes
+    # back corrupted; batch 5 is fine, components all verify in
+    # isolation).  Processing two steps per trip sidesteps the bad
+    # relayout and is bit-exact vs the host at every batch size tested.
+    (f, _), _ = lax.scan(body, (f0, T0), xs, unroll=2)
     return conj12(f)  # x < 0
 
 
@@ -1333,7 +1338,9 @@ def _dev_cyclo_exp_abs(m, bits_arr):
         acc = _tree_select(mask, mul12(acc, m), acc)
         return acc, None
 
-    acc, _ = lax.scan(body, m, jnp.asarray(bits_arr[1:]))
+    # unroll=2: same tunnel-compiler scan-carry workaround as the Miller
+    # loop (see _dev_miller_fused)
+    acc, _ = lax.scan(body, m, jnp.asarray(bits_arr[1:]), unroll=2)
     return acc
 
 
