@@ -79,12 +79,32 @@ class Keyring:
 
 @dataclass
 class VerifyStats:
-    """Batch-occupancy + latency accounting (BASELINE.md metrics)."""
+    """Batch-occupancy + latency accounting (BASELINE.md metrics).
+
+    ``metrics``: optionally a :class:`smartbft_tpu.metrics.TPUCryptoMetrics`
+    bundle — every record() then also feeds the embedder's metrics
+    provider (batch-fill histogram, per-sig latency, counters)."""
 
     launches: int = 0
     sigs_verified: int = 0
     slots_used: int = 0
     total_kernel_seconds: float = 0.0
+    metrics: object = None
+
+    def record(self, n_sigs: int, n_slots: int, seconds: float) -> None:
+        self.launches += 1
+        self.sigs_verified += n_sigs
+        self.slots_used += n_slots
+        self.total_kernel_seconds += seconds
+        if self.metrics is not None:
+            self.metrics.count_batches.add(1)
+            self.metrics.count_sigs_verified.add(n_sigs)
+            if n_slots:
+                self.metrics.batch_fill_percent.observe(100.0 * n_sigs / n_slots)
+            if n_sigs:
+                self.metrics.verify_latency_per_sig_us.observe(
+                    1e6 * seconds / n_sigs
+                )
 
     @property
     def batch_fill_pct(self) -> float:
@@ -103,9 +123,9 @@ class HostVerifyEngine:
     # sequential engine: coalescing gains nothing, don't add window latency
     preferred_coalesce_window = 0.0
 
-    def __init__(self, scheme=p256) -> None:
+    def __init__(self, scheme=p256, metrics=None) -> None:
         self.scheme = scheme
-        self.stats = VerifyStats()
+        self.stats = VerifyStats(metrics=metrics)
         self._lock = threading.Lock()
 
     def _verify_one(self, item) -> bool:
@@ -117,10 +137,7 @@ class HostVerifyEngine:
         out = [self._verify_one(item) for item in items]
         dt = time.perf_counter() - t0
         with self._lock:
-            self.stats.launches += 1
-            self.stats.sigs_verified += len(items)
-            self.stats.slots_used += len(items)
-            self.stats.total_kernel_seconds += dt
+            self.stats.record(len(items), len(items), dt)
         return out
 
 
@@ -135,10 +152,11 @@ class JaxVerifyEngine:
     preferred_coalesce_window = 0.002  # batched engine: wait for fan-in
 
     def __init__(self, pad_sizes: Sequence[int] = (8, 32, 128, 512, 2048),
-                 scheme=p256):
+                 scheme=p256, metrics=None):
         import jax  # deferred: engine construction may precede platform pin
 
         self._jax = jax
+        self._metrics = metrics
         self.scheme = scheme
         self.pad_sizes = tuple(sorted(pad_sizes))
         self._kernel = jax.jit(scheme.verify_kernel)
@@ -174,7 +192,7 @@ class JaxVerifyEngine:
 
             self._kernel = guarded_kernel
         self._lock = threading.Lock()
-        self.stats = VerifyStats()
+        self.stats = VerifyStats(metrics=metrics)
 
     def _pad_to(self, n: int) -> int:
         for s in self.pad_sizes:
@@ -211,10 +229,7 @@ class JaxVerifyEngine:
         mask = np.asarray(self._kernel(*(pad(a) for a in arrays)))
         dt = time.perf_counter() - t0
         with self._lock:
-            self.stats.launches += 1
-            self.stats.sigs_verified += n
-            self.stats.slots_used += size
-            self.stats.total_kernel_seconds += dt
+            self.stats.record(n, size, dt)
         return [bool(v) for v in mask[:n]]
 
 

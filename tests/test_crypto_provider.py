@@ -187,3 +187,20 @@ def test_e2e_consensus_with_real_ecdsa(tmp_path):
             await a.stop()
 
     asyncio.run(run())
+
+
+def test_engine_stats_feed_tpu_metrics(keyrings):
+    """VerifyStats.record forwards to the TPUCryptoMetrics bundle."""
+    from smartbft_tpu.metrics import InMemoryProvider, TPUCryptoMetrics
+
+    mem = InMemoryProvider()
+    engine = HostVerifyEngine()
+    engine.stats.metrics = TPUCryptoMetrics(mem)
+    prov = make_provider(keyrings, 1, engine=engine)
+    prop = Proposal(payload=b"m")
+    sigs = [make_provider(keyrings, i).sign_proposal(prop, b"") for i in (1, 2)]
+    prov.verify_consenter_sigs_batch(sigs, prop)
+    assert mem.counters["consensus.tpu.count_batches"] == 1
+    assert mem.counters["consensus.tpu.count_sigs_verified"] == 2
+    assert mem.histograms["consensus.tpu.batch_fill_percent"] == [100.0]
+    assert len(mem.histograms["consensus.tpu.verify_latency_per_sig_us"]) == 1
