@@ -106,11 +106,13 @@ def _openssl_baseline(items) -> float:
     prepared = _openssl_prepare(items)
     for msg, der, key in prepared[:32]:  # warm up EVP/allocator state
         key.verify(der, msg, ec.ECDSA(hashes.SHA256()))
-    t0 = time.perf_counter()
-    for msg, der, key in prepared:
-        key.verify(der, msg, ec.ECDSA(hashes.SHA256()))
-    dt = time.perf_counter() - t0
-    return 1e6 * dt / len(prepared)
+    best = float("inf")
+    for _ in range(3):  # best-of-3: give the baseline its least-noise run
+        t0 = time.perf_counter()
+        for msg, der, key in prepared:
+            key.verify(der, msg, ec.ECDSA(hashes.SHA256()))
+        best = min(best, time.perf_counter() - t0)
+    return 1e6 * best / len(prepared)
 
 
 def _openssl_all_cores_baseline(items) -> tuple[float, int]:
@@ -131,11 +133,15 @@ def _openssl_all_cores_baseline(items) -> tuple[float, int]:
         msg, der, key = job
         key.verify(der, msg, ec.ECDSA(hashes.SHA256()))
 
+    chunk = max(1, len(prepared) // (4 * ncores))
+    best = float("inf")
     with ThreadPoolExecutor(max_workers=ncores) as pool:
-        t0 = time.perf_counter()
-        list(pool.map(verify_one, prepared, chunksize=max(1, len(prepared) // (4 * ncores))))
-        dt = time.perf_counter() - t0
-    return 1e6 * dt / len(prepared), ncores
+        list(pool.map(verify_one, prepared[:64], chunksize=chunk))  # ramp up
+        for _ in range(3):  # best-of-3, like the single-core baseline
+            t0 = time.perf_counter()
+            list(pool.map(verify_one, prepared, chunksize=chunk))
+            best = min(best, time.perf_counter() - t0)
+    return 1e6 * best / len(prepared), ncores
 
 
 def main() -> None:
