@@ -324,7 +324,27 @@ def verify_int(pub: bytes, msg: bytes, sig: bytes) -> bool:
 # host <-> kernel marshalling (scheme API used by the verify engines)
 # ---------------------------------------------------------------------------
 
+try:  # native signing fast path (RFC 8032 is deterministic, so OpenSSL
+    # produces byte-identical signatures to the pure-Python sign(); the
+    # pure path costs ~180 ms per signature on this host, OpenSSL ~50 us)
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey as _CgEd25519,
+    )
+
+    _CG_KEYS: dict = {}
+
+    def _sign_native(priv: bytes, msg: bytes) -> bytes:
+        key = _CG_KEYS.get(priv)
+        if key is None:
+            key = _CG_KEYS[priv] = _CgEd25519.from_private_bytes(priv)
+        return key.sign(msg)
+except Exception:  # pragma: no cover — wheel absent
+    _sign_native = None
+
+
 def sign_raw(priv: bytes, msg: bytes) -> bytes:
+    if _sign_native is not None:
+        return _sign_native(priv, msg)
     return sign(priv, msg)
 
 

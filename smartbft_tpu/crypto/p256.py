@@ -353,9 +353,40 @@ def verify_inputs(items) -> tuple[np.ndarray, ...]:
 # scheme API (uniform surface the verify engines/providers program against)
 # ---------------------------------------------------------------------------
 
+try:  # native signing fast path: the reference signs with Go's native
+    # crypto/ecdsa; pure-Python signing costs ~9.5 ms and dominated the
+    # cluster protocol loop, OpenSSL via the cryptography wheel does it in
+    # ~60 us.  Verification paths are unaffected (that is the TPU's job).
+    from cryptography.hazmat.primitives import hashes as _cg_hashes
+    from cryptography.hazmat.primitives.asymmetric import ec as _cg_ec
+    from cryptography.hazmat.primitives.asymmetric.utils import (
+        decode_dss_signature as _cg_decode_dss,
+    )
+
+    _CG_KEYS: dict = {}
+
+    def _sign_native(priv: int, msg: bytes):
+        key = _CG_KEYS.get(priv)
+        if key is None:
+            key = _CG_KEYS[priv] = _cg_ec.derive_private_key(
+                priv, _cg_ec.SECP256R1()
+            )
+        der = key.sign(msg, _cg_ec.ECDSA(_cg_hashes.SHA256()))
+        return _cg_decode_dss(der)
+except Exception:  # pragma: no cover — wheel absent: pure-Python fallback
+    _sign_native = None
+
+
 def sign_raw(priv: int, msg: bytes) -> bytes:
-    """Sign and encode as fixed 64-byte big-endian r || s."""
-    r, s = sign(priv, msg)
+    """Sign and encode as fixed 64-byte big-endian r || s.
+
+    Uses the native OpenSSL signer when available (non-deterministic k,
+    like the reference's crypto/ecdsa); :func:`sign` remains the
+    deterministic RFC 6979 pure-Python reference."""
+    if _sign_native is not None:
+        r, s = _sign_native(priv, msg)
+    else:
+        r, s = sign(priv, msg)
     return r.to_bytes(32, "big") + s.to_bytes(32, "big")
 
 
