@@ -331,14 +331,21 @@ try:  # native signing fast path (RFC 8032 is deterministic, so OpenSSL
         Ed25519PrivateKey as _CgEd25519,
     )
 
-    _CG_KEYS: dict = {}
+    import functools as _ft
+
+    @_ft.lru_cache(maxsize=256)  # bounded, like _expand_key
+    def _cg_key(priv: bytes):
+        return _CgEd25519.from_private_bytes(priv)
 
     def _sign_native(priv: bytes, msg: bytes) -> bytes:
-        key = _CG_KEYS.get(priv)
-        if key is None:
-            key = _CG_KEYS[priv] = _CgEd25519.from_private_bytes(priv)
-        return key.sign(msg)
-except Exception:  # pragma: no cover — wheel absent
+        return _cg_key(priv).sign(msg)
+except Exception as _exc:  # pragma: no cover — wheel absent/broken
+    import logging as _logging
+
+    _logging.getLogger("smartbft_tpu.crypto").warning(
+        "native Ed25519 signer unavailable (%s); falling back to the "
+        "pure-Python signer", _exc,
+    )
     _sign_native = None
 
 

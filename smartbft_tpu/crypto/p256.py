@@ -363,17 +363,22 @@ try:  # native signing fast path: the reference signs with Go's native
         decode_dss_signature as _cg_decode_dss,
     )
 
-    _CG_KEYS: dict = {}
+    import functools as _ft
+
+    @_ft.lru_cache(maxsize=256)  # bounded: like ed25519._expand_key
+    def _cg_key(priv: int):
+        return _cg_ec.derive_private_key(priv, _cg_ec.SECP256R1())
 
     def _sign_native(priv: int, msg: bytes):
-        key = _CG_KEYS.get(priv)
-        if key is None:
-            key = _CG_KEYS[priv] = _cg_ec.derive_private_key(
-                priv, _cg_ec.SECP256R1()
-            )
-        der = key.sign(msg, _cg_ec.ECDSA(_cg_hashes.SHA256()))
+        der = _cg_key(priv).sign(msg, _cg_ec.ECDSA(_cg_hashes.SHA256()))
         return _cg_decode_dss(der)
-except Exception:  # pragma: no cover — wheel absent: pure-Python fallback
+except Exception as _exc:  # pragma: no cover — wheel absent/broken
+    import logging as _logging
+
+    _logging.getLogger("smartbft_tpu.crypto").warning(
+        "native P-256 signer unavailable (%s); falling back to the "
+        "~150x slower pure-Python signer", _exc,
+    )
     _sign_native = None
 
 
