@@ -419,6 +419,26 @@ class View:
                 break
             await self._next_event()
 
+        # sweep prepares that are already queued/registered into the witness
+        # list before signing: PreparesFrom is the liveness evidence behind
+        # blacklist redemption (util.go:502-541), and crediting only the
+        # FIRST quorum-1 voters lets a slow-but-alive replica lose the
+        # witness race on every decision and never get redeemed
+        # (the vote set dedupes per sender, so one more pass of the same
+        # collection loop suffices)
+        self._drain_inbox()
+        while taken < len(self.prepares.votes):
+            vote = self.prepares.votes[taken]
+            taken += 1
+            prepare = vote.msg
+            if prepare.digest != expected_digest:
+                self.logger.warnf(
+                    "Got wrong digest at processPrepares for prepare with seq %d",
+                    prepare.seq,
+                )
+                continue
+            voter_ids.append(vote.sender)
+
         self.logger.infof(
             "%d collected %d prepares from %s", self.self_id, len(voter_ids), voter_ids
         )
