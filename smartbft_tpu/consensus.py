@@ -458,8 +458,9 @@ class Consensus:
     async def _start_components(
         self, view: int, seq: int, dec: int, config_sync: bool
     ) -> None:
-        """consensus.go:513-523."""
+        """consensus.go:513-523 (+507-511 waitForEachOther barrier)."""
         self.collector.start()
+        self.view_changer.controller_started_event = asyncio.Event()
         self.view_changer.start(view)
         if self._restore_view_change:
             self.view_changer.restore_trigger()
@@ -471,9 +472,14 @@ class Consensus:
             Ticker(self.scheduler, self.heartbeat_tick_interval,
                    lambda: self.controller.leader_monitor.tick(self.scheduler.now()))
         )
-        await self.controller.start(
-            view, seq + 1, dec, self.config.sync_on_start if config_sync else False
-        )
+        try:
+            await self.controller.start(
+                view, seq + 1, dec, self.config.sync_on_start if config_sync else False
+            )
+        finally:
+            # always release the barrier — a failed start must not leave the
+            # viewchanger task parked forever (controller.go:813)
+            self.view_changer.controller_started_event.set()
 
     def _stop_tickers(self) -> None:
         for t in self._tickers:

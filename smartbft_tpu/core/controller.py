@@ -151,6 +151,12 @@ class Controller(RequestTimeoutHandler):
         self.curr_decisions_in_view = 0
         self.verification_sequence = 0
 
+        # Internal control events only (1-slot tokens + decision rendezvous,
+        # all bounded by construction).  Inbound network messages never queue
+        # here: process_messages dispatches synchronously into the View /
+        # ViewChanger / HeartbeatMonitor / StateCollector inboxes, each of
+        # which enforces its own bound (the reference instead bounds the
+        # controller's inMsgs channel, consensus.go:337).
         self._events: asyncio.Queue = asyncio.Queue()
         self._stopped = False
         self._task: Optional[asyncio.Task] = None

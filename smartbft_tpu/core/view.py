@@ -171,6 +171,7 @@ class View:
         self._blacklist_supported = False
 
         self._inbox: asyncio.Queue = asyncio.Queue()
+        self._dropped_msgs = 0  # overflow counter for the bounded inbox
         self._aborted = False
         self._task: Optional[asyncio.Task] = None
         # 1-slot pre-prepare stashes (view.go:105-111)
@@ -223,6 +224,17 @@ class View:
 
     def handle_message(self, sender: int, msg: Message) -> None:
         if self._aborted:
+            return
+        # Bounded inbox (consensus.go:337 IncomingMessageBufferSize; the
+        # reference's View drains a buffered channel, view.go:274): drop on
+        # overflow so a Byzantine flooder cannot grow memory without limit.
+        if self._inbox.qsize() >= self.in_msg_q_size:
+            self._dropped_msgs += 1
+            if self._dropped_msgs == 1 or self._dropped_msgs % 1000 == 0:
+                self.logger.warnf(
+                    "View %d inbox full (%d), dropped %d messages from %d",
+                    self.number, self.in_msg_q_size, self._dropped_msgs, sender,
+                )
             return
         self._inbox.put_nowait((sender, msg))
 

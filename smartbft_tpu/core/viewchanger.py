@@ -273,6 +273,12 @@ class ViewChanger:
         self.real_view = 0
         self.next_view = 0
         self._events: asyncio.Queue = asyncio.Queue()
+        self._queued_msgs = 0  # network messages in-queue (bounded; internal events are not)
+        self._dropped_msgs = 0
+        # start barrier (consensus.go:507-511 waitForEachOther): the run loop
+        # holds off processing until the Controller finished starting, so a
+        # message racing a start/reconfig cannot hit a half-wired ViewChanger.
+        self.controller_started_event: Optional[asyncio.Event] = None
         self._stopped = False
         self._task: Optional[asyncio.Task] = None
         self._restore_on_start = False
@@ -321,6 +327,8 @@ class ViewChanger:
     def close(self) -> None:
         if not self._stopped:
             self._stopped = True
+            if self.controller_started_event is not None:
+                self.controller_started_event.set()  # release the start barrier
             self._events.put_nowait(("stop",))
             for fut in (self._in_flight_decide, self._in_flight_sync):
                 if fut is not None and not fut.done():
@@ -337,6 +345,18 @@ class ViewChanger:
     def handle_message(self, sender: int, m: Message) -> None:
         if self._stopped:
             return
+        # Bounded message intake (consensus.go:406 IncomingMessageBufferSize):
+        # only network messages count toward the bound — internal control
+        # events (change/inform/tick/stop) must never be dropped.
+        if self._queued_msgs >= self.in_msg_q_size:
+            self._dropped_msgs += 1
+            if self._dropped_msgs == 1 or self._dropped_msgs % 1000 == 0:
+                self.logger.warnf(
+                    "ViewChanger inbox full (%d), dropped %d messages from %d",
+                    self.in_msg_q_size, self._dropped_msgs, sender,
+                )
+            return
+        self._queued_msgs += 1
         self._events.put_nowait(("msg", sender, m))
 
     def handle_view_message(self, sender: int, m: Message) -> None:
@@ -375,13 +395,19 @@ class ViewChanger:
     # ------------------------------------------------------------------ loop
 
     async def _run(self) -> None:
+        if self.controller_started_event is not None:
+            await self.controller_started_event.wait()  # viewchanger.go:156
         while True:
             evt = await self._events.get()
             kind = evt[0]
-            if kind == "stop":
+            # close() may have released the start barrier with a message
+            # backlog still queued ahead of the stop sentinel — never process
+            # it against a half-started controller
+            if kind == "stop" or self._stopped:
                 return
             try:
                 if kind == "msg":
+                    self._queued_msgs -= 1
                     await self._process_msg(evt[1], evt[2])
                 elif kind == "change":
                     self._pending_changes -= 1

@@ -13,7 +13,7 @@ import dataclasses
 import pytest
 
 from smartbft_tpu.codec import decode
-from smartbft_tpu.messages import Commit, PrePrepare, ViewMetadata
+from smartbft_tpu.messages import Commit, Prepare, PrePrepare, ViewChange, ViewMetadata
 from smartbft_tpu.testing.app import fast_config, wait_for
 
 from tests.test_basic import make_nodes, start_all, stop_all
@@ -344,6 +344,43 @@ def test_blacklist_after_view_change(tmp_path):
             if 1 not in list(md.black_list):
                 break
         assert 1 not in list(md.black_list), f"node 1 never redeemed: {md}"
+        await stop_all(apps)
+
+    asyncio.run(run())
+
+
+def test_byzantine_flood_bounded_memory(tmp_path):
+    """A Byzantine member spams 10^5 messages straight into a replica's
+    dispatch path: the per-component inboxes stay bounded
+    (IncomingMessageBufferSize, consensus.go:337,406) and the cluster
+    still orders new requests afterwards (liveness holds)."""
+
+    async def run():
+        apps, scheduler, network, shared = make_nodes(4, tmp_path)
+        await start_all(apps)
+        await apps[0].submit("c", "warm")
+        await wait_for(lambda: all(a.height() >= 1 for a in apps), scheduler)
+
+        victim = apps[1].consensus
+        bound = apps[1].consensus.config.incoming_message_buffer_size
+        # flood the View inbox (prepares for a far-future sequence never
+        # drain into votes) and the ViewChanger inbox (stale view-changes)
+        for i in range(100_000):
+            if i % 2 == 0:
+                victim.handle_message(3, Prepare(view=0, seq=7, digest="flood"))
+            else:
+                victim.handle_message(3, ViewChange(next_view=0, reason="flood"))
+
+        view_q = victim.controller.curr_view._inbox.qsize()
+        vc_q = victim.view_changer._queued_msgs
+        assert view_q <= bound + 1, f"view inbox grew to {view_q}"
+        assert vc_q <= bound, f"viewchanger inbox grew to {vc_q}"
+        assert victim.controller.curr_view._dropped_msgs > 0
+        assert victim.view_changer._dropped_msgs > 0
+
+        # liveness: the flooded replica still participates in new decisions
+        await apps[0].submit("c", "after-flood")
+        await wait_for(lambda: all(a.height() >= 2 for a in apps), scheduler, timeout=240.0)
         await stop_all(apps)
 
     asyncio.run(run())
