@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import hashlib
 import hmac
+import os as _os
 import secrets
 
 import numpy as np
@@ -387,8 +388,13 @@ def sign_raw(priv: int, msg: bytes) -> bytes:
 
     Uses the native OpenSSL signer when available (non-deterministic k,
     like the reference's crypto/ecdsa); :func:`sign` remains the
-    deterministic RFC 6979 pure-Python reference."""
-    if _sign_native is not None:
+    deterministic RFC 6979 pure-Python reference.  Set
+    ``SMARTBFT_DETERMINISTIC_SIGN=1`` to force the RFC 6979 path so
+    signature bytes for identical (priv, msg) are reproducible across
+    environments regardless of whether the cryptography wheel imports."""
+    if _sign_native is not None and _os.environ.get(
+        "SMARTBFT_DETERMINISTIC_SIGN"
+    ) != "1":
         r, s = _sign_native(priv, msg)
     else:
         r, s = sign(priv, msg)

@@ -26,8 +26,11 @@ Pallas kernels may not capture array constants, so every bignum constant
 is rebuilt inside the kernel from Python ints (scalar broadcasts), and the
 static inversion-exponent digit string enters as a small operand.
 
-Use :func:`ecdsa_verify` (grid over batch tiles, pads internally) or the
-engine flag ``SMARTBFT_PALLAS=1`` (see provider.JaxVerifyEngine).
+This kernel is the DEFAULT engine path on TPU backends (see
+provider.JaxVerifyEngine): :func:`ecdsa_verify` (grid over batch tiles,
+pads internally) is selected automatically when the backend is a TPU,
+forced on elsewhere with ``SMARTBFT_PALLAS=1``, disabled with
+``SMARTBFT_PALLAS=0``.
 """
 
 from __future__ import annotations

@@ -160,39 +160,106 @@ class JaxVerifyEngine:
         self.scheme = scheme
         self.pad_sizes = tuple(sorted(pad_sizes))
         self._kernel = jax.jit(scheme.verify_kernel)
-        # SMARTBFT_PALLAS=1 opts the P-256 path into the fused limb-major
-        # Pallas kernel (pallas_ecdsa.ecdsa_verify) — TPU only.  The first
-        # call probes it; a Mosaic/compile failure (non-TPU backend, older
-        # toolchain) falls back to the XLA kernel instead of taking down the
-        # consensus verify path.
-        if os.environ.get("SMARTBFT_PALLAS") == "1" and scheme is p256:
+        # The fused limb-major Pallas kernel (pallas_ecdsa.ecdsa_verify) is
+        # the DEFAULT P-256 path whenever the backend is a TPU — a production
+        # embedder gets the fast path with no env plumbing.  SMARTBFT_PALLAS=0
+        # (or any set value other than "1") opts out; SMARTBFT_PALLAS=1
+        # forces it on other backends (CI uses interpret-mode tests instead).
+        # The backend probe is LAZY — deciding at the first kernel call, when
+        # backend init is inevitable anyway — so constructing an engine never
+        # initializes jax (platform pins like force_cpu still work after).
+        if self.supports_pallas and scheme is p256 \
+                and os.environ.get("SMARTBFT_PALLAS", "1") == "1":
             from . import pallas_ecdsa
 
             xla_kernel = self._kernel
-            state = {"pallas": True}
+            # tri-state guard: compile-type failures (Mosaic lowering, an
+            # unimplemented primitive) disable the Pallas path permanently;
+            # transient runtime blips (momentary device OOM, a flaky tunnel)
+            # fall back per-call and retry, up to a consecutive-failure cap
+            state = {"pallas": None, "transient": 0}
 
             def guarded_kernel(*arrays):
-                # permanent guard, not a first-call probe: every padded
-                # batch size jit-compiles the Pallas kernel afresh, and a
-                # Mosaic/OOM failure at ANY size must degrade to the XLA
-                # kernel instead of taking down the consensus verify path
+                if state["pallas"] is None:
+                    state["pallas"] = self._use_pallas(scheme)
                 if state["pallas"]:
                     try:
-                        return pallas_ecdsa.ecdsa_verify(*arrays)
-                    except Exception as exc:  # noqa: BLE001 — compile/OOM
+                        out = pallas_ecdsa.ecdsa_verify(*arrays)
+                        state["transient"] = 0
+                        return out
+                    except Exception as exc:  # noqa: BLE001
                         import logging
 
-                        logging.getLogger("smartbft_tpu.crypto").warning(
-                            "pallas kernel unavailable (%s: %s); engine "
-                            "falls back to the XLA kernel",
-                            type(exc).__name__, exc,
-                        )
-                        state["pallas"] = False
+                        log = logging.getLogger("smartbft_tpu.crypto")
+                        if self._is_permanent_kernel_error(exc):
+                            state["pallas"] = False
+                            log.warning(
+                                "pallas kernel failed to compile (%s: %s); "
+                                "engine PERMANENTLY falls back to the XLA "
+                                "kernel for this process",
+                                type(exc).__name__, exc,
+                            )
+                        else:
+                            state["transient"] += 1
+                            if state["transient"] >= 5:
+                                state["pallas"] = False
+                                log.warning(
+                                    "pallas kernel failed %d consecutive "
+                                    "times (%s: %s); engine PERMANENTLY "
+                                    "falls back to the XLA kernel",
+                                    state["transient"], type(exc).__name__, exc,
+                                )
+                            else:
+                                log.warning(
+                                    "pallas kernel transient failure %d/5 "
+                                    "(%s: %s); this call uses the XLA "
+                                    "kernel, next call retries pallas",
+                                    state["transient"], type(exc).__name__, exc,
+                                )
                 return xla_kernel(*arrays)
 
             self._kernel = guarded_kernel
         self._lock = threading.Lock()
         self.stats = VerifyStats(metrics=metrics)
+
+    #: subclasses whose inputs are mesh-placed (ShardedVerifyEngine) must
+    #: opt out — pallas_call has no partitioning rules, so routing sharded
+    #: lanes into it would silently collapse the mesh to one device
+    supports_pallas = True
+
+    def _use_pallas(self, scheme) -> bool:
+        """Default the fused Pallas kernel on when the backend is a TPU.
+
+        Called lazily from the first kernel invocation (never at engine
+        construction — see __init__): any set SMARTBFT_PALLAS value other
+        than "1" disables, "1" forces on, unset auto-detects the backend."""
+        if scheme is not p256 or not self.supports_pallas:
+            return False
+        flag = os.environ.get("SMARTBFT_PALLAS")
+        if flag is not None:
+            return flag == "1"
+        try:
+            backend = self._jax.default_backend()
+        except Exception:  # backend init failure — let the XLA path report it
+            return False
+        # the axon plugin exposes the tunneled TPU under its own platform name
+        return backend in ("tpu", "axon")
+
+    @staticmethod
+    def _is_permanent_kernel_error(exc: Exception) -> bool:
+        """Compile-type failures never succeed on retry; runtime blips may."""
+        text = f"{type(exc).__name__}: {exc}"
+        permanent = (
+            "Mosaic", "lowering", "Lowering", "NotImplemented",
+            "Unsupported", "unsupported", "INVALID_ARGUMENT", "UNIMPLEMENTED",
+        )
+        transient = (
+            "RESOURCE_EXHAUSTED", "UNAVAILABLE", "DEADLINE_EXCEEDED",
+            "ABORTED", "CANCELLED", "Connection", "Socket", "timed out",
+        )
+        if any(t in text for t in transient):
+            return False
+        return any(p in text for p in permanent)
 
     def _pad_to(self, n: int) -> int:
         for s in self.pad_sizes:

@@ -55,6 +55,10 @@ class ShardedVerifyEngine(JaxVerifyEngine):
     with a lane sharding and XLA partitions the kernel.
     """
 
+    # the fused Pallas kernel is single-device (no partitioning rules);
+    # mesh-placed lanes must stay on the XLA kernel so jit partitions them
+    supports_pallas = False
+
     def __init__(self, mesh=None,
                  pad_sizes: tuple[int, ...] = (64, 256, 1024), scheme=p256):
         from jax.sharding import NamedSharding, PartitionSpec

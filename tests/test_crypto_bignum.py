@@ -80,3 +80,62 @@ def test_bits_msb():
     arr = jnp.asarray(bn.to_limbs(x, 4))[None]
     bits = np.asarray(bn.bits_msb(arr, 20))[0]
     assert int("".join(str(b) for b in bits), 2) == x
+
+
+# ---------------------------------------------------------------------------
+# both carry-chain implementations stay verified against the integer
+# reference (the non-default mode is otherwise a dead path that can rot)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("mode", ["prefix", "scan"])
+def test_carry_chain_modes_match_ints(mode, monkeypatch):
+    import smartbft_tpu.crypto.bignum as bn_mod
+
+    monkeypatch.setattr(bn_mod, "CHAIN", mode)
+    rng = np.random.default_rng(7)
+    # column sums < 2^31 as carry_propagate's contract requires
+    cols = rng.integers(0, 1 << 31, size=(5, 24), dtype=np.uint32)
+    out = np.asarray(bn_mod.carry_propagate(jnp.asarray(cols), 24))
+    for row_in, row_out in zip(cols, out):
+        want = sum(int(v) << (16 * i) for i, v in enumerate(row_in))
+        want %= 1 << (16 * 24)
+        got = sum(int(v) << (16 * i) for i, v in enumerate(row_out))
+        assert got == want
+
+    a = rng.integers(0, 1 << 16, size=(6, 16), dtype=np.uint32)
+    b = rng.integers(0, 1 << 16, size=(6, 16), dtype=np.uint32)
+    diff, borrow = bn_mod.sub_borrow(jnp.asarray(a), jnp.asarray(b))
+    diff, borrow = np.asarray(diff), np.asarray(borrow)
+    for ra, rb, rd, bo in zip(a, b, diff, borrow):
+        ia = sum(int(v) << (16 * i) for i, v in enumerate(ra))
+        ib = sum(int(v) << (16 * i) for i, v in enumerate(rb))
+        idiff = sum(int(v) << (16 * i) for i, v in enumerate(rd))
+        assert idiff == (ia - ib) % (1 << 256)
+        assert int(bo) == (1 if ia < ib else 0)
+
+
+@pytest.mark.parametrize("mode", ["ripple", "prefix"])
+def test_pallas_carry_chain_modes_match_ints(mode, monkeypatch):
+    import smartbft_tpu.crypto.pallas_ecdsa as pe_mod
+
+    monkeypatch.setattr(pe_mod, "CHAIN", mode)
+    rng = np.random.default_rng(11)
+    # limb-major (m, B) columns < 2^31
+    cols = rng.integers(0, 1 << 31, size=(24, 4), dtype=np.uint32)
+    out = np.asarray(pe_mod._carry(jnp.asarray(cols)))
+    for lane in range(4):
+        want = sum(int(v) << (16 * i) for i, v in enumerate(cols[:, lane]))
+        want %= 1 << (16 * 24)
+        got = sum(int(v) << (16 * i) for i, v in enumerate(out[:, lane]))
+        assert got == want
+
+    a = rng.integers(0, 1 << 16, size=(16, 5), dtype=np.uint32)
+    b = rng.integers(0, 1 << 16, size=(16, 5), dtype=np.uint32)
+    diff, borrow = pe_mod._sub_borrow(jnp.asarray(a), jnp.asarray(b))
+    diff, borrow = np.asarray(diff), np.asarray(borrow)
+    for lane in range(5):
+        ia = sum(int(v) << (16 * i) for i, v in enumerate(a[:, lane]))
+        ib = sum(int(v) << (16 * i) for i, v in enumerate(b[:, lane]))
+        idiff = sum(int(v) << (16 * i) for i, v in enumerate(diff[:, lane]))
+        assert idiff == (ia - ib) % (1 << 256)
+        assert int(borrow[lane]) == (1 if ia < ib else 0)

@@ -243,3 +243,94 @@ def test_full_kernel_matches_reference():
     mask = np.asarray(body(e, r, s, qx, qy))
     exp = np.array([p256.verify_item(it) for it in items], np.uint32)
     assert (mask == exp).all()
+
+
+class _FakeJax:
+    def __init__(self, backend):
+        self._backend = backend
+
+    def default_backend(self):
+        if isinstance(self._backend, Exception):
+            raise self._backend
+        return self._backend
+
+    def jit(self, fn):
+        return fn
+
+
+def _engine_probe(backend, env, monkeypatch):
+    """Evaluate JaxVerifyEngine._use_pallas against a faked backend."""
+    from smartbft_tpu.crypto.provider import JaxVerifyEngine
+
+    if env is None:
+        monkeypatch.delenv("SMARTBFT_PALLAS", raising=False)
+    else:
+        monkeypatch.setenv("SMARTBFT_PALLAS", env)
+    eng = JaxVerifyEngine.__new__(JaxVerifyEngine)
+    eng._jax = _FakeJax(backend)
+    return eng._use_pallas(p256)
+
+
+@pytest.mark.parametrize("backend,env,want", [
+    ("tpu", None, True),       # default ON on TPU
+    ("axon", None, True),      # tunneled TPU platform name
+    ("cpu", None, False),      # default OFF elsewhere
+    ("tpu", "0", False),       # explicit opt-out wins
+    ("tpu", "false", False),   # any set value other than "1" disables
+    ("tpu", "", False),
+    ("cpu", "1", True),        # explicit opt-in wins
+    (RuntimeError("no backend"), None, False),  # init failure -> XLA path
+])
+def test_pallas_default_on_tpu(backend, env, want, monkeypatch):
+    assert _engine_probe(backend, env, monkeypatch) is want
+
+
+def test_kernel_error_classification():
+    from smartbft_tpu.crypto.provider import JaxVerifyEngine
+
+    perm = JaxVerifyEngine._is_permanent_kernel_error
+    assert perm(RuntimeError("Mosaic failed to legalize op"))
+    assert perm(NotImplementedError("dynamic gather"))
+    assert perm(ValueError("INVALID_ARGUMENT: bad block shape"))
+    # transient classes retry
+    assert not perm(RuntimeError("RESOURCE_EXHAUSTED: out of memory"))
+    assert not perm(RuntimeError("UNAVAILABLE: Socket closed"))
+    assert not perm(OSError("Connection reset by peer"))
+    # unknown errors default to transient (retry, bounded by the cap)
+    assert not perm(RuntimeError("some novel error"))
+
+
+def test_guarded_kernel_transient_then_permanent(monkeypatch):
+    """A flaky kernel falls back per-call and retries; 5 consecutive
+    transient failures (or one compile failure) disable it permanently."""
+    import smartbft_tpu.crypto.pallas_ecdsa as pe_mod
+    from smartbft_tpu.crypto.provider import JaxVerifyEngine
+
+    monkeypatch.setenv("SMARTBFT_PALLAS", "1")
+    calls = {"pallas": 0, "xla": 0}
+    fail_with = {"exc": RuntimeError("UNAVAILABLE: tunnel blip")}
+
+    def fake_pallas(*arrays):
+        calls["pallas"] += 1
+        raise fail_with["exc"]
+
+    monkeypatch.setattr(pe_mod, "ecdsa_verify", fake_pallas)
+
+    def fake_verify_kernel(*arrays):
+        calls["xla"] += 1
+        return np.ones(1, np.uint32)
+
+    monkeypatch.setattr(p256, "verify_kernel", fake_verify_kernel, raising=False)
+    import jax as real_jax
+
+    monkeypatch.setattr(real_jax, "jit", lambda fn: fn)  # count real calls
+    eng = JaxVerifyEngine(pad_sizes=(8,), scheme=p256)
+
+    for i in range(4):
+        eng._kernel()
+    assert calls["pallas"] == 4  # still retrying
+    eng._kernel()
+    assert calls["pallas"] == 5
+    eng._kernel()  # permanently disabled now
+    assert calls["pallas"] == 5
+    assert calls["xla"] == 6
