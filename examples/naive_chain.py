@@ -43,6 +43,7 @@ from smartbft_tpu.messages import Message, Proposal, Signature, ViewMetadata
 from smartbft_tpu.types import Decision, Reconfig, RequestInfo, SyncResponse
 from smartbft_tpu.utils.clock import Scheduler, WallClockDriver
 from smartbft_tpu.utils.logging import StdLogger
+from smartbft_tpu.utils.memo import BoundedMemo
 
 
 # --------------------------------------------------------------------------
@@ -142,6 +143,7 @@ class ChainNode(Application, Assembler, Signer, Verifier, RequestInspector,
         )
         self.wal_dir = wal_dir
         self.logger = StdLogger(f"chain-{node_id}")
+        self._request_id_cache: BoundedMemo = BoundedMemo()
         self.blocks: list[tuple[BlockHeader, list[bytes], tuple[Signature, ...]]] = []
         self.decisions: list[Decision] = []  # full committed decisions
         self.block_listeners: list[asyncio.Queue] = []
@@ -230,8 +232,13 @@ class ChainNode(Application, Assembler, Signer, Verifier, RequestInspector,
     # -- RequestInspector --------------------------------------------------
 
     def request_id(self, raw_request: bytes) -> RequestInfo:
-        tx = decode(Transaction, raw_request)
-        return RequestInfo(client_id=tx.client_id, request_id=tx.tx_id)
+        # bounded memo: the inspector sees the same bytes at submit,
+        # proposal verification, and removal
+        def compute() -> RequestInfo:
+            tx = decode(Transaction, raw_request)
+            return RequestInfo(client_id=tx.client_id, request_id=tx.tx_id)
+
+        return self._request_id_cache.get_or(raw_request, compute)
 
     # -- MembershipNotifier ------------------------------------------------
 

@@ -38,8 +38,6 @@ _TAG_BY_CLS: dict[type, int] = {}
 _CLS_BY_TAG: dict[int, type] = {}
 _NEXT_TAG = [1]
 
-# cached per-class field plans: list of (attr_name, encoder, decoder)
-_PLAN: dict[type, list[tuple[str, Any, Any]]] = {}
 
 
 class CodecError(Exception):
@@ -165,28 +163,118 @@ def _codec_for(tp):
     raise CodecError(f"unsupported field type {tp!r}")
 
 
-def _plan(cls) -> list[tuple[str, Any, Any]]:
-    plan = _PLAN.get(cls)
-    if plan is None:
-        hints = typing.get_type_hints(cls)
-        plan = []
-        for f in dataclasses.fields(cls):
-            enc, dec = _codec_for(hints[f.name])
-            plan.append((f.name, enc, dec))
-        _PLAN[cls] = plan
-    return plan
+# ---------------------------------------------------------------------------
+# compiled per-class codecs.  The plan above dispatches through one closure
+# call per field; on the protocol hot path (every request id, every
+# signature binding, every metadata read) that indirection is the dominant
+# Python cost (measured: decode() was ~half the n=64 cluster profile).
+# Each class instead gets ONE generated function that inlines the scalar
+# field handling and falls back to the plan closures only for nested /
+# container fields.  The wire format is bit-identical to the plan codecs.
+# ---------------------------------------------------------------------------
+
+_ENC_FN: dict[type, Any] = {}
+_DEC_FN: dict[type, Any] = {}
+
+_INLINE_ENC = {
+    int: ("    x = v.{name}\n"
+          "    if x < 0 or x > 18446744073709551615:\n"
+          "        raise CodecError('int out of uint64 range: %r' % (x,))\n"
+          "    out += _u64(x)\n"),
+    bool: "    out.append(1 if v.{name} else 0)\n",
+    bytes: ("    x = v.{name}\n"
+            "    out += _u32(len(x))\n"
+            "    out += x\n"),
+    str: ("    x = v.{name}.encode('utf-8')\n"
+          "    out += _u32(len(x))\n"
+          "    out += x\n"),
+}
+
+_INLINE_DEC = {
+    int: ("    {name} = _u64u(buf, off)[0]\n"
+          "    off += 8\n"),
+    bool: ("    {name} = buf[off] != 0\n"
+           "    off += 1\n"),
+    bytes: ("    n = _u32u(buf, off)[0]\n"
+            "    off += 4\n"
+            "    {name} = bytes(buf[off:off + n])\n"
+            "    off += n\n"),
+    str: ("    n = _u32u(buf, off)[0]\n"
+          "    off += 4\n"
+          "    {name} = str(buf[off:off + n], 'utf-8')\n"
+          "    off += n\n"),
+}
+
+
+#: identifiers used by the generated codec bodies — a dataclass field with
+#: one of these names would silently miscompile, so registration rejects it
+_RESERVED_FIELD_NAMES = frozenset(
+    {"out", "v", "buf", "off", "n", "x", "_cls", "CodecError"}
+    | {f"_e{i}" for i in range(64)} | {f"_d{i}" for i in range(64)}
+    | {"_u64", "_u32", "_u64u", "_u32u", "_enc", "_dec"}
+)
+
+
+def _compile_codecs(cls) -> None:
+    hints = typing.get_type_hints(cls)
+    fields = dataclasses.fields(cls)
+    for f in fields:
+        if f.name in _RESERVED_FIELD_NAMES:
+            raise CodecError(
+                f"{cls.__name__}.{f.name}: field name is reserved by the "
+                "compiled codec generator"
+            )
+    ns: dict[str, Any] = {
+        "CodecError": CodecError,
+        "_u64": _U64.pack, "_u32": _U32.pack,
+        "_u64u": _U64.unpack_from, "_u32u": _U32.unpack_from,
+        "_cls": cls,
+    }
+    enc_src = ["def _enc(out, v):\n"]
+    dec_src = ["def _dec(buf, off):\n"]
+    names = []
+    for i, f in enumerate(fields):
+        tp = hints[f.name]
+        names.append(f.name)
+        if tp in _INLINE_ENC:
+            enc_src.append(_INLINE_ENC[tp].format(name=f.name))
+            dec_src.append(_INLINE_DEC[tp].format(name=f.name))
+        else:
+            e, d = _codec_for(tp)
+            ns[f"_e{i}"], ns[f"_d{i}"] = e, d
+            enc_src.append(f"    _e{i}(out, v.{f.name})\n")
+            dec_src.append(f"    {f.name}, off = _d{i}(buf, off)\n")
+    if not fields:
+        enc_src.append("    pass\n")
+    dec_src.append(f"    return _cls({', '.join(names)}), off\n")
+    exec("".join(enc_src), ns)
+    exec("".join(dec_src), ns)
+    _ENC_FN[cls] = ns["_enc"]
+    _DEC_FN[cls] = ns["_dec"]
+
+
+def _enc_fn(cls):
+    fn = _ENC_FN.get(cls)
+    if fn is None:
+        _compile_codecs(cls)
+        fn = _ENC_FN[cls]
+    return fn
+
+
+def _dec_fn(cls):
+    fn = _DEC_FN.get(cls)
+    if fn is None:
+        _compile_codecs(cls)
+        fn = _DEC_FN[cls]
+    return fn
 
 
 def _encode_into(out: bytearray, msg) -> None:
-    for name, enc, _ in _plan(type(msg)):
-        enc(out, getattr(msg, name))
+    _enc_fn(type(msg))(out, msg)
 
 
 def _decode_from(cls: Type[T], buf: memoryview, off: int) -> tuple[T, int]:
-    kwargs = {}
-    for name, _, dec in _plan(cls):
-        kwargs[name], off = dec(buf, off)
-    return cls(**kwargs), off
+    return _dec_fn(cls)(buf, off)
 
 
 def encode(msg) -> bytes:

@@ -46,7 +46,7 @@ from ..messages import (
     ViewMetadata,
 )
 from ..metrics import ConsensusMetrics, ViewMetrics
-from ..types import Checkpoint, Proposal, Reconfig, RequestInfo, ViewAndSeq
+from ..types import Checkpoint, Proposal, Reconfig, RequestInfo, ViewAndSeq, cached_view_metadata
 from .pool import Pool, RequestTimeoutHandler
 from .state import ABORT, COMMITTED
 from .util import InFlightData, compute_quorum, get_leader_id
@@ -171,13 +171,13 @@ class Controller(RequestTimeoutHandler):
         prop, _ = self.checkpoint.get()
         if not prop.metadata:
             return []
-        return list(decode(ViewMetadata, prop.metadata).black_list)
+        return list(cached_view_metadata(prop.metadata).black_list)
 
     def latest_seq(self) -> int:
         prop, _ = self.checkpoint.get()
         if not prop.metadata:
             return 0
-        return decode(ViewMetadata, prop.metadata).latest_sequence
+        return cached_view_metadata(prop.metadata).latest_sequence
 
     def leader_id(self) -> int:
         return get_leader_id(

