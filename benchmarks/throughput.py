@@ -137,10 +137,18 @@ async def run_cluster(engine_kind: str, n: int, requests: int, batch: int,
     # pre-warm every engine at every lane size so no XLA compile lands
     # inside the timed window
     if engine_kind == "jax":
-        sk, pub = scheme.keygen(b"warm")
+        # warm with a RING key: a foreign key would grow the comb-table
+        # registry past the membership (65 keys -> npad 128) and force a
+        # recompile of every padded shape mid-run
+        sk, pub = scheme.keygen(b"bench-tput-1")
         item = scheme.make_item(
             b"warm-msg", scheme.sign_raw(sk, b"warm-msg"), pub
         )
+        for eng in set(engines.values()):
+            if hasattr(eng, "prewarm_keys"):
+                eng.prewarm_keys(
+                    rings[node_ids[0]].public_keys.values()
+                )
         t0 = time.perf_counter()
         for eng in set(engines.values()):
             for size in pad_sizes:
@@ -232,7 +240,13 @@ def main() -> None:
     ap.add_argument("--engines", default="openssl,jax")
     ap.add_argument("--scheme", default="p256",
                     choices=("p256", "ed25519", "bls"))
-    ap.add_argument("--pad-sizes", default="8,32,128")
+    ap.add_argument(
+        "--pad-sizes", default="auto",
+        help="comma-separated engine pad ladder, or 'auto': scale the top "
+             "rung to the cluster's full quorum wave (n x (quorum-1) "
+             "signatures per decision through the shared engine) so one "
+             "decision coalesces into ONE launch, capped at 4096 lanes",
+    )
     ap.add_argument("--share-engine", choices=("auto", "yes", "no"),
                     default="auto",
                     help="share one engine+coalescer across replicas "
@@ -240,7 +254,18 @@ def main() -> None:
     ap.add_argument("--cpu", action="store_true",
                     help="pin JAX to the CPU backend")
     args = ap.parse_args()
-    pad_sizes = tuple(int(x) for x in args.pad_sizes.split(","))
+    if args.pad_sizes == "auto":
+        n = args.nodes
+        quorum = (n + (n - 1) // 3 + 1 + 1) // 2  # util.go:176-180
+        wave = n * (quorum - 1)
+        top = 128
+        while top < wave and top < 4096:
+            top *= 2
+        pad_sizes = tuple(
+            s for s in (8, 32, 128, 512, 2048, 4096) if s <= top
+        ) + ((top,) if top not in (8, 32, 128, 512, 2048, 4096) else ())
+    else:
+        pad_sizes = tuple(int(x) for x in args.pad_sizes.split(","))
 
     if args.cpu or os.environ.get("SMARTBFT_BENCH_CPU") == "1":
         force_cpu()

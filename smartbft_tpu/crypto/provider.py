@@ -168,59 +168,76 @@ class JaxVerifyEngine:
         # The backend probe is LAZY — deciding at the first kernel call, when
         # backend init is inevitable anyway — so constructing an engine never
         # initializes jax (platform pins like force_cpu still work after).
+        # static-key comb path (pallas_comb): the fastest P-256 route —
+        # host-precomputed per-replica comb tables, 32 point-op levels per
+        # verify.  Used for every chunk whose signer keys are registrable;
+        # shares the lazy backend probe and failure-guard semantics below.
+        self._comb = None
+        self._comb_state = {"enabled": None, "transient": 0}
         if self.supports_pallas and scheme is p256 \
                 and os.environ.get("SMARTBFT_PALLAS", "1") == "1":
             from . import pallas_ecdsa
+            from .pallas_comb import CombVerifier
 
+            self._comb = CombVerifier()
             xla_kernel = self._kernel
-            # tri-state guard: compile-type failures (Mosaic lowering, an
-            # unimplemented primitive) disable the Pallas path permanently;
-            # transient runtime blips (momentary device OOM, a flaky tunnel)
-            # fall back per-call and retry, up to a consecutive-failure cap
-            state = {"pallas": None, "transient": 0}
+            state = {"enabled": None, "transient": 0}
 
             def guarded_kernel(*arrays):
-                if state["pallas"] is None:
-                    state["pallas"] = self._use_pallas(scheme)
-                if state["pallas"]:
-                    try:
-                        out = pallas_ecdsa.ecdsa_verify(*arrays)
-                        state["transient"] = 0
-                        return out
-                    except Exception as exc:  # noqa: BLE001
-                        import logging
-
-                        log = logging.getLogger("smartbft_tpu.crypto")
-                        if self._is_permanent_kernel_error(exc):
-                            state["pallas"] = False
-                            log.warning(
-                                "pallas kernel failed to compile (%s: %s); "
-                                "engine PERMANENTLY falls back to the XLA "
-                                "kernel for this process",
-                                type(exc).__name__, exc,
-                            )
-                        else:
-                            state["transient"] += 1
-                            if state["transient"] >= 5:
-                                state["pallas"] = False
-                                log.warning(
-                                    "pallas kernel failed %d consecutive "
-                                    "times (%s: %s); engine PERMANENTLY "
-                                    "falls back to the XLA kernel",
-                                    state["transient"], type(exc).__name__, exc,
-                                )
-                            else:
-                                log.warning(
-                                    "pallas kernel transient failure %d/5 "
-                                    "(%s: %s); this call uses the XLA "
-                                    "kernel, next call retries pallas",
-                                    state["transient"], type(exc).__name__, exc,
-                                )
-                return xla_kernel(*arrays)
+                out = self._guarded_call(
+                    state, "pallas", lambda: pallas_ecdsa.ecdsa_verify(*arrays)
+                )
+                return out if out is not None else xla_kernel(*arrays)
 
             self._kernel = guarded_kernel
         self._lock = threading.Lock()
         self.stats = VerifyStats(metrics=metrics)
+
+    def _guarded_call(self, state: dict, name: str, fn):
+        """Tri-state failure guard shared by the Pallas kernel paths.
+
+        Returns fn()'s result, or None to tell the caller to fall back.
+        Compile-type failures (Mosaic lowering, an unimplemented primitive)
+        disable the path permanently; transient runtime blips (momentary
+        device OOM, a flaky tunnel) fall back per-call and retry, up to a
+        consecutive-failure cap.  The backend probe is lazy: first call
+        decides via _use_pallas.
+        """
+        if state["enabled"] is None:
+            state["enabled"] = self._use_pallas(self.scheme)
+        if not state["enabled"]:
+            return None
+        try:
+            out = fn()
+            state["transient"] = 0
+            return out
+        except Exception as exc:  # noqa: BLE001
+            import logging
+
+            log = logging.getLogger("smartbft_tpu.crypto")
+            if self._is_permanent_kernel_error(exc):
+                state["enabled"] = False
+                log.warning(
+                    "%s kernel failed to compile (%s: %s); engine "
+                    "PERMANENTLY falls back for this process",
+                    name, type(exc).__name__, exc,
+                )
+            else:
+                state["transient"] += 1
+                if state["transient"] >= 5:
+                    state["enabled"] = False
+                    log.warning(
+                        "%s kernel failed %d consecutive times (%s: %s); "
+                        "engine PERMANENTLY falls back",
+                        name, state["transient"], type(exc).__name__, exc,
+                    )
+                else:
+                    log.warning(
+                        "%s kernel transient failure %d/5 (%s: %s); this "
+                        "call falls back, next call retries",
+                        name, state["transient"], type(exc).__name__, exc,
+                    )
+            return None
 
     #: subclasses whose inputs are mesh-placed (ShardedVerifyEngine) must
     #: opt out — pallas_call has no partitioning rules, so routing sharded
@@ -282,18 +299,41 @@ class JaxVerifyEngine:
         """Hook for subclasses to place padded inputs (e.g. mesh-sharded)."""
         return a
 
+    def prewarm_keys(self, pubs) -> None:
+        """Register a known key set (e.g. the whole keyring) with the comb
+        registry up front, so no verify path ever re-traces mid-protocol."""
+        if self._comb is not None:
+            self._comb.prewarm_keys(pubs)
+
+    def _comb_verify(self, items, size):
+        """Comb-kernel chunk verify under the shared guard semantics.
+
+        Returns the (n,) mask, or None to fall back (unregistrable key,
+        non-TPU backend, compile failure, or repeated transient errors)."""
+        if self._comb is None:
+            return None
+        return self._guarded_call(
+            self._comb_state, "comb", lambda: self._comb.verify(items, size)
+        )
+
     def _verify_chunk(self, items) -> list[bool]:
         n = len(items)
         size = self._pad_to(n)
-        arrays = self.scheme.verify_inputs(items)
-
-        def pad(a):
-            return self._place(
-                np.concatenate([a, np.zeros((size - n,) + a.shape[1:], a.dtype)])
-            )
-
         t0 = time.perf_counter()
-        mask = np.asarray(self._kernel(*(pad(a) for a in arrays)))
+        mask = self._comb_verify(items, size)
+        if mask is not None:
+            mask = np.asarray(mask)
+        else:
+            arrays = self.scheme.verify_inputs(items)
+
+            def pad(a):
+                return self._place(
+                    np.concatenate(
+                        [a, np.zeros((size - n,) + a.shape[1:], a.dtype)]
+                    )
+                )
+
+            mask = np.asarray(self._kernel(*(pad(a) for a in arrays)))
         dt = time.perf_counter() - t0
         with self._lock:
             self.stats.record(n, size, dt)
@@ -400,6 +440,13 @@ class CryptoProvider:
         eng_scheme = getattr(self.engine, "scheme", self.scheme)
         if eng_scheme is not self.scheme:
             raise ValueError("engine scheme does not match provider scheme")
+        # membership keys are static per configuration: register them with
+        # the engine's comb-table path up front (no-op for other engines)
+        if hasattr(self.engine, "prewarm_keys"):
+            try:
+                self.engine.prewarm_keys(self.keyring.public_keys.values())
+            except ValueError as exc:
+                raise ValueError(f"invalid key in keyring: {exc}") from exc
         if coalescer is not None:
             self._coalescer = coalescer
             return
