@@ -257,8 +257,10 @@ def main() -> None:
     if args.pad_sizes == "auto":
         n = args.nodes
         quorum = (n + (n - 1) // 3 + 1 + 1) // 2  # util.go:176-180
-        wave = n * (quorum - 1)
-        top = 128
+        # the shared engine's per-decision wave: every replica checks its
+        # quorum; BLS collapses each check to ONE aggregated pairing lane
+        wave = n if args.scheme == "bls" else n * (quorum - 1)
+        top = 128 if args.scheme != "bls" else 8
         while top < wave and top < 4096:
             top *= 2
         pad_sizes = tuple(

@@ -239,9 +239,17 @@ def compress(point) -> bytes:
 
 
 def decompress(data: bytes):
-    """32-byte encoding -> affine point, or None if invalid (RFC 8032 §5.1.3)."""
+    """32-byte encoding -> affine point, or None if invalid (RFC 8032 §5.1.3).
+
+    The sqrt mod p runs in the native C++ helper when available (~30 us vs
+    ~150 us as a Python pow) — every Ed25519 verification decompresses R,
+    making this the host-prep hot spot of the batch path."""
     if len(data) != 32:
         return None
+    from .. import native
+
+    if native.ed_available():
+        return native.ed_decompress(data)
     val = int.from_bytes(data, "little")
     sign = val >> 255
     y = val & ((1 << 255) - 1)

@@ -347,18 +347,27 @@ def ecdsa_verify_comb(e, r, s, kidx, gtab, qtab, tile: int = 128,
 # ---------------------------------------------------------------------------
 
 
+def _p256_validate(pub):
+    if not is_on_curve_int(pub):
+        raise ValueError("public key is not on the P-256 curve")
+
+
 class CombKeyRegistry:
     """pub -> table index; tables built once per key, stacked and padded.
 
-    The stack is padded to a power-of-two key count so jit re-traces at
-    most log2(cap) times as membership grows.  Padding tables are zero —
-    their Z rows decode to 0 so any (buggy) reference to a padded index
-    yields the point at infinity and a failed verify, never a false
-    accept.
+    Scheme-agnostic: ``validate``/``build`` default to the P-256 curve
+    check and comb builder; :mod:`pallas_ed25519` instantiates it with
+    Edwards equivalents.  The stack is padded to a power-of-two key count
+    so jit re-traces at most log2(cap) times as membership grows.
+    Padding tables are zero — their Z rows decode to 0 so any (buggy)
+    reference to a padded index yields the point at infinity and a failed
+    verify, never a false accept.
     """
 
-    def __init__(self, cap: int = 128):
+    def __init__(self, cap: int = 128, validate=None, build=None):
         self.cap = cap
+        self._validate = validate if validate is not None else _p256_validate
+        self._build = build if build is not None else build_table
         self._index: dict = {}
         self._tables: list[np.ndarray] = []
         self._stack: np.ndarray | None = None
@@ -369,18 +378,17 @@ class CombKeyRegistry:
     def register(self, pub) -> int:
         """Index for ``pub`` (validating + building its table on first use).
 
-        Raises ValueError for off-curve keys or when the cap is exceeded.
+        Raises ValueError for invalid keys or when the cap is exceeded.
         """
         idx = self._index.get(pub)
         if idx is not None:
             return idx
         if len(self._tables) >= self.cap:
             raise ValueError(f"comb key registry full ({self.cap})")
-        if not is_on_curve_int(pub):
-            raise ValueError("public key is not on the P-256 curve")
+        self._validate(pub)
         idx = len(self._tables)
         self._index[pub] = idx
-        self._tables.append(build_table(pub))
+        self._tables.append(self._build(pub))
         self._stack = None
         return idx
 
@@ -405,31 +413,53 @@ class CombVerifier:
     """Engine adapter: items -> comb-kernel launch with cached device tables.
 
     ``verify(items)`` returns a bool list, or None when any item's key is
-    unregistrable (caller falls back to the generic kernel).
+    unregistrable (caller falls back to the generic kernel).  The prewarm /
+    device-table caching / pad-and-launch scaffolding is scheme-agnostic;
+    subclasses (pallas_ed25519.Ed25519CombVerifier) override the four
+    ``_...`` hooks.
     """
 
     def __init__(self, tile: int = 128, cap: int = 128):
-        self.registry = CombKeyRegistry(cap=cap)
+        self.registry = self._make_registry(cap)
         self.tile = tile
         self._pending_prewarm: list = []
         self._dev_version: int = -1
         self._dev_gtab = None
         self._dev_qtab = None
 
+    # -- scheme hooks (P-256 defaults) --------------------------------------
+
+    def _make_registry(self, cap: int) -> CombKeyRegistry:
+        return CombKeyRegistry(cap=cap)
+
+    def _validate_key(self, pub) -> None:
+        _p256_validate(pub)
+
+    def _base_table(self) -> np.ndarray:
+        return g_table()
+
+    def _pack(self, items):
+        """items -> ([(B,32) uint8 arrays...], ok-mask-or-None, kidx)."""
+        e8, r8, s8, kidx = pack_items(items, self.registry)
+        return [e8, r8, s8], None, kidx
+
+    def _launch(self, arrays, ok, kidx, gtab, qtab):
+        return ecdsa_verify_comb(*arrays, kidx, gtab, qtab, tile=self.tile)
+
+    # -- shared scaffolding --------------------------------------------------
+
     def prewarm_keys(self, pubs) -> None:
         """Record a known key set (e.g. the whole keyring) to register
         before the first verify, so membership growth never re-traces
-        mid-protocol.  Validation is EAGER (an off-curve key or a key set
+        mid-protocol.  Validation is EAGER (an invalid key or a key set
         beyond the registry cap raises here, at provider construction);
         table building is DEFERRED — it costs ~2.4 ms/key of host EC
         arithmetic, which engines on non-TPU backends (where the comb path
         never runs) must not pay."""
         pubs = list(pubs)
         for pub in pubs:
-            if not is_on_curve_int(pub):
-                raise ValueError("public key is not on the P-256 curve")
-        prospective = {p for p in self._pending_prewarm}
-        prospective.update(pubs)
+            self._validate_key(pub)
+        prospective = set(self._pending_prewarm) | set(pubs)
         if len(self.registry) + len(prospective - set(
                 self.registry._index)) > self.registry.cap:
             raise ValueError(f"comb key registry full ({self.registry.cap})")
@@ -438,7 +468,7 @@ class CombVerifier:
     def _device_tables(self):
         version = len(self.registry)
         if version != self._dev_version:
-            self._dev_gtab = jnp.asarray(g_table(), jnp.bfloat16)
+            self._dev_gtab = jnp.asarray(self._base_table(), jnp.bfloat16)
             self._dev_qtab = jnp.asarray(self.registry.stacked(), jnp.bfloat16)
             self._dev_version = version
         return self._dev_gtab, self._dev_qtab
@@ -449,14 +479,16 @@ class CombVerifier:
             for pub in pending:
                 self.registry.register(pub)
         try:
-            e8, r8, s8, kidx = pack_items(items, self.registry)
+            arrays, ok, kidx = self._pack(items)
         except ValueError:
-            return None  # off-curve or registry full: generic kernel
+            return None  # invalid key or registry full: generic kernel
         n = len(items)
         if pad_to > n:
             z = np.zeros((pad_to - n, 32), np.uint8)
-            e8, r8, s8 = (np.concatenate([a, z]) for a in (e8, r8, s8))
+            arrays = [np.concatenate([a, z]) for a in arrays]
+            if ok is not None:
+                ok = np.concatenate([ok, np.zeros(pad_to - n, np.uint32)])
             kidx = np.concatenate([kidx, np.zeros(pad_to - n, np.int32)])
         gtab, qtab = self._device_tables()
-        mask = ecdsa_verify_comb(e8, r8, s8, kidx, gtab, qtab, tile=self.tile)
+        mask = self._launch(arrays, ok, kidx, gtab, qtab)
         return mask[:n]

@@ -174,22 +174,30 @@ class JaxVerifyEngine:
         # shares the lazy backend probe and failure-guard semantics below.
         self._comb = None
         self._comb_state = {"enabled": None, "transient": 0}
-        if self.supports_pallas and scheme is p256 \
+        if self.supports_pallas \
                 and os.environ.get("SMARTBFT_PALLAS", "1") == "1":
-            from . import pallas_ecdsa
-            from .pallas_comb import CombVerifier
+            if scheme is p256:
+                from . import pallas_ecdsa
+                from .pallas_comb import CombVerifier
 
-            self._comb = CombVerifier()
-            xla_kernel = self._kernel
-            state = {"enabled": None, "transient": 0}
+                self._comb = CombVerifier()
+                xla_kernel = self._kernel
+                state = {"enabled": None, "transient": 0}
 
-            def guarded_kernel(*arrays):
-                out = self._guarded_call(
-                    state, "pallas", lambda: pallas_ecdsa.ecdsa_verify(*arrays)
-                )
-                return out if out is not None else xla_kernel(*arrays)
+                def guarded_kernel(*arrays):
+                    out = self._guarded_call(
+                        state, "pallas",
+                        lambda: pallas_ecdsa.ecdsa_verify(*arrays),
+                    )
+                    return out if out is not None else xla_kernel(*arrays)
 
-            self._kernel = guarded_kernel
+                self._kernel = guarded_kernel
+            elif scheme is ed25519:
+                # ed25519 has no generic pallas kernel — the comb path IS
+                # the fused kernel; fallback is the XLA batch-major kernel
+                from .pallas_ed25519 import Ed25519CombVerifier
+
+                self._comb = Ed25519CombVerifier()
         self._lock = threading.Lock()
         self.stats = VerifyStats(metrics=metrics)
 
@@ -250,7 +258,7 @@ class JaxVerifyEngine:
         Called lazily from the first kernel invocation (never at engine
         construction — see __init__): any set SMARTBFT_PALLAS value other
         than "1" disables, "1" forces on, unset auto-detects the backend."""
-        if scheme is not p256 or not self.supports_pallas:
+        if scheme not in (p256, ed25519) or not self.supports_pallas:
             return False
         flag = os.environ.get("SMARTBFT_PALLAS")
         if flag is not None:

@@ -17,7 +17,7 @@ from typing import Optional
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _LIB_NAME = "libsmartbft_native.so"
-_SOURCES = ["crc32c.cc", "wal_frame.cc"]
+_SOURCES = ["crc32c.cc", "wal_frame.cc", "bls381.cc", "ed25519_fp.cc"]
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -87,6 +87,18 @@ def load() -> Optional[ctypes.CDLL]:
                 ctypes.c_int,
                 ctypes.c_int,
             ]
+            buf = ctypes.c_char_p
+            sz = ctypes.c_size_t
+            for name in ("smartbft_bls_g1_mul", "smartbft_bls_g2_mul"):
+                fn = getattr(lib, name)
+                fn.restype = ctypes.c_int
+                fn.argtypes = [buf, sz, buf, ctypes.c_char_p]
+            for name in ("smartbft_bls_g1_sum", "smartbft_bls_g2_sum"):
+                fn = getattr(lib, name)
+                fn.restype = ctypes.c_int
+                fn.argtypes = [buf, sz, ctypes.c_char_p]
+            lib.smartbft_ed_decompress.restype = ctypes.c_int
+            lib.smartbft_ed_decompress.argtypes = [buf, ctypes.c_char_p]
             _lib = lib
         except (OSError, AttributeError):
             _lib = None
@@ -157,3 +169,105 @@ def wal_append(fd: int, payload: bytes, crc: int, update_crc: bool,
     if n < 0:
         raise OSError(ctypes.get_errno(), "wal: native append failed")
     return int(n), int(crc_io.value)
+
+
+# ---------------------------------------------------------------------------
+# BLS12-381 group arithmetic (bls381.cc)
+#
+# Points cross the boundary as big-endian byte buffers: G1 affine = x||y
+# (96B), G2 affine = x_c0||x_c1||y_c0||y_c1 (192B); infinity is rc=0.
+# Python-side points use the same representation as crypto/bls12381.py:
+# G1 = (x, y) ints, G2 = ((x0, x1), (y0, y1)), None = infinity.
+# ---------------------------------------------------------------------------
+
+def bls_available() -> bool:
+    lib = load()
+    return lib is not None and hasattr(lib, "smartbft_bls_g1_mul")
+
+
+def _g1_bytes(pt) -> bytes:
+    if pt is None:
+        return b"\x00" * 96
+    return pt[0].to_bytes(48, "big") + pt[1].to_bytes(48, "big")
+
+
+def _g1_point(rc: int, out) -> Optional[tuple]:
+    if rc == 0:
+        return None
+    raw = bytes(out)
+    return (int.from_bytes(raw[:48], "big"), int.from_bytes(raw[48:96], "big"))
+
+
+def _g2_bytes(pt) -> bytes:
+    if pt is None:
+        return b"\x00" * 192
+    (x0, x1), (y0, y1) = pt
+    return (x0.to_bytes(48, "big") + x1.to_bytes(48, "big")
+            + y0.to_bytes(48, "big") + y1.to_bytes(48, "big"))
+
+
+def _g2_point(rc: int, out) -> Optional[tuple]:
+    if rc == 0:
+        return None
+    raw = bytes(out)
+    c = [int.from_bytes(raw[i * 48:(i + 1) * 48], "big") for i in range(4)]
+    return ((c[0], c[1]), (c[2], c[3]))
+
+
+def bls_g1_mul(k: int, pt) -> Optional[tuple]:
+    """k * P on G1 (affine ints); None = infinity.  k taken as given."""
+    lib = load()
+    scalar = k.to_bytes(max(1, (k.bit_length() + 7) // 8), "big")
+    out = ctypes.create_string_buffer(96)
+    rc = lib.smartbft_bls_g1_mul(scalar, len(scalar), _g1_bytes(pt), out)
+    return _g1_point(rc, out.raw)
+
+
+def bls_g1_sum(points) -> Optional[tuple]:
+    lib = load()
+    pts = [p for p in points if p is not None]
+    if not pts:
+        return None
+    blob = b"".join(_g1_bytes(p) for p in pts)
+    out = ctypes.create_string_buffer(96)
+    rc = lib.smartbft_bls_g1_sum(blob, len(pts), out)
+    return _g1_point(rc, out.raw)
+
+
+def bls_g2_mul(k: int, pt) -> Optional[tuple]:
+    lib = load()
+    scalar = k.to_bytes(max(1, (k.bit_length() + 7) // 8), "big")
+    out = ctypes.create_string_buffer(192)
+    rc = lib.smartbft_bls_g2_mul(scalar, len(scalar), _g2_bytes(pt), out)
+    return _g2_point(rc, out.raw)
+
+
+def bls_g2_sum(points) -> Optional[tuple]:
+    lib = load()
+    pts = [p for p in points if p is not None]
+    if not pts:
+        return None
+    blob = b"".join(_g2_bytes(p) for p in pts)
+    out = ctypes.create_string_buffer(192)
+    rc = lib.smartbft_bls_g2_sum(blob, len(pts), out)
+    return _g2_point(rc, out.raw)
+
+
+# ---------------------------------------------------------------------------
+# Ed25519 point decompression (ed25519_fp.cc)
+# ---------------------------------------------------------------------------
+
+def ed_available() -> bool:
+    lib = load()
+    return lib is not None and hasattr(lib, "smartbft_ed_decompress")
+
+
+def ed_decompress(comp: bytes) -> Optional[tuple]:
+    """RFC 8032 decompression; (x, y) ints or None when invalid."""
+    lib = load()
+    out = ctypes.create_string_buffer(64)
+    if lib.smartbft_ed_decompress(comp, out) == 0:
+        return None
+    raw = out.raw
+    return (int.from_bytes(raw[:32], "little"),
+            int.from_bytes(raw[32:], "little"))

@@ -189,3 +189,83 @@ def test_jax_engine_batch(keyrings):
     assert auxes[2] is None
     # forged sig decodes (zero lanes) so all 4 items reach the one launch
     assert eng.stats.launches == 1 and eng.stats.sigs_verified == 4
+
+
+def test_native_decompress_matches_python():
+    import secrets as _secrets
+
+    from smartbft_tpu import native
+
+    if not native.ed_available():
+        pytest.skip("native ed25519 backend unavailable")
+    import random
+
+    rng = random.Random(5)
+    for i in range(40):
+        if i < 20:
+            k = rng.getrandbits(252)
+            pt = ed.scalar_mult_int(k, (ed.BX, ed.BY))
+            comp = ed.compress(pt)
+            assert native.ed_decompress(comp) == pt
+        else:
+            comp = _secrets.token_bytes(32)
+            val = int.from_bytes(comp, "little")
+            sign = val >> 255
+            y = val & ((1 << 255) - 1)
+            # python reference path (bypass the native fast path)
+            if y >= ed.P:
+                want = None
+            else:
+                yy = y * y % ed.P
+                u, v = (yy - 1) % ed.P, (ed.D * yy + 1) % ed.P
+                x = (u * pow(v, 3, ed.P)
+                     * pow(u * pow(v, 7, ed.P) % ed.P, (ed.P - 5) // 8, ed.P)
+                     % ed.P)
+                if v * x * x % ed.P != u:
+                    x = x * ed.SQRT_M1 % ed.P
+                want = None
+                if v * x * x % ed.P == u and not (x == 0 and sign):
+                    want = (ed.P - x if (x & 1) != sign else x, y)
+            assert native.ed_decompress(comp) == want
+
+
+def test_ed25519_comb_kernel_interpret():
+    """ONE interpret-mode launch of the comb kernel covering valid votes,
+    a corrupted s, a tampered message, a wrong-key claim, and padding."""
+    import numpy as np
+
+    from smartbft_tpu.crypto import pallas_ed25519 as ped
+
+    keys = [ed.keygen(b"ck%d" % i) for i in range(2)]
+    items, expect = [], []
+    for i in range(6):
+        priv, pub = keys[i % 2]
+        msg = b"m%d" % i
+        sig = ed.sign(priv, msg)
+        ok = True
+        if i == 2:
+            bad_s = (int.from_bytes(sig[32:], "little") + 1) % ed.L
+            sig = sig[:32] + bad_s.to_bytes(32, "little")
+            ok = False
+        if i == 4:
+            msg = b"tampered"
+            ok = False
+        items.append((msg, sig, pub))
+        expect.append(ok)
+    cv = ped.Ed25519CombVerifier(tile=8)
+    for _, pub in keys:
+        cv.registry.register(pub)
+    s8, h8, rx8, ry8, ok, kidx = ped.pack_items(items, cv.registry)
+    kidx[5] = 1 - kidx[5]  # valid signature claimed under the wrong key
+    expect[5] = False
+    z = np.zeros((2, 32), np.uint8)
+    s8, h8, rx8, ry8 = (np.concatenate([a, z]) for a in (s8, h8, rx8, ry8))
+    ok = np.concatenate([ok, np.zeros(2, np.uint32)])
+    kidx = np.concatenate([kidx, np.zeros(2, np.int32)])
+    expect += [False, False]
+    mask = ped.eddsa_verify_comb(
+        s8, h8, rx8, ry8, ok, kidx, ped.b_table(), cv.registry.stacked(),
+        tile=8, interpret=True,
+    )
+    assert [bool(v) for v in np.asarray(mask)] == expect
+    assert [ed.verify_item(it) for it in items[:5]] == expect[:5]
