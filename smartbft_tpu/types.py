@@ -62,6 +62,16 @@ class RequestInfo:
     def __str__(self) -> str:
         return f"{self.client_id}:{self.request_id}"
 
+    def __hash__(self) -> int:
+        # memoized: RequestInfo keys every pool map/set — the generated
+        # dataclass __hash__ rebuilt the field tuple on each of ~1M
+        # lookups per n=64 bench run
+        h = self.__dict__.get("_hash_memo")
+        if h is None:
+            h = hash((self.client_id, self.request_id))
+            object.__setattr__(self, "_hash_memo", h)
+        return h
+
 
 @dataclass(frozen=True)
 class Decision:
