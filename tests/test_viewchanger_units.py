@@ -216,3 +216,73 @@ def test_agreed_proposal_with_mixed_supporters():
     ]
     ok, none_in_flight, prop = check(msgs)
     assert ok and not none_in_flight and prop == p
+
+
+# -- start barrier (consensus.go:507-511 waitForEachOther) -------------------
+
+def _bare_viewchanger():
+    from smartbft_tpu.core.viewchanger import ViewChanger
+    from smartbft_tpu.utils.logging import RecordingLogger
+
+    return ViewChanger(
+        self_id=1, n=4, nodes_list=[1, 2, 3, 4], leader_rotation=False,
+        decisions_per_leader=0, speed_up_view_change=False,
+        logger=RecordingLogger("vc"), signer=None, verifier=None,
+        checkpoint=None, in_flight=None, state=None,
+        resend_timeout=1.0, view_change_timeout=10.0, in_msg_q_size=50,
+    )
+
+
+def test_barrier_holds_messages_until_controller_started():
+    """Messages buffered behind the start barrier are processed only after
+    the controller-started event fires (viewchanger.go:156)."""
+
+    async def run():
+        vc = _bare_viewchanger()
+        vc.controller_started_event = asyncio.Event()
+        processed = []
+
+        async def spy(sender, m):
+            processed.append(sender)
+
+        vc._process_msg = spy
+        vc.start(0)
+        from smartbft_tpu.messages import ViewChange
+
+        vc.handle_message(2, ViewChange(next_view=1))
+        vc.handle_message(3, ViewChange(next_view=1))
+        for _ in range(5):
+            await asyncio.sleep(0)
+        assert processed == []  # barrier holds
+        vc.controller_started_event.set()
+        for _ in range(5):
+            await asyncio.sleep(0)
+        assert processed == [2, 3]
+        await vc.stop()
+
+    asyncio.run(run())
+
+
+def test_close_releases_barrier_without_processing_backlog():
+    """close() before the controller finished starting must release the
+    barrier AND skip the buffered message backlog — never process messages
+    against a half-started controller."""
+
+    async def run():
+        vc = _bare_viewchanger()
+        vc.controller_started_event = asyncio.Event()
+        processed = []
+
+        async def spy(sender, m):
+            processed.append(sender)
+
+        vc._process_msg = spy
+        vc.start(0)
+        from smartbft_tpu.messages import ViewChange
+
+        for s in (2, 3, 4):
+            vc.handle_message(s, ViewChange(next_view=1))
+        await vc.stop()  # close() sets the event and enqueues the sentinel
+        assert processed == []
+
+    asyncio.run(run())
