@@ -261,11 +261,14 @@ def main() -> None:
         # quorum; BLS collapses each check to ONE aggregated pairing lane
         wave = n if args.scheme == "bls" else n * (quorum - 1)
         top = 128 if args.scheme != "bls" else 8
-        while top < wave and top < 4096:
+        # the comb kernels amortize a fixed per-launch cost, so the top
+        # rung covers the whole wave (n=128 -> 10880 sigs) in ONE launch
+        while top < wave and top < 16384:
             top *= 2
-        pad_sizes = tuple(
-            s for s in (8, 32, 128, 512, 2048, 4096) if s <= top
-        ) + ((top,) if top not in (8, 32, 128, 512, 2048, 4096) else ())
+        ladder = (8, 32, 128, 512, 2048, 4096, 16384)
+        pad_sizes = tuple(s for s in ladder if s <= top) + (
+            (top,) if top not in ladder else ()
+        )
     else:
         pad_sizes = tuple(int(x) for x in args.pad_sizes.split(","))
 
