@@ -555,6 +555,14 @@ class View:
         batch_async = getattr(self.verifier, "verify_consenter_sigs_batch_async", None)
         if batch_async is not None:
             return await batch_async(sigs, proposal)
+        # Sync-only verifier: called inline, ON the event loop.  Deliberate:
+        # every CryptoProvider exposes the async coalescer path (which runs
+        # the engine on a worker thread), so this branch serves only
+        # injected test verifiers with trivial crypto — and threading it
+        # (asyncio.to_thread) makes the deterministic logical-clock tests
+        # racy: timers advance while the thread runs, firing spurious
+        # heartbeat/view-change timeouts.  A production embedder with a
+        # slow sync-only verifier should implement the async method.
         return self.verifier.verify_consenter_sigs_batch(sigs, proposal)
 
     async def _decide(self, proposal, signatures, requests) -> None:
