@@ -151,8 +151,17 @@ class JaxVerifyEngine:
 
     preferred_coalesce_window = 0.002  # batched engine: wait for fan-in
 
-    def __init__(self, pad_sizes: Sequence[int] = (8, 32, 128, 512, 2048),
+    def __init__(self,
+                 pad_sizes: Sequence[int] = (8, 32, 128, 512, 2048, 4096,
+                                             8192, 16384),
                  scheme=p256, metrics=None):
+        """``pad_sizes``: the top rung bounds how much of a large cluster's
+        quorum wave one launch can absorb (n=128 -> 10880 signatures);
+        per-launch overhead is fixed, so bigger is better.  A size only
+        compiles a kernel when a batch of that shape first occurs — call
+        :meth:`prewarm_shapes` at startup to pay those compiles before
+        protocol traffic (a mid-protocol compile can outlast heartbeat
+        timeouts; benchmarks/throughput.py prewarms every rung)."""
         import jax  # deferred: engine construction may precede platform pin
 
         self._jax = jax
@@ -313,6 +322,16 @@ class JaxVerifyEngine:
         if self._comb is not None:
             self._comb.prewarm_keys(pubs)
 
+    def prewarm_shapes(self, item, sizes: Optional[Sequence[int]] = None) -> None:
+        """Compile every pad-ladder shape up front with copies of ``item``
+        (one scheme verify item whose key is registered/registrable).
+
+        Kernel shapes otherwise compile on first use — fine for benches,
+        but in a live protocol the first large quorum wave would stall for
+        the compile (possibly past heartbeat/view-change timeouts)."""
+        for size in (self.pad_sizes if sizes is None else sizes):
+            self.verify([item] * size)
+
     def _comb_verify(self, items, size):
         """Comb-kernel chunk verify under the shared guard semantics.
 
@@ -462,7 +481,13 @@ class CryptoProvider:
             coalesce_window = getattr(
                 self.engine, "preferred_coalesce_window", 0.002
             )
-        self._coalescer = AsyncBatchCoalescer(self.engine, window=coalesce_window)
+        # let one coalesced flush fill the engine's largest launch — a
+        # smaller max_batch would split big quorum waves into multiple
+        # launches and multiply the fixed per-launch overhead
+        max_batch = getattr(self.engine, "pad_sizes", (2048,))[-1]
+        self._coalescer = AsyncBatchCoalescer(
+            self.engine, window=coalesce_window, max_batch=max_batch
+        )
 
     # -- Signer -------------------------------------------------------------
 
