@@ -204,3 +204,20 @@ def test_engine_stats_feed_tpu_metrics(keyrings):
     assert mem.counters["consensus.tpu.count_sigs_verified"] == 2
     assert mem.histograms["consensus.tpu.batch_fill_percent"] == [100.0]
     assert len(mem.histograms["consensus.tpu.verify_latency_per_sig_us"]) == 1
+
+
+def test_provider_coalescer_fills_largest_launch():
+    """The production coalescer must be able to fill the engine's largest
+    launch — a smaller max_batch splits big quorum waves into multiple
+    launches and multiplies the fixed per-launch overhead."""
+    from smartbft_tpu.crypto.provider import (
+        JaxVerifyEngine,
+        Keyring,
+        P256CryptoProvider,
+    )
+
+    rings = Keyring.generate([1, 2, 3, 4], seed=b"coalesce")
+    eng = JaxVerifyEngine()
+    prov = P256CryptoProvider(rings[1], engine=eng)
+    assert prov._coalescer.max_batch == eng.pad_sizes[-1]
+    assert eng.pad_sizes[-1] >= 16384  # covers an n=128 quorum wave
