@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import functools
 import hashlib
+import threading
 
 import numpy as np
 
@@ -347,6 +348,16 @@ def ecdsa_verify_comb(e, r, s, kidx, gtab, qtab, tile: int = 128,
 # ---------------------------------------------------------------------------
 
 
+class CombRegistryFull(ValueError):
+    """The registry's key cap was reached — NOT an invalid key.
+
+    Callers distinguish this from key-validation failures: a full registry
+    only means this engine's comb path can't serve the extra keys (the
+    generic kernel still verifies them fine), whereas an invalid key is a
+    configuration error worth failing loudly over.
+    """
+
+
 def _p256_validate(pub):
     if not is_on_curve_int(pub):
         raise ValueError("public key is not on the P-256 curve")
@@ -384,7 +395,7 @@ class CombKeyRegistry:
         if idx is not None:
             return idx
         if len(self._tables) >= self.cap:
-            raise ValueError(f"comb key registry full ({self.cap})")
+            raise CombRegistryFull(f"comb key registry full ({self.cap})")
         self._validate(pub)
         idx = len(self._tables)
         self._index[pub] = idx
@@ -426,6 +437,14 @@ class CombVerifier:
         self._dev_version: int = -1
         self._dev_gtab = None
         self._dev_qtab = None
+        # Engines overlap flushes via asyncio.to_thread, so concurrent
+        # verify() calls can race first-use registration: two threads both
+        # computing idx=len(tables) would bind different keys to one index,
+        # making signatures verify against the wrong replica's key.  All
+        # registry / prewarm / device-table mutation happens under this
+        # lock; only the kernel launch itself runs outside it.
+        self._reg_lock = threading.RLock()
+        self._warned_full = False
 
     # -- scheme hooks (P-256 defaults) --------------------------------------
 
@@ -451,19 +470,55 @@ class CombVerifier:
     def prewarm_keys(self, pubs) -> None:
         """Record a known key set (e.g. the whole keyring) to register
         before the first verify, so membership growth never re-traces
-        mid-protocol.  Validation is EAGER (an invalid key or a key set
-        beyond the registry cap raises here, at provider construction);
-        table building is DEFERRED — it costs ~2.4 ms/key of host EC
-        arithmetic, which engines on non-TPU backends (where the comb path
-        never runs) must not pay."""
+        mid-protocol.  Validation is EAGER (an invalid key raises here, at
+        provider construction); table building is DEFERRED — it costs
+        ~2.4 ms/key of host EC arithmetic, which engines on non-TPU
+        backends (where the comb path never runs) must not pay.  If the
+        set exceeds remaining registry capacity, the fitting prefix is
+        still queued and CombRegistryFull reports the overflow — callers
+        degrade those keys to the generic kernel."""
         pubs = list(pubs)
         for pub in pubs:
             self._validate_key(pub)
-        prospective = set(self._pending_prewarm) | set(pubs)
-        if len(self.registry) + len(prospective - set(
-                self.registry._index)) > self.registry.cap:
-            raise ValueError(f"comb key registry full ({self.registry.cap})")
-        self._pending_prewarm.extend(pubs)
+        with self._reg_lock:
+            known = set(self.registry._index) | set(self._pending_prewarm)
+            room = self.registry.cap - len(known)
+            fitting, overflow = [], 0
+            for pub in pubs:
+                if pub in known:
+                    continue
+                if len(fitting) < room:
+                    fitting.append(pub)
+                    known.add(pub)
+                else:
+                    overflow += 1
+            # Queue what fits BEFORE signalling overflow: those keys still
+            # get their tables built up front, avoiding the mid-protocol
+            # build/retrace stall prewarm exists to prevent.  Chunks whose
+            # signers are all registered keep the comb path; any chunk
+            # containing an overflow key degrades wholly to the generic
+            # kernel (verify short-circuits it rather than splitting the
+            # launch).
+            self._pending_prewarm.extend(fitting)
+            if overflow:
+                raise CombRegistryFull(
+                    f"comb key registry full ({self.registry.cap}): "
+                    f"{overflow} key(s) beyond capacity "
+                    f"({len(fitting)} queued)")
+
+    def _warn_registry_full(self, exc) -> None:
+        """Warn ONCE per verifier when registration hits a full registry
+        (prewarm drain or first-use) — chunks carrying unregistrable keys
+        silently riding the generic kernel would hide the fast path dying."""
+        if not self._warned_full:
+            self._warned_full = True
+            import logging
+
+            logging.getLogger("smartbft_tpu.crypto").warning(
+                "comb key registry full at verify time; chunks with "
+                "unregistered keys fall back to the generic verify "
+                "kernel: %s", exc,
+            )
 
     def _device_tables(self):
         version = len(self.registry)
@@ -474,14 +529,47 @@ class CombVerifier:
         return self._dev_gtab, self._dev_qtab
 
     def verify(self, items, pad_to: int):
-        if self._pending_prewarm:
-            pending, self._pending_prewarm = self._pending_prewarm, []
-            for pub in pending:
-                self.registry.register(pub)
+        # Registry mutation (drain + first-use registration) and the
+        # device-table snapshot happen under the lock; the per-item
+        # hash/pack and the launch run outside it, so concurrent flushes
+        # only serialize on the (once-per-key) table builds, not on every
+        # chunk's O(n) hashing.
+        chunk_pubs = {it[-1] for it in items}
+        with self._reg_lock:
+            if self._pending_prewarm:
+                pending, self._pending_prewarm = self._pending_prewarm, []
+                try:
+                    for pub in pending:
+                        self.registry.register(pub)
+                except CombRegistryFull as exc:
+                    # Other engine users filled the registry after our
+                    # prewarm passed its cap check.  Warn like the
+                    # construction-time overflow does, but keep going:
+                    # chunks whose signers are all registered still ride
+                    # the comb path.
+                    self._warn_registry_full(exc)
+            try:
+                # O(distinct signers) lock-held work, not O(items): a
+                # quorum wave repeats each replica's key thousands of times
+                for pub in chunk_pubs:
+                    self.registry.register(pub)
+            except CombRegistryFull as exc:
+                # An unregistrable key sends the WHOLE chunk to the generic
+                # kernel (splitting the launch would double the fixed
+                # per-launch cost).  This raises before any hashing, and
+                # must not escape — the engine's failure guard would
+                # misread it as a kernel transient.
+                self._warn_registry_full(exc)
+                return None
+            except ValueError:
+                return None  # invalid key: generic kernel
+            gtab, qtab = self._device_tables()
         try:
+            # every key is now registered, so _pack's register calls are
+            # pure dict hits — no shared-state mutation outside the lock
             arrays, ok, kidx = self._pack(items)
         except ValueError:
-            return None  # invalid key or registry full: generic kernel
+            return None
         n = len(items)
         if pad_to > n:
             z = np.zeros((pad_to - n, 32), np.uint8)
@@ -489,6 +577,5 @@ class CombVerifier:
             if ok is not None:
                 ok = np.concatenate([ok, np.zeros(pad_to - n, np.uint32)])
             kidx = np.concatenate([kidx, np.zeros(pad_to - n, np.int32)])
-        gtab, qtab = self._device_tables()
         mask = self._launch(arrays, ok, kidx, gtab, qtab)
         return mask[:n]

@@ -473,7 +473,28 @@ class CryptoProvider:
             try:
                 self.engine.prewarm_keys(self.keyring.public_keys.values())
             except ValueError as exc:
-                raise ValueError(f"invalid key in keyring: {exc}") from exc
+                # Import only on the error path: a raised CombRegistryFull
+                # implies pallas_comb is already loaded, and the happy path
+                # must not pull pallas machinery into configurations where
+                # the comb path is disabled.
+                from .pallas_comb import CombRegistryFull
+
+                if not isinstance(exc, CombRegistryFull):
+                    raise ValueError(
+                        f"invalid key in keyring: {exc}") from exc
+                # A long-lived shared engine can accumulate more distinct
+                # keys than the comb registry holds (e.g. across many
+                # reconfigs).  That only disables the comb fast path for
+                # this provider's overflow keys — the generic kernel still
+                # verifies them — so degrade loudly instead of failing
+                # construction.
+                import logging
+
+                logging.getLogger("smartbft_tpu.crypto").warning(
+                    "comb key registry full; provider %s falls back to the "
+                    "generic verify kernel for unregistered keys: %s",
+                    self.keyring.self_id, exc,
+                )
         if coalescer is not None:
             self._coalescer = coalescer
             return

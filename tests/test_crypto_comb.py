@@ -170,3 +170,137 @@ def test_engine_comb_path_and_fallback(monkeypatch):
     out2 = eng2.verify(items)
     assert out2 == expect
     assert calls["generic"] == 1
+
+
+def test_concurrent_registration_binds_keys_consistently(monkeypatch):
+    """Concurrent verify() calls racing first-use registration must not
+    misbind pub -> table index (two threads both reading idx=len(tables)
+    would bind different keys to one index — signatures would then verify
+    against the WRONG replica's key, a quorum-safety hazard).  Engines
+    overlap flushes via asyncio.to_thread, so this race is reachable in
+    production; CombVerifier serializes registry access with a lock."""
+    import threading
+
+    v = pc.CombVerifier()
+    monkeypatch.setattr(
+        v, "_launch",
+        lambda arrays, ok, kidx, gtab, qtab: np.ones(
+            len(np.asarray(kidx)), np.uint32),
+    )
+    nkeys = 12
+    keys = [p256.keygen(b"race-%d" % i) for i in range(nkeys)]
+    items_per_key = []
+    for d, pub in keys:
+        r, s = p256.sign(d, b"race-msg")
+        items_per_key.append([(b"race-msg", r, s, pub)])
+
+    barrier = threading.Barrier(nkeys)
+    errs = []
+
+    def worker(items):
+        try:
+            barrier.wait(timeout=30)
+            for _ in range(3):
+                v.verify(items, pad_to=8)
+        except Exception as e:  # pragma: no cover - failure reporting
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(it,))
+               for it in items_per_key]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errs
+    # Bijection: every key got a distinct index and exactly nkeys tables.
+    reg = v.registry
+    assert len(reg) == nkeys
+    idxs = [reg.index_of(pub) for _, pub in keys]
+    assert sorted(idxs) == list(range(nkeys))
+    # Binding: each index's table is the table OF THAT KEY.
+    for (_, pub), idx in zip(keys, idxs):
+        assert np.array_equal(reg._tables[idx], pc.build_table(pub))
+
+
+def test_registry_full_mid_drain_warns_and_continues(monkeypatch, caplog):
+    """A CombRegistryFull raised while draining pending prewarm keys must
+    neither escape verify() (the engine's failure guard would misread it
+    as a kernel transient and burn a strike toward permanently disabling
+    the comb path) nor degrade the current chunk when its signers are all
+    registered.  Scenario: shared long-lived engine — this provider's
+    prewarm passed the cap check at construction, then OTHER providers'
+    first-use registrations filled the registry before our first verify."""
+    import logging
+
+    v = pc.CombVerifier(cap=1)
+    monkeypatch.setattr(
+        v, "_launch",
+        lambda arrays, ok, kidx, gtab, qtab: np.ones(
+            len(np.asarray(kidx)), np.uint32),
+    )
+    d1, pub1 = p256.keygen(b"drain-1")
+    _, pub2 = p256.keygen(b"drain-2")
+    r, s = p256.sign(d1, b"m")
+    assert v.verify([(b"m", r, s, pub1)], pad_to=8) is not None  # fills cap
+    v._pending_prewarm.append(pub2)  # simulates the raced shared engine
+    with caplog.at_level(logging.WARNING, logger="smartbft_tpu.crypto"):
+        # all-registered chunk keeps the comb path despite the overflow
+        assert v.verify([(b"m", r, s, pub1)], pad_to=8) is not None
+    assert v._pending_prewarm == []  # unregistrable pendings are dropped
+    assert any("registry full" in rec.message for rec in caplog.records)
+
+
+def test_prewarm_overflow_queues_fitting_prefix(monkeypatch):
+    """prewarm_keys past capacity still queues the keys that fit (their
+    tables build up front, avoiding a mid-protocol build/retrace stall)
+    and raises CombRegistryFull only for the overflow."""
+    v = pc.CombVerifier(cap=2)
+    keys = [p256.keygen(b"pw-%d" % i)[1] for i in range(3)]
+    with pytest.raises(pc.CombRegistryFull, match="1 key"):
+        v.prewarm_keys(keys)
+    assert v._pending_prewarm == keys[:2]
+    # idempotent for already-queued keys; overflow still reported
+    with pytest.raises(pc.CombRegistryFull):
+        v.prewarm_keys(keys)
+    assert v._pending_prewarm == keys[:2]
+
+
+def test_unregistrable_key_short_circuits_before_pack(monkeypatch, caplog):
+    """When the registry is full, a chunk containing any unregistered key
+    degrades to the generic kernel WITHOUT paying the per-item hash/pack,
+    while all-registered chunks keep the comb path; the drain-time
+    registry-full condition warns (once)."""
+    import logging
+
+    v = pc.CombVerifier(cap=1)
+    monkeypatch.setattr(
+        v, "_launch",
+        lambda arrays, ok, kidx, gtab, qtab: np.ones(
+            len(np.asarray(kidx)), np.uint32),
+    )
+    d1, pub1 = p256.keygen(b"sc-1")
+    _, pub2 = p256.keygen(b"sc-2")
+    r, s = p256.sign(d1, b"m")
+    assert v.verify([(b"m", r, s, pub1)], pad_to=8) is not None  # fills cap
+
+    packed = {"n": 0}
+    real_pack = v._pack
+
+    def counting_pack(items):
+        packed["n"] += 1
+        return real_pack(items)
+
+    monkeypatch.setattr(v, "_pack", counting_pack)
+    with caplog.at_level(logging.WARNING, logger="smartbft_tpu.crypto"):
+        # mixed chunk with an unregistrable key: no pack, generic fallback
+        assert v.verify([(b"m", r, s, pub1), (b"m", r, s, pub2)],
+                        pad_to=8) is None
+        assert packed["n"] == 0
+        # all-registered chunk still rides the comb path
+        assert v.verify([(b"m", r, s, pub1)], pad_to=8) is not None
+        assert packed["n"] == 1
+        # repeated overflow hits warn only once
+        assert v.verify([(b"m", r, s, pub2)], pad_to=8) is None
+    msgs = [rec.message for rec in caplog.records
+            if "registry full at verify time" in rec.message]
+    assert len(msgs) == 1

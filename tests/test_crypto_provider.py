@@ -221,3 +221,39 @@ def test_provider_coalescer_fills_largest_launch():
     prov = P256CryptoProvider(rings[1], engine=eng)
     assert prov._coalescer.max_batch == eng.pad_sizes[-1]
     assert eng.pad_sizes[-1] >= 16384  # covers an n=128 quorum wave
+
+
+def test_registry_full_degrades_instead_of_failing_construction(keyrings, caplog):
+    """A full comb registry (e.g. a long-lived shared engine accumulating
+    keys across reconfigs) must NOT abort provider construction — the
+    generic kernel still verifies unregistered keys fine.  Only genuinely
+    invalid keys raise."""
+    import logging
+
+    import numpy as np
+
+    from smartbft_tpu.crypto import pallas_comb as pc
+
+    engine = JaxVerifyEngine(pad_sizes=(4, 8))
+    if engine._comb is None:
+        pytest.skip("comb path disabled on this backend")
+    engine._comb.registry = pc.CombKeyRegistry(cap=0)
+    with caplog.at_level(logging.WARNING, logger="smartbft_tpu.crypto"):
+        prov = make_provider(keyrings, 1, engine=engine)  # must not raise
+    assert any("comb key registry full" in r.message for r in caplog.records)
+
+    # ...and the provider still verifies via the generic kernel
+    def generic_stub(*arrays):
+        e = np.asarray(arrays[0])
+        return np.ones(e.shape[0], np.uint32)
+
+    engine._kernel = generic_stub
+    prop = Proposal(payload=b"rf")
+    sig = prov.sign_proposal(prop, b"")
+    assert prov.verify_consenter_sigs_batch([sig], prop)[0] is not None
+
+    # invalid key still fails construction loudly
+    bad = Keyring(1, keyrings[1].private_key,
+                  {**keyrings[1].public_keys, 4: (12345, 67890)})
+    with pytest.raises(ValueError, match="invalid key"):
+        P256CryptoProvider(bad, engine=JaxVerifyEngine(pad_sizes=(4,)))
