@@ -92,7 +92,8 @@ def build_engine(kind: str, pad_sizes, scheme):
 
 async def run_cluster(engine_kind: str, n: int, requests: int, batch: int,
                       pad_sizes, scheme_name: str = "p256",
-                      share_engine: bool = False) -> dict:
+                      share_engine: bool = False,
+                      dedupe: bool = False) -> dict:
     import dataclasses
 
     from smartbft_tpu.crypto.provider import AsyncBatchCoalescer, Keyring
@@ -127,7 +128,9 @@ async def run_cluster(engine_kind: str, n: int, requests: int, batch: int,
         # kernel launch costs ~100ms over the tunnel, so waiting ~20ms to
         # merge every replica's quorum check into ONE launch is cheap
         window = float(os.environ.get("SMARTBFT_BENCH_WINDOW", "0.02"))
-        coalescer = AsyncBatchCoalescer(one, window=window, max_batch=max(pad_sizes))
+        coalescer = AsyncBatchCoalescer(one, window=window,
+                                        max_batch=max(pad_sizes),
+                                        dedupe=dedupe)
         coalescers = {i: coalescer for i in node_ids}
     else:
         engines = {i: build_engine(engine_kind, pad_sizes, scheme)
@@ -214,6 +217,7 @@ async def run_cluster(engine_kind: str, n: int, requests: int, batch: int,
             "scheme": scheme_name,
             "nodes": n,
             "shared_engine": share_engine,
+            "dedupe": dedupe,
             "tx_per_sec": round(requests / elapsed, 1),
             "decisions": decisions,
             "batch_fill_pct": round(stats.batch_fill_pct, 1),
@@ -242,33 +246,46 @@ def main() -> None:
                     choices=("p256", "ed25519", "bls"))
     ap.add_argument(
         "--pad-sizes", default="auto",
-        help="comma-separated engine pad ladder, or 'auto': scale the top "
-             "rung to the cluster's full quorum wave (n x (quorum-1) "
-             "signatures per decision through the shared engine) so one "
-             "decision coalesces into ONE launch, capped at 4096 lanes",
+        help="comma-separated engine pad ladder, or 'auto': derive from the "
+             "production JaxVerifyEngine ladder, with the top rung sized to "
+             "the cluster's full quorum wave rounded up to a 128-lane Mosaic "
+             "block (n x (quorum-1) signatures per decision through the "
+             "shared engine) — one decision coalesces into ONE launch with "
+             "near-full lanes, and the coalescer's max_batch trigger fires "
+             "the moment the wave completes instead of waiting the window "
+             "out",
     )
     ap.add_argument("--share-engine", choices=("auto", "yes", "no"),
                     default="auto",
                     help="share one engine+coalescer across replicas "
                          "(auto: yes for the jax engine)")
+    ap.add_argument("--dedupe", choices=("auto", "yes", "no"), default="auto",
+                    help="deduplicate identical verify items within a "
+                         "coalesced flush (auto: on when the engine is "
+                         "shared — colocated replicas re-check the same "
+                         "commit votes, so a quorum wave holds each "
+                         "signature up to n times)")
     ap.add_argument("--cpu", action="store_true",
                     help="pin JAX to the CPU backend")
     args = ap.parse_args()
     if args.pad_sizes == "auto":
+        from smartbft_tpu.crypto.provider import JaxVerifyEngine
+        import inspect
+
         n = args.nodes
         quorum = (n + (n - 1) // 3 + 1 + 1) // 2  # util.go:176-180
         # the shared engine's per-decision wave: every replica checks its
         # quorum; BLS collapses each check to ONE aggregated pairing lane
         wave = n if args.scheme == "bls" else n * (quorum - 1)
-        top = 128 if args.scheme != "bls" else 8
-        # the comb kernels amortize a fixed per-launch cost, so the top
-        # rung covers the whole wave (n=128 -> 10880 sigs) in ONE launch
-        while top < wave and top < 16384:
-            top *= 2
-        ladder = (8, 32, 128, 512, 2048, 4096, 16384)
-        pad_sizes = tuple(s for s in ladder if s <= top) + (
-            (top,) if top not in ladder else ()
-        )
+        # top rung = the wave rounded up to a 128-lane Mosaic block (n=64:
+        # 2688 exactly — the power-of-two ladder padded it to 4096, wasting
+        # ~34% of every launch); smaller rungs come from the production
+        # engine's default ladder so bench shapes match deployed shapes
+        block = 8 if args.scheme == "bls" else 128
+        top = min(-(-wave // block) * block, 16384)
+        defaults = inspect.signature(JaxVerifyEngine).parameters[
+            "pad_sizes"].default
+        pad_sizes = tuple(sorted({s for s in defaults if s < top} | {top}))
     else:
         pad_sizes = tuple(int(x) for x in args.pad_sizes.split(","))
 
@@ -279,11 +296,16 @@ def main() -> None:
     for kind in args.engines.split(","):
         share = (kind == "jax") if args.share_engine == "auto" \
             else args.share_engine == "yes"
+        # dedupe lives in the shared coalescer: without --share-engine there
+        # is no cross-replica batch to deduplicate, so report it as off
+        dedupe = share and (args.dedupe != "no")
+        if args.dedupe == "yes" and not share:
+            _log("bench: --dedupe yes ignored without a shared engine")
         try:
             res = asyncio.run(
                 run_cluster(kind, args.nodes, args.requests, args.batch,
                             pad_sizes, scheme_name=args.scheme,
-                            share_engine=share)
+                            share_engine=share, dedupe=dedupe)
             )
         except TimeoutError as exc:
             _log(f"bench[{kind}]: FAILED — {exc}")

@@ -26,7 +26,16 @@ class Configuration:
     request_batch_max_bytes: int = 10 * 1024 * 1024
     request_batch_max_interval: float = 0.05
 
-    # Buffers / pool (config.go:30-35)
+    # Buffers / pool (config.go:30-35).
+    # Divergence from the reference: when a View/ViewChanger inbox reaches
+    # incoming_message_buffer_size, further messages are DROPPED (with a
+    # rate-limited warning), whereas the reference blocks the sender on a
+    # full channel for backpressure (view.go:190, viewchanger.go:206).
+    # Dropping bounds a Byzantine flooder's memory without letting it stall
+    # the shared event loop; the cost is that an honest burst near the bound
+    # (e.g. a view-change storm at large n) can shed prepares/commits/
+    # view-data and pay an extra view change.  Size the bound generously for
+    # large clusters — the throughput harness uses max(2000, 40*n).
     incoming_message_buffer_size: int = 200
     request_pool_size: int = 400
 

@@ -47,6 +47,9 @@ class StateRecorder:
     def save(self, msg) -> None:
         self.saved_messages.append(msg)
 
+    async def save_durable(self, msg) -> None:
+        self.save(msg)
+
     def restore(self, view) -> None:
         raise RuntimeError("should not be used")
 
@@ -68,13 +71,28 @@ class PersistedState:
         """Append a SavedMessage; only ProposedRecord truncates
         (state.go:38-59): a new proposal implies the previous decision is a
         stable checkpoint."""
+        data = self._record_and_marshal(msg)
+        self.wal.append(data, truncate_to=isinstance(msg, ProposedRecord))
+
+    async def save_durable(self, msg) -> None:
+        """Like :meth:`save`, but rides the WAL's group-commit path when it
+        has one: the append happens immediately, the fsync lands in a wave
+        shared with every other WAL on the loop, and this coroutine resumes
+        once the record is durable.  Callers hold their dependent broadcast
+        until then — the same WAL-first ordering the sync path gives."""
+        data = self._record_and_marshal(msg)
+        append_async = getattr(self.wal, "append_async", None)
+        if append_async is None:
+            self.wal.append(data, truncate_to=isinstance(msg, ProposedRecord))
+            return
+        await append_async(data, truncate_to=isinstance(msg, ProposedRecord))
+
+    def _record_and_marshal(self, msg) -> bytes:
         if isinstance(msg, ProposedRecord):
             self._store_proposal(msg)
         elif isinstance(msg, CommitRecord):
             self._store_prepared(msg.commit)
-        data = marshal(msg)
-        is_new_proposal = isinstance(msg, ProposedRecord)
-        self.wal.append(data, truncate_to=is_new_proposal)
+        return marshal(msg)
 
     def _store_proposal(self, proposed: ProposedRecord) -> None:
         self.in_flight.store_proposal(proposed.pre_prepare.proposal)

@@ -394,7 +394,9 @@ class View:
         prepare = Prepare(view=self.number, seq=seq, digest=proposal_digest(proposal))
 
         # Record the pre-prepare before sending our prepare (WAL-first).
-        self.state.save(ProposedRecord(pre_prepare=pp, prepare=prepare))
+        # Awaiting durability (group-commit fsync wave) instead of blocking
+        # lets every other component make progress while the disk syncs.
+        await self._save_state(ProposedRecord(pre_prepare=pp, prepare=prepare))
         self.last_broadcast_sent = prepare
         self._curr_prepare_sent = replace(prepare, assist=True)
         self.in_flight_proposal = proposal
@@ -469,8 +471,8 @@ class View:
                 msg=self.my_proposal_sig.msg,
             ),
         )
-        # Save our commit before broadcasting it.
-        self.state.save(CommitRecord(commit=commit))
+        # Save our commit before broadcasting it (group-commit durability).
+        await self._save_state(CommitRecord(commit=commit))
         self._curr_commit_sent = replace(commit, assist=True)
         self.last_broadcast_sent = commit
         self.logger.infof("Processed prepares for proposal with seq %d", seq)
@@ -533,6 +535,15 @@ class View:
                         continue
                     if sig.signer in seen:
                         continue
+                    # stop at EXACTLY quorum-1, like the reference's vote
+                    # collector (view.go:326-349): a batched flush can
+                    # validate extras, but admitting them would make
+                    # certificate sizes vary per replica — and the
+                    # prev-commit count check (view.go:694, ours :698)
+                    # rejects any later pre-prepare carrying fewer commits
+                    # than the verifier's own stored certificate
+                    if len(valid) >= self.quorum - 1:
+                        break
                     seen.add(sig.signer)
                     valid.append(sig)
                 pending = []
@@ -548,6 +559,23 @@ class View:
             self.self_id, len(valid), sorted(s.signer for s in valid),
         )
         return valid
+
+    async def _save_state(self, msg) -> None:
+        """Persist a SavedMessage, awaiting durability.
+
+        Prefers the state's ``save_durable`` (group-commit: append now,
+        fsync in a shared wave — the WAL-first guarantee is intact because
+        the caller broadcasts only after this resumes).  Falls back to the
+        blocking ``save`` for injected test doubles.  A view abort that
+        lands during the await is re-raised here so no post-abort
+        broadcast goes out."""
+        save_durable = getattr(self.state, "save_durable", None)
+        if save_durable is not None:
+            await save_durable(msg)
+        else:
+            self.state.save(msg)
+        if self._aborted:
+            raise ViewAborted()
 
     async def _verify_consenter_sigs_batch(
         self, sigs: Sequence[Signature], proposal: Proposal
@@ -567,12 +595,25 @@ class View:
 
     async def _decide(self, proposal, signatures, requests) -> None:
         """view.go:851-858: prepare next sequence, then hand the decision to
-        the Controller and wait for delivery."""
+        the Controller and wait for delivery.
+
+        Deliberate divergence from the reference: the ViewSequence is stored
+        AFTER ``_start_next_seq`` (the reference stores the just-decided
+        sequence, view.go:853).  Every consumer treats ProposalSeq as "the
+        sequence this view is working on" — the proposer stores the next
+        expected sequence at view start, and the sync path checks
+        ``response.seq == latest_seq + 1`` (controller.go:651) — so storing
+        the just-decided value made the two sources ambiguous: a replica
+        stuck one sequence behind an idle cluster reads the leader's
+        heartbeat seq as equal to its own and never syncs (the heartbeat
+        one-behind rescue, heartbeatmonitor.go:231-247, can then never
+        fire).  Storing the next expected sequence on both paths makes the
+        comparison sound."""
         self.logger.infof("Deciding on seq %d", self.proposal_sequence)
+        self._start_next_seq()
         self.view_sequences.store(
             ViewSequence(view_active=True, proposal_seq=self.proposal_sequence)
         )
-        self._start_next_seq()
         signatures = list(signatures) + [self.my_proposal_sig]
         await self.decider.decide(proposal, signatures, requests)
 

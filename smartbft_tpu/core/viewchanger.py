@@ -314,6 +314,16 @@ class ViewChanger:
         self.view_data_msgs.clear()
         self._back_off_factor = 1
         self._stopped = False
+        # reuse safety: a prior life's run loop may still be winding down if
+        # the caller close()d without awaiting stop() — cancel it so two
+        # loops never compete on one queue, then drain its backlog (a stale
+        # ("stop",) sentinel would kill the fresh run loop on its first turn)
+        if self._task is not None and not self._task.done():
+            self._task.cancel()
+        while not self._events.empty():
+            self._events.get_nowait()
+        self._queued_msgs = 0
+        self._pending_changes = 0
         self._task = asyncio.get_running_loop().create_task(
             self._run(), name=f"viewchanger-{self.self_id}"
         )

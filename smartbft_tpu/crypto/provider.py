@@ -377,10 +377,22 @@ class AsyncBatchCoalescer:
     explicit, so one launch serves many sequences and replicas.
     """
 
-    def __init__(self, engine, window: float = 0.002, max_batch: int = 2048):
+    def __init__(self, engine, window: float = 0.002, max_batch: int = 2048,
+                 dedupe: bool = False):
+        """``dedupe``: verify each DISTINCT item once per flush and fan the
+        verdict out to every submitter.  Verification is a pure function of
+        (message, signature, key), so this is sound; it pays off when many
+        colocated replicas share one engine — a quorum wave then contains
+        each commit signature up to n times (every replica checks the same
+        votes), and deduplication collapses an n*(quorum-1) wave to at most
+        n distinct lanes.  The reference never shares a verifier across
+        replicas, so it has no analogous seam (view.go:537-541 is
+        per-replica fan-out).  Off by default: single-replica engines see
+        no repeats, and the dict pass would be pure overhead."""
         self.engine = engine
         self.window = window
         self.max_batch = max_batch
+        self.dedupe = dedupe
         self._pending: list[tuple] = []
         self._futures: list[tuple[asyncio.Future, int, int]] = []
         self._flush_scheduled = False
@@ -418,7 +430,7 @@ class AsyncBatchCoalescer:
         if not pending:
             return
         try:
-            results = await asyncio.to_thread(self.engine.verify, pending)
+            results = await asyncio.to_thread(self._verify_batch, pending)
         except Exception as exc:
             for fut, _, _ in futures:
                 if not fut.done():
@@ -429,6 +441,22 @@ class AsyncBatchCoalescer:
         for fut, start, count in futures:
             if not fut.done():
                 fut.set_result(results[start : start + count])
+
+    def _verify_batch(self, pending: list) -> list[bool]:
+        """One engine call for the flushed batch, optionally deduplicated."""
+        if not self.dedupe:
+            return self.engine.verify(pending)
+        try:
+            first: dict = {}
+            for it in pending:
+                first.setdefault(it, len(first))
+        except TypeError:
+            # unhashable scheme items — dedupe silently degrades to 1:1
+            return self.engine.verify(pending)
+        if len(first) == len(pending):
+            return self.engine.verify(pending)
+        distinct = self.engine.verify(list(first))
+        return [distinct[first[it]] for it in pending]
 
 
 # ---------------------------------------------------------------------------

@@ -16,9 +16,13 @@ util.go) with the same on-disk architecture:
   from the previous CRC; a file's first frame is a CRC_ANCHOR whose header
   carries the chain value forward without covering bytes
   (writeaheadlog.go:716-757, reader.go:109-144).
-- Every append fsyncs (writeaheadlog.go:469-472).  Files rotate when the
-  next frame might overflow ``file_size_bytes``; rotation deletes files
-  older than the last truncation point (writeaheadlog.go:639-714).
+- Every append fsyncs (writeaheadlog.go:469-472) — or, via
+  :meth:`WriteAheadLogFile.append_async`, writes the frame immediately and
+  defers the fsync to the shared group-commit wave (see
+  :mod:`.group_commit`); callers await durability before acting on it.
+  Files rotate when the next frame might overflow ``file_size_bytes``;
+  rotation deletes files older than the last truncation point
+  (writeaheadlog.go:639-714).
 - ``read_all`` replays entries from the last truncation point, then switches
   the log to write mode on a fresh file.  A torn tail in the *last* file
   raises :class:`RepairableWALError`; ``repair`` truncates the last file
@@ -224,6 +228,7 @@ class WriteAheadLogFile(WriteAheadLog):
         self._truncate_index = 0
         self._active_indexes: list[int] = []
         self._closed = False
+        self._dirty = False  # unsynced frame bytes in the current file
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -282,6 +287,7 @@ class WriteAheadLogFile(WriteAheadLog):
                     self._f.truncate(self._f.tell())
                     self._f.flush()
                     os.fsync(self._f.fileno())
+                    self._dirty = False
                 self._f.close()
                 self._f = None
             self._closed = True
@@ -294,6 +300,46 @@ class WriteAheadLogFile(WriteAheadLog):
             raise WALError("data is nil or empty")
         self._append_record(LogRecord(type=ENTRY, truncate_to=truncate_to, data=entry))
 
+    def append_async(self, entry: bytes, truncate_to: bool) -> "asyncio.Future":
+        """Group-commit append: write the frame now, fsync in a shared wave.
+
+        The frame (and CRC chain) is written before this returns, so record
+        order is call order; only durability is deferred.  The returned
+        future resolves once an fsync covering this write completed —
+        callers MUST await it before sending any message that depends on
+        the record being durable (the WAL-first rule).  Requires a running
+        event loop.
+        """
+        import asyncio
+
+        from .group_commit import default_scheduler
+
+        if not entry:
+            raise WALError("data is nil or empty")
+        self._append_record(
+            LogRecord(type=ENTRY, truncate_to=truncate_to, data=entry), sync=False
+        )
+        with self._lock:
+            dirty = self._dirty
+        if not dirty:
+            # rotation (or a concurrent sync append) already fsynced past us
+            fut: asyncio.Future = asyncio.get_running_loop().create_future()
+            fut.set_result(None)
+            return fut
+        return default_scheduler().schedule(self)
+
+    def _group_sync(self) -> None:
+        """Fsync the current file if it has unsynced frames.  Called by the
+        GroupCommitScheduler on an executor thread; the lock is held across
+        the fsync so the fd cannot rotate/close out from under it (loop-side
+        contention is bounded by one ~100 us fsync — the price the inline
+        path paid on every single append)."""
+        with self._lock:
+            if self._closed or self._f is None or not self._dirty:
+                return  # already durable (rotation/close fsyncs before moving on)
+            os.fsync(self._f.fileno())
+            self._dirty = False
+
     def truncate_to(self) -> None:
         """Append a CONTROL record marking a truncation point
         (writeaheadlog.go:381-394)."""
@@ -303,7 +349,7 @@ class WriteAheadLogFile(WriteAheadLog):
         with self._lock:
             return self._crc
 
-    def _append_record(self, rec: LogRecord) -> None:
+    def _append_record(self, rec: LogRecord, sync: bool = True) -> None:
         with self._lock:
             if self._closed:
                 raise WALClosedError("wal: closed")
@@ -314,9 +360,10 @@ class WriteAheadLogFile(WriteAheadLog):
             length = len(payload)
             if length > 0xFFFFFFFF:
                 raise WALError(f"wal: record too big: {length}")
-            # native fast path: pack + CRC + write + fdatasync in one call
+            # native fast path: pack + CRC + write (+ fdatasync) in one call
             # (write-mode files are unbuffered, so fd-level writes are safe)
-            res = native_wal_append(self._f.fileno(), payload, self._crc, True)
+            res = native_wal_append(self._f.fileno(), payload, self._crc, True,
+                                    do_sync=sync)
             if res is not None:
                 _, self._crc = res
             else:
@@ -324,9 +371,11 @@ class WriteAheadLogFile(WriteAheadLog):
                 crc = crc32c_update(self._crc, padded)
                 self._f.write(_HDR.pack(length | (crc << 32)))
                 self._f.write(padded)
-                self._f.flush()
-                os.fsync(self._f.fileno())
+                if sync:
+                    self._f.flush()
+                    os.fsync(self._f.fileno())
                 self._crc = crc
+            self._dirty = not sync
             if rec.truncate_to:
                 self._truncate_index = self._index
             # switch if this or the next (>=16B) record could overflow
@@ -372,6 +421,7 @@ class WriteAheadLogFile(WriteAheadLog):
         self._f.truncate(self._f.tell())
         self._f.flush()
         os.fsync(self._f.fileno())
+        self._dirty = False  # rotation makes every prior frame durable
         self._f.close()
         self._open_next_file()
         self._log.debugf("Switched to log file index %d", self._index)

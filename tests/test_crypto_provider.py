@@ -257,3 +257,36 @@ def test_registry_full_degrades_instead_of_failing_construction(keyrings, caplog
                   {**keyrings[1].public_keys, 4: (12345, 67890)})
     with pytest.raises(ValueError, match="invalid key"):
         P256CryptoProvider(bad, engine=JaxVerifyEngine(pad_sizes=(4,)))
+
+
+def test_coalescer_dedupe_verifies_distinct_items_once(keyrings):
+    """dedupe=True: identical items across submitters share one engine lane
+    (the colocated-replica shape — every replica re-checks the same votes)."""
+    engine = HostVerifyEngine()
+    co = AsyncBatchCoalescer(engine, window=0.01, dedupe=True)
+
+    d, pub = p256.keygen(b"c")
+    good = (b"m", *p256.sign(d, b"m"), pub)
+    bad = (b"m", 1, 1, pub)
+
+    async def run():
+        return await asyncio.gather(
+            co.submit([good, bad]), co.submit([good, bad]), co.submit([good])
+        )
+
+    r = asyncio.run(run())
+    assert r[0] == [True, False] and r[1] == [True, False] and r[2] == [True]
+    assert engine.stats.launches == 1
+    assert engine.stats.sigs_verified == 2  # 5 submitted, 2 distinct
+
+
+def test_coalescer_dedupe_degrades_on_unhashable_items():
+    engine = HostVerifyEngine()
+    engine._verify_one = lambda item: True
+    co = AsyncBatchCoalescer(engine, window=0.01, dedupe=True)
+
+    async def run():
+        return await co.submit([(b"m", [1, 2])] * 3)  # list => unhashable
+
+    assert asyncio.run(run()) == [True, True, True]
+    assert engine.stats.sigs_verified == 3  # no dedupe possible

@@ -25,3 +25,120 @@ def test_example_is_standalone():
 
 def test_naive_chain_orders_blocks():
     asyncio.run(naive_chain.main(num_blocks=5))
+
+
+def test_naive_chain_per_block_ordering_all_nodes(tmp_path):
+    """The reference's TestChain loop (chain_test.go:71-93): submit blocks
+    one at a time and assert EVERY node emits exactly that block — right
+    sequence, right transactions — before the next is ordered."""
+    from smartbft_tpu.codec import decode
+    from smartbft_tpu.crypto.provider import Keyring
+    from smartbft_tpu.utils.clock import Scheduler, WallClockDriver
+
+    async def run():
+        scheduler = Scheduler()
+        driver = WallClockDriver(scheduler, tick_interval=0.01)
+        mesh = naive_chain.ChannelMesh()
+        keyrings = Keyring.generate([1, 2, 3, 4], seed=b"chain-e2e")
+        nodes = [
+            naive_chain.ChainNode(i, mesh, scheduler, keyrings[i],
+                                  str(tmp_path / f"wal-{i}"))
+            for i in range(1, 5)
+        ]
+        listeners = []
+        for n in nodes:
+            q = asyncio.Queue()
+            n.block_listeners.append(q)
+            listeners.append(q)
+        driver.start()
+        for n in nodes:
+            await n.start()
+        try:
+            for seq in range(1, 6):
+                await nodes[0].submit("alice", f"tx{seq}", payload=b"")
+                for node, q in zip(nodes, listeners):
+                    header, txns = await asyncio.wait_for(q.get(), timeout=30)
+                    assert header.sequence == seq, (node.id, header)
+                    assert [decode(naive_chain.Transaction, t).tx_id
+                            for t in txns] == [f"tx{seq}"], node.id
+        finally:
+            for n in nodes:
+                await n.stop()
+            await driver.stop()
+
+    asyncio.run(run())
+
+
+def test_naive_chain_restart_mid_stream(tmp_path):
+    """A follower restarts between blocks (WAL recovery through the real
+    initialize_and_read_all path) and the chain keeps ordering on all four
+    nodes afterwards — the restart dimension the reference's chain test
+    leaves to the library suites."""
+    import hashlib
+
+    from smartbft_tpu.codec import encode
+    from smartbft_tpu.crypto.provider import Keyring
+    from smartbft_tpu.utils.clock import Scheduler, WallClockDriver
+
+    async def run():
+        scheduler = Scheduler()
+        driver = WallClockDriver(scheduler, tick_interval=0.01)
+        mesh = naive_chain.ChannelMesh()
+        keyrings = Keyring.generate([1, 2, 3, 4], seed=b"chain-restart")
+        nodes = [
+            naive_chain.ChainNode(i, mesh, scheduler, keyrings[i],
+                                  str(tmp_path / f"wal-{i}"))
+            for i in range(1, 5)
+        ]
+        listener: asyncio.Queue = asyncio.Queue()
+        nodes[0].block_listeners.append(listener)
+        driver.start()
+        for n in nodes:
+            await n.start()
+        try:
+            async def order(k: int) -> None:
+                await nodes[0].submit("alice", f"tx{k}", payload=b"")
+                header, _ = await asyncio.wait_for(listener.get(), timeout=30)
+                assert header.sequence == k
+
+            for k in (1, 2, 3):
+                await order(k)
+
+            # wait for every node to DELIVER block 3 locally: the naive
+            # example's sync reports only the local tip (no peer fetch), so
+            # a node stopped mid-delivery could never recover the gap
+            for _ in range(600):
+                if all(len(n.blocks) >= 3 for n in nodes):
+                    break
+                await asyncio.sleep(0.01)
+            assert all(len(n.blocks) >= 3 for n in nodes)
+
+            # follower restart between blocks: rejoin via its own WAL
+            # (initialize_and_read_all recovery), not via state transfer
+            follower = nodes[2]
+            await follower.stop()
+            await follower.start()
+
+            for k in (4, 5):
+                await order(k)
+
+            # the restarted node followed every post-restart block and its
+            # chain links verify end to end (poll: deliveries on other
+            # nodes may trail the listener node's by a few loop turns)
+            for _ in range(600):
+                if all(len(n.blocks) >= 5 for n in nodes):
+                    break
+                await asyncio.sleep(0.01)
+            assert len(follower.blocks) == 5
+            for i in range(1, len(follower.blocks)):
+                want = hashlib.sha256(
+                    encode(follower.blocks[i - 1][0])
+                ).digest()
+                assert follower.blocks[i][0].prev_hash == want
+            assert all(len(n.blocks) == 5 for n in nodes)
+        finally:
+            for n in nodes:
+                await n.stop()
+            await driver.stop()
+
+    asyncio.run(run())

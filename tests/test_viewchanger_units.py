@@ -286,3 +286,25 @@ def test_close_releases_barrier_without_processing_backlog():
         assert processed == []
 
     asyncio.run(run())
+
+
+def test_restart_after_close_drains_stale_stop_sentinel():
+    """close() leaves a ("stop",) sentinel queued; start() must drain it so
+    a reused instance's fresh run loop isn't killed on its first turn."""
+
+    async def run():
+        vc = _bare_viewchanger()
+        vc.start(0)
+        await asyncio.sleep(0)
+        vc.close()
+        await vc._task
+        # reuse the same instance — mirrors consensus restart flows
+        vc.start(0)
+        for _ in range(3):
+            await asyncio.sleep(0)
+        assert not vc._task.done(), "fresh run loop died on a stale sentinel"
+        assert vc._queued_msgs == 0 and vc._pending_changes == 0
+        vc.close()
+        await vc._task
+
+    asyncio.run(run())
