@@ -85,6 +85,15 @@ def build_engine(kind: str, pad_sizes, scheme):
         return OpenSSLVerifyEngine(scheme=scheme)
     if kind == "jax":
         return JaxVerifyEngine(pad_sizes=pad_sizes, scheme=scheme)
+    if kind == "sharded":
+        # quorum waves sharded over ALL visible devices (SURVEY §2.4's
+        # multi-chip shape; on CI this is the virtual 8-CPU mesh —
+        # run with --cpu or JAX_PLATFORMS=cpu
+        # XLA_FLAGS=--xla_force_host_platform_device_count=8)
+        from smartbft_tpu.parallel import ShardedVerifyEngine, build_mesh
+
+        return ShardedVerifyEngine(mesh=build_mesh(), pad_sizes=pad_sizes,
+                                   scheme=scheme)
     if kind == "host":
         return HostVerifyEngine(scheme=scheme)
     raise ValueError(f"unknown engine {kind}")
@@ -107,6 +116,7 @@ async def run_cluster(engine_kind: str, n: int, requests: int, batch: int,
     def cfg(i):
         return dataclasses.replace(
             fast_config(i),
+            wal_group_commit=True,  # production durability path
             request_batch_max_count=batch,
             request_batch_max_interval=0.02,
             request_pool_size=max(2 * requests, 800),
@@ -139,7 +149,7 @@ async def run_cluster(engine_kind: str, n: int, requests: int, batch: int,
 
     # pre-warm every engine at every lane size so no XLA compile lands
     # inside the timed window
-    if engine_kind == "jax":
+    if engine_kind in ("jax", "sharded"):
         # warm with a RING key: a foreign key would grow the comb-table
         # registry past the membership (65 keys -> npad 128) and force a
         # recompile of every padded shape mid-run
@@ -294,7 +304,7 @@ def main() -> None:
 
     results = []
     for kind in args.engines.split(","):
-        share = (kind == "jax") if args.share_engine == "auto" \
+        share = (kind in ("jax", "sharded")) if args.share_engine == "auto" \
             else args.share_engine == "yes"
         # dedupe lives in the shared coalescer: without --share-engine there
         # is no cross-replica batch to deduplicate, so report it as off

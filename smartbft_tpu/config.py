@@ -39,6 +39,14 @@ class Configuration:
     incoming_message_buffer_size: int = 200
     request_pool_size: int = 400
 
+    # Group-commit WAL durability (no reference counterpart — the reference
+    # fsyncs inline on every append, writeaheadlog.go:469-472).  ON: protocol
+    # saves append immediately and await a shared batched fsync wave, so the
+    # disk never blocks the event loop.  Deterministic logical-clock tests
+    # turn it OFF (see testing.app.fast_config): awaiting a real executor
+    # round-trip lets the test clock race ahead of the protocol.
+    wal_group_commit: bool = True
+
     # Request timeout chain (config.go:37-45)
     request_forward_timeout: float = 2.0
     request_complain_timeout: float = 20.0

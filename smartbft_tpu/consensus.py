@@ -151,7 +151,8 @@ class Consensus:
         self._set_nodes(self.comm.nodes())
         self.in_flight = InFlightData()
         self.state = PersistedState(
-            self.in_flight, self.wal_initial_content, self.logger, self.wal
+            self.in_flight, self.wal_initial_content, self.logger, self.wal,
+            group_commit=self.config.wal_group_commit,
         )
         self.checkpoint.set(self.last_proposal, self.last_signatures)
 

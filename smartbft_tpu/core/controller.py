@@ -471,11 +471,22 @@ class Controller(RequestTimeoutHandler):
             self._reconfig = reconfig
             self.close()
         self.logger.debugf("Node %d delivered proposal", self.id)
+        from .pool import PoolError
+
         for info in d.requests:
             try:
                 self.request_pool.remove_request(info)
-            except Exception:
-                pass
+            except PoolError as e:
+                # routine: a delivered request this node never pooled
+                # (followers see most requests only inside batches)
+                self.logger.debugf("%s", e)
+            except Exception as e:
+                # anything else means corrupted pool state — silence here
+                # hid it entirely (round-3 review item)
+                self.logger.warnf(
+                    "Removing delivered request %s from the pool failed "
+                    "unexpectedly: %r", info, e,
+                )
         if not d.done.done():
             d.done.set_result(None)
         if self._stopped:

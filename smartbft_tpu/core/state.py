@@ -61,11 +61,21 @@ class PersistedState:
         entries: list[bytes],
         logger: Logger,
         wal: WriteAheadLog,
+        group_commit: bool = True,
     ):
+        """``group_commit``: let :meth:`save_durable` ride the WAL's
+        append_async path (batched fsync waves, awaited durability).  ON in
+        production — fsyncs stop blocking the event loop.  Deterministic
+        logical-clock tests turn it OFF (Configuration.wal_group_commit /
+        fast_config): a save would otherwise span real executor round-trips
+        during which the test harness advances the logical clock, firing
+        timers the protocol never earned — the same determinism argument
+        that keeps the sync-verifier fallback inline (view.py)."""
         self.in_flight = in_flight
         self.entries = entries
         self.logger = logger
         self.wal = wal
+        self.group_commit = group_commit
 
     def save(self, msg) -> None:
         """Append a SavedMessage; only ProposedRecord truncates
@@ -81,7 +91,9 @@ class PersistedState:
         once the record is durable.  Callers hold their dependent broadcast
         until then — the same WAL-first ordering the sync path gives."""
         data = self._record_and_marshal(msg)
-        append_async = getattr(self.wal, "append_async", None)
+        append_async = (
+            getattr(self.wal, "append_async", None) if self.group_commit else None
+        )
         if append_async is None:
             self.wal.append(data, truncate_to=isinstance(msg, ProposedRecord))
             return

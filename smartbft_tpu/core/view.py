@@ -217,7 +217,18 @@ class View:
             try:
                 await self._task
             except asyncio.CancelledError:
-                pass
+                # Swallow ONLY the view task's own cancellation.  If the
+                # CALLER is the one being cancelled (shutdown reaping a
+                # controller parked here during a view change), eating the
+                # error leaves that task permanently in 'cancelling' —
+                # asyncio delivers the cancel once — and the event loop
+                # can never close (the bug showed as a 0%-CPU hang in
+                # asyncio.run's _cancel_all_tasks).
+                cur = asyncio.current_task()
+                if not self._task.done() or (
+                    cur is not None and cur.cancelling()
+                ):
+                    raise
 
     def get_leader_id(self) -> int:
         return self.leader_id

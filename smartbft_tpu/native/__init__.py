@@ -89,16 +89,27 @@ def load() -> Optional[ctypes.CDLL]:
             ]
             buf = ctypes.c_char_p
             sz = ctypes.c_size_t
-            for name in ("smartbft_bls_g1_mul", "smartbft_bls_g2_mul"):
-                fn = getattr(lib, name)
+            for name in ("smartbft_bls_g1_mul", "smartbft_bls_g1_mul_glv",
+                         "smartbft_bls_g2_mul"):
+                # a prebuilt .so from an older source snapshot (the
+                # source-pruned deploy _stale() supports) may lack newer
+                # symbols — degrade just that entry point, never the
+                # whole native plane
+                try:
+                    fn = getattr(lib, name)
+                except AttributeError:
+                    continue
                 fn.restype = ctypes.c_int
                 fn.argtypes = [buf, sz, buf, ctypes.c_char_p]
             for name in ("smartbft_bls_g1_sum", "smartbft_bls_g2_sum"):
                 fn = getattr(lib, name)
                 fn.restype = ctypes.c_int
                 fn.argtypes = [buf, sz, ctypes.c_char_p]
-            lib.smartbft_ed_decompress.restype = ctypes.c_int
-            lib.smartbft_ed_decompress.argtypes = [buf, ctypes.c_char_p]
+            try:
+                lib.smartbft_ed_decompress.restype = ctypes.c_int
+                lib.smartbft_ed_decompress.argtypes = [buf, ctypes.c_char_p]
+            except AttributeError:
+                pass  # older prebuilt .so: ed decompress degrades to Python
             _lib = lib
         except (OSError, AttributeError):
             _lib = None
@@ -220,6 +231,21 @@ def bls_g1_mul(k: int, pt) -> Optional[tuple]:
     scalar = k.to_bytes(max(1, (k.bit_length() + 7) // 8), "big")
     out = ctypes.create_string_buffer(96)
     rc = lib.smartbft_bls_g1_mul(scalar, len(scalar), _g1_bytes(pt), out)
+    return _g1_point(rc, out.raw)
+
+
+def bls_g1_mul_torsion(k: int, pt) -> Optional[tuple]:
+    """GLV-accelerated k * P — ONLY for P in the r-torsion subgroup (e.g.
+    a hash-to-curve output or a validated key).  The endomorphism identity
+    phi(P) = lambda*P fails off the subgroup, so subgroup checks and
+    cofactor clearing must call :func:`bls_g1_mul` instead.  Falls back to
+    the generic ladder when the loaded library predates the GLV symbol."""
+    lib = load()
+    if not hasattr(lib, "smartbft_bls_g1_mul_glv"):
+        return bls_g1_mul(k, pt)
+    scalar = k.to_bytes(max(1, (k.bit_length() + 7) // 8), "big")
+    out = ctypes.create_string_buffer(96)
+    rc = lib.smartbft_bls_g1_mul_glv(scalar, len(scalar), _g1_bytes(pt), out)
     return _g1_point(rc, out.raw)
 
 

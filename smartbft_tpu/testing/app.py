@@ -75,6 +75,11 @@ def fast_config(self_id: int) -> Configuration:
         leader_heartbeat_timeout=15.0,
         leader_heartbeat_count=10,
         num_of_ticks_behind_before_syncing=10,
+        # blocking saves keep the logical clock honest: an awaited fsync
+        # wave spans real executor round-trips during which wait_for-driven
+        # tests advance timers the protocol never earned (Configuration
+        # docstring has the full rationale); production keeps the default ON
+        wal_group_commit=False,
         collect_timeout=0.5,
         sync_on_start=False,
         speed_up_view_change=False,

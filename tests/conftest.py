@@ -17,3 +17,25 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from smartbft_tpu.utils.jaxenv import force_cpu  # noqa: E402
 
 force_cpu(virtual_devices=8)
+
+
+def require_native(available: bool, what: str) -> None:
+    """Gate a test on a native backend — loudly.
+
+    Default: skip when the backend didn't build (a laptop without g++ can
+    still run the suite).  With SMARTBFT_REQUIRE_NATIVE=1 (CI on build-
+    capable hosts) the missing backend FAILS instead, so the native oracles
+    can't silently vanish from the suite.
+    """
+    import os
+
+    import pytest
+
+    if available:
+        return
+    if os.environ.get("SMARTBFT_REQUIRE_NATIVE") == "1":
+        pytest.fail(
+            f"{what} unavailable but SMARTBFT_REQUIRE_NATIVE=1 — the native "
+            "library failed to build/load on a host that requires it"
+        )
+    pytest.skip(f"{what} unavailable")

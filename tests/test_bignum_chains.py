@@ -1,0 +1,136 @@
+"""A/B coverage for the alternate carry-chain implementations.
+
+``SMARTBFT_BN_CHAIN`` (bignum.py: 'prefix' default / 'scan' alternate) and
+``SMARTBFT_PALLAS_CHAIN`` (pallas_ecdsa.py: 'ripple' default / 'prefix'
+alternate) are read at import time, so each non-default chain runs in a
+subprocess with the env var set and is asserted against the Python-int
+oracle.  Without this, the alternates are untested dead paths — a
+regression in one would only surface when someone flips the knob to
+chase a Mosaic/XLA regression, which is exactly the wrong moment.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Exercises carry_propagate / sub_borrow / MontCtx round-trips against
+# integer arithmetic.  Plain jnp on CPU — no pallas_call, compiles fast.
+BN_SCRIPT = r"""
+import sys
+sys.path.insert(0, %(repo)r)
+from smartbft_tpu.utils.jaxenv import force_cpu
+force_cpu()
+import random
+
+import numpy as np
+
+from smartbft_tpu.crypto import bignum as bn
+
+assert bn.CHAIN == %(chain)r, f"chain knob not honored: {bn.CHAIN}"
+
+P = 0xffffffff00000001000000000000000000000000ffffffffffffffffffffffff
+NL = 16
+ctx = bn.MontCtx(P, NL)
+rng = random.Random(99)
+xs = [rng.randrange(P) for _ in range(64)] + [0, 1, P - 1, P - 2]
+ys = [rng.randrange(P) for _ in range(64)] + [P - 1, 1, P - 1, 2]
+a = bn.batch_to_limbs(xs, NL)
+b = bn.batch_to_limbs(ys, NL)
+
+# sub_borrow against ints
+diff, borrow = bn.sub_borrow(a, b)
+for i, (x, y) in enumerate(zip(xs, ys)):
+    want = (x - y) %% (1 << (16 * NL))
+    assert bn.from_limbs(np.asarray(diff)[i]) == want, i
+    assert int(np.asarray(borrow)[i]) == (1 if x < y else 0), i
+
+# Montgomery multiply round-trip against ints
+am = ctx.to_mont(a)
+bm = ctx.to_mont(b)
+pm = ctx.mul(am, bm)
+prod = ctx.from_mont(pm)
+for i, (x, y) in enumerate(zip(xs, ys)):
+    assert bn.from_limbs(np.asarray(prod)[i]) == (x * y) %% P, i
+
+# raw column products + carry_propagate against ints
+full = bn.mul_full(a[:8], b[:8])
+for i in range(8):
+    assert bn.from_limbs(np.asarray(full)[i]) == xs[i] * ys[i], i
+print("BN-OK", bn.CHAIN)
+"""
+
+# Exercises the pallas helpers' limb-major (m, B) layout against ints.
+PALLAS_SCRIPT = r"""
+import sys
+sys.path.insert(0, %(repo)r)
+from smartbft_tpu.utils.jaxenv import force_cpu
+force_cpu()
+import random
+
+import numpy as np
+import jax.numpy as jnp
+
+from smartbft_tpu.crypto import pallas_ecdsa as pe
+
+assert pe.CHAIN == %(chain)r, f"chain knob not honored: {pe.CHAIN}"
+
+NL = pe.NL
+rng = random.Random(7)
+B = 32
+xs = [rng.randrange(1 << 256) for _ in range(B - 2)] + [0, (1 << 256) - 1]
+ys = [rng.randrange(1 << 256) for _ in range(B - 2)] + [(1 << 256) - 1, 1]
+
+
+def limb_major(vals):
+    a = np.zeros((NL, len(vals)), np.uint32)
+    for j, v in enumerate(vals):
+        for i in range(NL):
+            a[i, j] = (v >> (16 * i)) & 0xFFFF
+    return jnp.asarray(a)
+
+
+def from_limb_major(a, j):
+    a = np.asarray(a)
+    return sum(int(a[i, j]) << (16 * i) for i in range(a.shape[0]))
+
+
+a, b = limb_major(xs), limb_major(ys)
+
+diff, borrow = pe._sub_borrow(a, b)
+for j, (x, y) in enumerate(zip(xs, ys)):
+    assert from_limb_major(diff, j) == (x - y) %% (1 << 256), j
+    assert int(np.asarray(borrow)[j]) == (1 if x < y else 0), j
+
+s = pe._add_rows(a, b)
+for j, (x, y) in enumerate(zip(xs, ys)):
+    assert from_limb_major(s, j) == x + y, j
+print("PALLAS-OK", pe.CHAIN)
+"""
+
+
+def _run(script: str, env_extra: dict) -> str:
+    env = dict(os.environ, **env_extra)
+    res = subprocess.run(
+        [sys.executable, "-c", script], env=env, capture_output=True,
+        text=True, timeout=600,
+    )
+    assert res.returncode == 0, f"stderr:\n{res.stderr[-3000:]}"
+    return res.stdout
+
+
+@pytest.mark.parametrize("chain", ["prefix", "scan"])
+def test_bn_chain_against_int_oracle(chain):
+    out = _run(BN_SCRIPT % {"repo": REPO, "chain": chain},
+               {"SMARTBFT_BN_CHAIN": chain})
+    assert f"BN-OK {chain}" in out
+
+
+@pytest.mark.parametrize("chain", ["ripple", "prefix"])
+def test_pallas_chain_against_int_oracle(chain):
+    out = _run(PALLAS_SCRIPT % {"repo": REPO, "chain": chain},
+               {"SMARTBFT_PALLAS_CHAIN": chain})
+    assert f"PALLAS-OK {chain}" in out

@@ -10,6 +10,8 @@ import os
 import numpy as np
 import pytest
 
+from tests.conftest import require_native
+
 from smartbft_tpu.crypto import bls12381 as bls
 from smartbft_tpu.crypto.bls12381 import (
     HOST,
@@ -278,8 +280,7 @@ def test_native_group_ops_match_python():
 
     from smartbft_tpu import native
 
-    if not native.bls_available():
-        pytest.skip("native BLS backend unavailable")
+    require_native(native.bls_available(), "native BLS backend")
     rng = random.Random(42)
     G1 = (bls.G1X, bls.G1Y)
     G2 = (bls.G2X, bls.G2Y)
@@ -311,8 +312,7 @@ def test_sign_and_aggregate_are_fast_enough():
 
     from smartbft_tpu import native
 
-    if not native.bls_available():
-        pytest.skip("native BLS backend unavailable")
+    require_native(native.bls_available(), "native BLS backend")
     sk, pk = bls.keygen(b"speed")
     bls.sign(sk, b"warm")  # populate the hash_to_g1 cache
     t0 = time.perf_counter()
@@ -324,3 +324,45 @@ def test_sign_and_aggregate_are_fast_enough():
     t0 = time.perf_counter()
     bls.aggregate_sigs(sigs)
     assert time.perf_counter() - t0 < 0.05
+
+
+def test_native_glv_matches_generic_ladder():
+    """The GLV fast path (glv_split + wnaf5 + phi tables) against the
+    generic native ladder on random and boundary scalars — a split/wNAF
+    regression would otherwise produce valid-LOOKING but wrong signatures
+    while sign->verify round-trips still pass."""
+    import random
+
+    from smartbft_tpu import native
+
+    require_native(native.bls_available(), "native BLS backend")
+    G = (bls.G1X, bls.G1Y)
+    rng = random.Random(123)
+    base = native.bls_g1_mul(rng.randrange(1, bls.R_ORDER), G)
+    LAM = 0xAC45A4010001A40200000000FFFFFFFF
+    edges = [
+        1, 2, 3, 15, 16, 17, 31, 32, 33,
+        (1 << 64) - 1, 1 << 64, (1 << 128) - 1, 1 << 128, (1 << 128) + 1,
+        LAM - 1, LAM, LAM + 1, 2 * LAM, bls.R_ORDER - 2, bls.R_ORDER - 1,
+    ]
+    scalars = edges + [rng.randrange(1, bls.R_ORDER) for _ in range(40)]
+    for k in scalars:
+        assert native.bls_g1_mul_torsion(k, base) == \
+            native.bls_g1_mul(k, base), hex(k)
+    assert native.bls_g1_mul_torsion(0, base) is None
+
+
+def test_native_reduces_noncanonical_field_bytes():
+    """Coordinates in [p, 2^384) through the C byte ABI must behave as
+    their reduced values — the no-carry fp_mul requires operands < p, so
+    ingress reduction is the contract (bls381.cc fp_from_bytes_be)."""
+    from smartbft_tpu import native
+
+    require_native(native.bls_available(), "native BLS backend")
+    G = (bls.G1X, bls.G1Y)
+    # encode G with x lifted by +p (non-canonical): results must match G
+    lifted = (bls.G1X + bls.P, bls.G1Y)
+    for k in (1, 5, 12345):
+        want = native.bls_g1_mul(k, G)
+        assert native.bls_g1_mul(k, lifted) == want, k
+        assert native.bls_g1_mul_torsion(k, lifted) == want, k

@@ -12,6 +12,8 @@ import binascii
 import numpy as np
 import pytest
 
+from tests.conftest import require_native
+
 import jax
 import jax.numpy as jnp
 
@@ -196,8 +198,7 @@ def test_native_decompress_matches_python():
 
     from smartbft_tpu import native
 
-    if not native.ed_available():
-        pytest.skip("native ed25519 backend unavailable")
+    require_native(native.ed_available(), "native ed25519 backend")
     import random
 
     rng = random.Random(5)

@@ -1,0 +1,36 @@
+"""Full consensus on the virtual 8-device mesh (SURVEY §2.4 multi-chip).
+
+The conftest provisions 8 virtual CPU devices; these tests drive the SAME
+path the driver's ``dryrun_multichip`` validates: a real cluster whose
+quorum verification runs through ``ShardedVerifyEngine`` with batch lanes
+partitioned across the mesh — not just the bare ``quorum_decide`` kernel.
+"""
+
+import numpy as np
+
+import __graft_entry__ as graft
+from smartbft_tpu.crypto import p256
+from smartbft_tpu.parallel import ShardedVerifyEngine, build_mesh
+
+
+def test_sharded_engine_partitions_lanes_across_mesh():
+    import jax
+
+    assert len(jax.devices()) >= 8, "conftest should provision 8 devices"
+    mesh = build_mesh()
+    engine = ShardedVerifyEngine(mesh=mesh, pad_sizes=(8, 64))
+    assert engine.lanes == len(jax.devices())
+    # every pad size is a mesh multiple so tiles are equal and static
+    assert all(s % engine.lanes == 0 for s in engine.pad_sizes)
+
+    # the placed operand really is distributed: one shard per device
+    placed = engine._place(np.zeros((64, 16), np.uint32))
+    devices = {s.device for s in placed.addressable_shards}
+    assert len(devices) == len(jax.devices())
+    assert placed.addressable_shards[0].data.shape[0] == 64 // engine.lanes
+
+
+def test_consensus_cluster_commits_on_mesh():
+    """One real decision end-to-end with mesh-sharded quorum verification —
+    the cluster-on-mesh scenario the round-3 review flagged as missing."""
+    graft._dryrun_cluster_on_mesh(8)
