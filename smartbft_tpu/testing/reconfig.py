@@ -51,6 +51,7 @@ _BOOL_FIELDS = (
     "sync_on_start",
     "speed_up_view_change",
     "leader_rotation",
+    "wal_group_commit",
 )
 
 
@@ -78,6 +79,7 @@ class ConfigMirror:
     sync_on_start: bool = False
     speed_up_view_change: bool = False
     leader_rotation: bool = False
+    wal_group_commit: bool = True
 
 
 @wiremsg

@@ -408,3 +408,34 @@ def test_metrics_bundle_works_on_any_provider():
         b.view.count_batch_all.add(1)
         b.pool.count_of_requests.set(4)
         b.consensus.latency_sync.observe(0.1)
+
+
+def test_bulk_remove_wakes_all_waiting_submitters():
+    """remove_requests frees many slots in one call; EVERY parked submitter
+    that now fits must wake, not just the first (a bulk-path regression the
+    round-4 review caught: one wakeup per call strands the rest until
+    submit_timeout)."""
+
+    async def run():
+        s = Scheduler()
+        pool = make_pool(s, queue_size=3)
+        for i in range(3):
+            await pool.submit(b"r%d" % i)
+        waiters = [
+            asyncio.ensure_future(pool.submit(b"w%d" % i)) for i in range(3)
+        ]
+        await asyncio.sleep(0)
+        assert all(not w.done() for w in waiters)  # pool full, all parked
+
+        missing = pool.remove_requests(
+            [RequestInfo(client_id="c", request_id="r%d" % i) for i in range(3)]
+            + [RequestInfo(client_id="c", request_id="ghost")]
+        )
+        assert missing == 1  # the ghost
+        for _ in range(5):
+            await asyncio.sleep(0)
+        assert all(w.done() and w.exception() is None for w in waiters), \
+            "bulk removal must wake every submitter that fits"
+        pool.close()
+
+    asyncio.run(run())
