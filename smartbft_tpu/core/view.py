@@ -25,6 +25,7 @@ and are swapped in at ``_start_next_seq`` (view.go:107-113,860-894).
 from __future__ import annotations
 
 import asyncio
+import time
 from dataclasses import dataclass, replace
 from typing import Optional, Sequence
 
@@ -96,6 +97,46 @@ class _ProposalInfo:
 
 
 _ABORT = object()  # inbox sentinel
+
+#: one loud warning per process when a sync-only verifier measurably
+#: stalls the event loop (module-level: shared by View and ViewChanger)
+_warned_slow_sync_verifier = False
+
+
+async def verify_sigs_batch(verifier, sigs, proposal, logger=None) -> list:
+    """Batched consenter-signature verification, async path preferred.
+
+    Sync-only verifiers run inline, ON the event loop.  Deliberate: every
+    CryptoProvider exposes the async coalescer path (engine on a worker
+    thread), so the inline branch serves injected test verifiers with
+    trivial crypto — and threading it (asyncio.to_thread) makes the
+    deterministic logical-clock tests racy: timers advance while the
+    thread runs, firing spurious heartbeat/view-change timeouts.  A
+    production embedder with a slow sync-only verifier hears about it
+    loudly (once per process) when the inline call measurably stalls the
+    loop every component shares.
+    """
+    global _warned_slow_sync_verifier
+    batch_async = getattr(verifier, "verify_consenter_sigs_batch_async", None)
+    if batch_async is not None:
+        return await batch_async(sigs, proposal)
+    t0 = time.monotonic()
+    out = verifier.verify_consenter_sigs_batch(sigs, proposal)
+    elapsed = time.monotonic() - t0
+    if elapsed > 0.05 and not _warned_slow_sync_verifier:
+        _warned_slow_sync_verifier = True
+        if logger is None:
+            from ..utils.logging import StdLogger
+
+            logger = StdLogger("smartbft.view")
+        logger.warnf(
+            "Sync-only verifier blocked the event loop for %.0f ms "
+            "verifying %d signatures; EVERY consensus component stalls "
+            "during such calls — implement verify_consenter_sigs_batch_async "
+            "(see smartbft_tpu.crypto.provider.CryptoProvider) to run "
+            "verification off-loop", 1e3 * elapsed, len(sigs),
+        )
+    return out
 
 
 class View:
@@ -591,18 +632,7 @@ class View:
     async def _verify_consenter_sigs_batch(
         self, sigs: Sequence[Signature], proposal: Proposal
     ) -> list:
-        batch_async = getattr(self.verifier, "verify_consenter_sigs_batch_async", None)
-        if batch_async is not None:
-            return await batch_async(sigs, proposal)
-        # Sync-only verifier: called inline, ON the event loop.  Deliberate:
-        # every CryptoProvider exposes the async coalescer path (which runs
-        # the engine on a worker thread), so this branch serves only
-        # injected test verifiers with trivial crypto — and threading it
-        # (asyncio.to_thread) makes the deterministic logical-clock tests
-        # racy: timers advance while the thread runs, firing spurious
-        # heartbeat/view-change timeouts.  A production embedder with a
-        # slow sync-only verifier should implement the async method.
-        return self.verifier.verify_consenter_sigs_batch(sigs, proposal)
+        return await verify_sigs_batch(self.verifier, sigs, proposal, self.logger)
 
     async def _decide(self, proposal, signatures, requests) -> None:
         """view.go:851-858: prepare next sequence, then hand the decision to
@@ -926,6 +956,4 @@ class View:
     # ------------------------------------------------------------------ misc
 
     def _now(self) -> float:
-        import time
-
         return time.monotonic()

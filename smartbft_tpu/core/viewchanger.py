@@ -50,7 +50,7 @@ from ..metrics import BlacklistMetrics, ViewChangeMetrics, ViewMetrics
 from ..types import Checkpoint, proposal_digest
 from .state import PREPARED
 from .util import InFlightData, NextViews, VoteSet, compute_quorum, get_leader_id
-from .view import View, ViewSequencesHolder
+from .view import View, ViewSequencesHolder, verify_sigs_batch
 
 
 def validate_in_flight(in_flight_proposal: Optional[Proposal], last_sequence: int) -> None:
@@ -91,11 +91,8 @@ async def validate_last_decision(
             continue
         seen.add(sig.signer)
         unique_sigs.append(sig)
-    batch_async = getattr(verifier, "verify_consenter_sigs_batch_async", None)
-    if batch_async is not None:
-        results = await batch_async(unique_sigs, vd.last_decision)
-    else:
-        results = verifier.verify_consenter_sigs_batch(unique_sigs, vd.last_decision)
+    # shared dispatch incl. the loop-stall warning for slow sync verifiers
+    results = await verify_sigs_batch(verifier, unique_sigs, vd.last_decision)
     valid = sum(1 for r in results if r is not None)
     if any(r is None for r in results):
         raise ValueError("last decision signature is invalid")
