@@ -47,7 +47,7 @@ from ..messages import (
 )
 from ..metrics import ConsensusMetrics, ViewMetrics
 from ..types import Checkpoint, Proposal, Reconfig, RequestInfo, ViewAndSeq, cached_view_metadata
-from .pool import Pool, RequestTimeoutHandler
+from .pool import Pool, RequestTimeoutHandler, remove_delivered_requests
 from .state import ABORT, COMMITTED
 from .util import InFlightData, compute_quorum, get_leader_id
 from .view import ViewSequence, ViewSequencesHolder
@@ -161,6 +161,7 @@ class Controller(RequestTimeoutHandler):
         self._stopped = False
         self._task: Optional[asyncio.Task] = None
         self._propose_pending = False  # 1-slot leader token (controller.go:748-761)
+        self._fwd_submit_failures = 0  # throttled warn counter (handle_request)
         self._sync_pending = False  # 1-slot sync token (controller.go:718-730)
         self._sync_lock = asyncio.Lock()  # deliver-vs-sync (controller.go:143,940)
         self._reconfig: Optional[Reconfig] = None
@@ -220,8 +221,17 @@ class Controller(RequestTimeoutHandler):
             return
         try:
             await self.submit_request(req)
-        except Exception:
-            pass
+        except Exception as e:
+            # the reference warns on forwarded-submit failure too
+            # (controller.go:258-263); a full pool here is routine under
+            # load, so throttle like the inbox-overflow warnings — per-
+            # request logging on this hot path costs seconds per bench run
+            self._fwd_submit_failures += 1
+            if self._fwd_submit_failures == 1 or self._fwd_submit_failures % 1000 == 0:
+                self.logger.warnf(
+                    "Got request from %d but couldn't submit it (%d failures so far): %s",
+                    sender, self._fwd_submit_failures, e,
+                )
 
     # -- pool timeout chain (controller.go:266-297) ------------------------
 
@@ -475,21 +485,7 @@ class Controller(RequestTimeoutHandler):
         # most requests only inside batches) are counted, not raised/logged
         # per item — at RequestBatch=500 x 64 replicas the per-request
         # exception+logging path alone cost seconds per bench run.
-        # Unexpected exceptions mean corrupted pool state and warn loudly
-        # (round-3 review item — silence hid them).
-        try:
-            not_pooled = self.request_pool.remove_requests(d.requests)
-        except Exception as e:
-            self.logger.warnf(
-                "Removing delivered requests from the pool failed "
-                "unexpectedly: %r", e,
-            )
-            not_pooled = 0
-        if not_pooled:
-            self.logger.debugf(
-                "%d of %d delivered requests were not in the pool",
-                not_pooled, len(d.requests),
-            )
+        remove_delivered_requests(self.request_pool, d.requests, self.logger)
         if not d.done.done():
             d.done.set_result(None)
         if self._stopped:

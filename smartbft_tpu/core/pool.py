@@ -86,6 +86,28 @@ class _Item:
         self.addition_time = addition_time
 
 
+def remove_delivered_requests(pool, infos, logger) -> None:
+    """Bulk-remove a delivered batch from ``pool``, loudly on failure.
+
+    The shared post-delivery idiom (Controller._decide and both ViewChanger
+    delivery paths): a not-pooled request is routine on followers and only
+    counted, but an unexpected exception means corrupted pool state and
+    must warn — the reference logs removal failures too
+    (controller.go:258-263, viewchanger.go:1178-1182)."""
+    infos = list(infos)
+    try:
+        not_pooled = pool.remove_requests(infos)
+    except Exception as e:
+        logger.warnf(
+            "Removing delivered requests from the pool failed unexpectedly: %r", e
+        )
+        return
+    if not_pooled:
+        logger.debugf(
+            "%d of %d delivered requests were not in the pool", not_pooled, len(infos)
+        )
+
+
 class Pool:
     """The request pool.  Owned by the consensus event loop; ``submit`` is
     async (it may wait for space), everything else is synchronous."""
@@ -256,7 +278,14 @@ class Pool:
                 except Exception:
                     pass
         if removed and self._metrics:
-            self._metrics.count_of_requests.set(len(self._items))
+            try:
+                # same guard as the per-item observe above: removal fully
+                # succeeded by now, so a faulty metrics provider must not
+                # escape to the controller's catch-all and log a spurious
+                # "pool removal failed" warning
+                self._metrics.count_of_requests.set(len(self._items))
+            except Exception:
+                pass
         self._release_space()
         return missing
 
@@ -270,10 +299,16 @@ class Pool:
         self._size_bytes -= len(item.request)
         self._move_to_del(info)
         if self._metrics:
-            self._metrics.count_of_requests.set(len(self._items))
-            self._metrics.latency_of_requests.observe(
-                self._scheduler.now() - item.addition_time
-            )
+            try:
+                # same guard as remove_requests: removal already succeeded,
+                # so a faulty metrics provider must not escape (prune()
+                # catches only PoolError around this call)
+                self._metrics.count_of_requests.set(len(self._items))
+                self._metrics.latency_of_requests.observe(
+                    self._scheduler.now() - item.addition_time
+                )
+            except Exception:
+                pass
         self._release_space()
 
     def _move_to_del(self, info: RequestInfo) -> None:

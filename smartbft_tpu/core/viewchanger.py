@@ -48,6 +48,7 @@ from ..messages import (
 )
 from ..metrics import BlacklistMetrics, ViewChangeMetrics, ViewMetrics
 from ..types import Checkpoint, proposal_digest
+from .pool import remove_delivered_requests
 from .state import PREPARED
 from .util import InFlightData, NextViews, VoteSet, compute_quorum, get_leader_id
 from .view import View, ViewSequencesHolder, verify_sigs_batch
@@ -187,11 +188,7 @@ class _InFlightDecider:
         reconfig = await vc.application.deliver(proposal, signatures)
         if reconfig.in_latest_decision:
             vc.close()
-        for info in requests:
-            try:
-                vc.requests_timer.remove_request(info)
-            except Exception:
-                pass
+        remove_delivered_requests(vc.requests_timer, requests, vc.logger)
         vc.pruner.maybe_prune_revoked_requests()
         if vc._in_flight_decide is not None and not vc._in_flight_decide.done():
             vc._in_flight_decide.set_result(True)
@@ -278,6 +275,7 @@ class ViewChanger:
         self.controller_started_event: Optional[asyncio.Event] = None
         self._stopped = False
         self._task: Optional[asyncio.Task] = None
+        self._prior_tasks: set[asyncio.Task] = set()
         self._restore_on_start = False
 
         self.view_change_msgs = VoteSet(lambda _s, m: isinstance(m, ViewChange))
@@ -317,12 +315,17 @@ class ViewChanger:
         # ("stop",) sentinel would kill the fresh run loop on its first turn)
         if self._task is not None and not self._task.done():
             self._task.cancel()
+            self._prior_tasks.add(self._task)
+        # transitive: a prior life may ITSELF still be waiting on an even
+        # older cancelled loop — a rapid double restart must not let the
+        # oldest loop interleave with the newest (wait on ALL live priors)
+        self._prior_tasks = {t for t in self._prior_tasks if not t.done()}
         while not self._events.empty():
             self._events.get_nowait()
         self._queued_msgs = 0
         self._pending_changes = 0
         self._task = asyncio.get_running_loop().create_task(
-            self._run(), name=f"viewchanger-{self.self_id}"
+            self._run(frozenset(self._prior_tasks)), name=f"viewchanger-{self.self_id}"
         )
 
     def _set_view_metrics(self) -> None:
@@ -401,7 +404,22 @@ class ViewChanger:
 
     # ------------------------------------------------------------------ loop
 
-    async def _run(self) -> None:
+    async def _run(self, prior_tasks: frozenset = frozenset()) -> None:
+        if prior_tasks:
+            # prior lives' cancelled loops may be suspended mid-_process_msg
+            # (not at the queue.get); let their cancellations land before
+            # this loop touches shared ViewChanger state, so two loops never
+            # interleave.  asyncio.wait never propagates the tasks' outcomes.
+            # Bounded: an embedder callback that swallows cancellation must
+            # not brick the ViewChanger forever — after the timeout, proceed
+            # loudly (the pre-round-5 behavior, but observable).
+            _, stragglers = await asyncio.wait(prior_tasks, timeout=5.0)
+            if stragglers:
+                self.logger.warnf(
+                    "ViewChanger %d: %d prior run loop(s) ignored cancellation "
+                    "for 5s; proceeding — shared state may briefly interleave",
+                    self.self_id, len(stragglers),
+                )
         if self.controller_started_event is not None:
             await self.controller_started_event.wait()  # viewchanger.go:156
         while True:
@@ -943,11 +961,9 @@ class ViewChanger:
         reconfig = await self.application.deliver(proposal, signatures)
         if reconfig.in_latest_decision:
             self.close()
-        for info in self.verifier.requests_from_proposal(proposal):
-            try:
-                self.requests_timer.remove_request(info)
-            except Exception:
-                pass
+        remove_delivered_requests(
+            self.requests_timer, self.verifier.requests_from_proposal(proposal), self.logger
+        )
         self.pruner.maybe_prune_revoked_requests()
 
     # ------------------------------------------------------------------ in-flight commit

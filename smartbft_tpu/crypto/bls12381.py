@@ -729,11 +729,20 @@ def sign(sk: int, msg: bytes) -> bytes:
     H(msg) is cofactor-cleared (r-torsion by construction), so the native
     GLV ladder is sound here — ~halves the doublings of the generic path
     (native/bls381.cc jac_mul_glv)."""
+    if sk % R_ORDER == 0:
+        # sk*h would be the point at infinity (rc==0 from the native ABI,
+        # None from the software ladder) — unserializable and useless as a
+        # signature; fail with a diagnosis instead of a TypeError downstream
+        raise ValueError("BLS secret key is 0 mod r; refusing to sign")
     h = hash_to_g1(msg)
     nat = _native_bls()
     if nat is not None:
-        return serialize_g1(nat.bls_g1_mul_torsion(sk, h))
-    return serialize_g1(g1_scalar_mult(sk, h))
+        pt = nat.bls_g1_mul_torsion(sk, h)
+    else:
+        pt = g1_scalar_mult(sk, h)
+    if pt is None:  # h at infinity (negligible-probability hash output)
+        raise ValueError("BLS signing produced the point at infinity")
+    return serialize_g1(pt)
 
 
 # Proof of possession: same-message ("fast") aggregate verification is only

@@ -29,12 +29,21 @@ side effects.
 from __future__ import annotations
 
 import asyncio
+import logging
 import weakref
 from typing import Protocol
 
 
 class _GroupSyncable(Protocol):
     def _group_sync(self) -> None: ...
+
+
+def _log_unobserved_fsync_failure(exc: BaseException) -> None:
+    logging.getLogger("smartbft.wal").warning(
+        "WAL group-commit fsync wave failed with no live awaiter "
+        "(all callers cancelled); durability is NOT guaranteed for the "
+        "wave's appends: %r", exc,
+    )
 
 
 class GroupCommitScheduler:
@@ -74,13 +83,19 @@ class GroupCommitScheduler:
                 return_exceptions=True,
             )
             for (_, futs), res in zip(pending.items(), results):
+                observed = False
                 for fut in futs:
                     if fut.done():
                         continue  # caller went away (e.g. cancelled)
                     if isinstance(res, BaseException):
                         fut.set_exception(res)
+                        observed = True
                     else:
                         fut.set_result(None)
+                if isinstance(res, BaseException) and not observed:
+                    # every awaiting caller was already cancelled: a real
+                    # durability failure (disk error) must still be heard
+                    _log_unobserved_fsync_failure(res)
         # task exits when idle; schedule() restarts it on the next append
 
 

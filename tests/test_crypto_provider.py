@@ -280,6 +280,44 @@ def test_coalescer_dedupe_verifies_distinct_items_once(keyrings):
     assert engine.stats.sigs_verified == 2  # 5 submitted, 2 distinct
 
 
+def test_coalescer_dedupe_property_random_mixes(keyrings):
+    """Property check over random duplicate mixes: for ANY partition of a
+    flush into submitters, each submitter's verdict slice equals the
+    per-item oracle (valid items True, forged False), dedupe on or off."""
+    import random
+
+    rng = random.Random(7)
+    keys = [p256.keygen(bytes([i])) for i in range(4)]
+    universe = []
+    oracle = {}
+    for i, (d, pub) in enumerate(keys):
+        msg = b"msg-%d" % i
+        good = (msg, *p256.sign(d, msg), pub)
+        bad = (msg, 7, 9, pub)  # structurally valid, cryptographically not
+        universe += [good, bad]
+        oracle[good] = True
+        oracle[bad] = False
+
+    for trial in range(6):
+        engine = HostVerifyEngine()
+        co = AsyncBatchCoalescer(engine, window=0.01, dedupe=True)
+        submissions = [
+            [rng.choice(universe) for _ in range(rng.randrange(1, 6))]
+            for _ in range(rng.randrange(2, 5))
+        ]
+
+        async def run():
+            return await asyncio.gather(*(co.submit(s) for s in submissions))
+
+        results = asyncio.run(run())
+        for items, verdicts in zip(submissions, results):
+            assert verdicts == [oracle[it] for it in items], (trial, items)
+        # dedupe really collapsed repeats: one launch, distinct lanes only
+        assert engine.stats.launches == 1
+        distinct = len({it for s in submissions for it in s})
+        assert engine.stats.sigs_verified == distinct
+
+
 def test_coalescer_dedupe_degrades_on_unhashable_items():
     engine = HostVerifyEngine()
     engine._verify_one = lambda item: True

@@ -263,6 +263,35 @@ def test_barrier_holds_messages_until_controller_started():
     asyncio.run(run())
 
 
+# -- delivered-request removal is never silent (controller.go:258-263) -------
+
+def test_remove_delivered_requests_warns_on_unexpected_failure():
+    from smartbft_tpu.core.pool import remove_delivered_requests as _remove_delivered_requests
+    from smartbft_tpu.utils.logging import RecordingLogger
+
+    class BrokenPool:
+        def remove_requests(self, infos):
+            raise RuntimeError("pool state corrupted")
+
+    log = RecordingLogger("vc")
+    _remove_delivered_requests(BrokenPool(), ["a", "b"], log)
+    assert any("failed unexpectedly" in m for m in log.lines), log.lines
+
+
+def test_remove_delivered_requests_counts_missing_quietly():
+    from smartbft_tpu.core.pool import remove_delivered_requests as _remove_delivered_requests
+    from smartbft_tpu.utils.logging import RecordingLogger
+
+    class BulkPool:
+        def remove_requests(self, infos):
+            return len(infos)  # all missing: routine on followers
+
+    log = RecordingLogger("vc")
+    _remove_delivered_requests(BulkPool(), ["a", "b"], log)
+    assert not any("failed unexpectedly" in m for m in log.lines), log.lines
+    assert any("were not in the pool" in m for m in log.lines), log.lines
+
+
 def test_close_releases_barrier_without_processing_backlog():
     """close() before the controller finished starting must release the
     barrier AND skip the buffered message backlog — never process messages
