@@ -102,7 +102,8 @@ def build_engine(kind: str, pad_sizes, scheme):
 async def run_cluster(engine_kind: str, n: int, requests: int, batch: int,
                       pad_sizes, scheme_name: str = "p256",
                       share_engine: bool = False,
-                      dedupe: bool = False) -> dict:
+                      dedupe: bool = False,
+                      pipeline: int = 1) -> dict:
     import dataclasses
 
     from smartbft_tpu.crypto.provider import AsyncBatchCoalescer, Keyring
@@ -114,8 +115,14 @@ async def run_cluster(engine_kind: str, n: int, requests: int, batch: int,
     provider_cls = get_provider_cls(scheme_name)
 
     def cfg(i):
+        pipe = {}
+        if pipeline > 1:
+            # pipelined window requires rotation off (config.validate)
+            pipe = dict(leader_rotation=False, decisions_per_leader=0,
+                        pipeline_depth=pipeline)
         return dataclasses.replace(
             fast_config(i),
+            **pipe,
             wal_group_commit=True,  # production durability path
             request_batch_max_count=batch,
             request_batch_max_interval=0.02,
@@ -138,8 +145,10 @@ async def run_cluster(engine_kind: str, n: int, requests: int, batch: int,
         # kernel launch costs ~100ms over the tunnel, so waiting ~20ms to
         # merge every replica's quorum check into ONE launch is cheap
         window = float(os.environ.get("SMARTBFT_BENCH_WINDOW", "0.02"))
+        # pipelined mode: up to `pipeline` decisions' quorum waves coalesce
+        # into one flush — max_batch must not force-flush a single wave
         coalescer = AsyncBatchCoalescer(one, window=window,
-                                        max_batch=max(pad_sizes),
+                                        max_batch=pipeline * max(pad_sizes),
                                         dedupe=dedupe)
         coalescers = {i: coalescer for i in node_ids}
     else:
@@ -228,6 +237,7 @@ async def run_cluster(engine_kind: str, n: int, requests: int, batch: int,
             "nodes": n,
             "shared_engine": share_engine,
             "dedupe": dedupe,
+            "pipeline": pipeline,
             "tx_per_sec": round(requests / elapsed, 1),
             "decisions": decisions,
             "batch_fill_pct": round(stats.batch_fill_pct, 1),
@@ -277,6 +287,11 @@ def main() -> None:
                          "signature up to n times)")
     ap.add_argument("--cpu", action="store_true",
                     help="pin JAX to the CPU backend")
+    ap.add_argument("--pipeline", type=int, default=1,
+                    help="pipelined in-flight window depth k (k>=2 runs "
+                         "rotation-off mode: the leader keeps k sequences "
+                         "outstanding so consecutive quorum waves coalesce "
+                         "into shared device launches)")
     args = ap.parse_args()
     if args.pad_sizes == "auto":
         from smartbft_tpu.crypto.provider import JaxVerifyEngine
@@ -295,7 +310,13 @@ def main() -> None:
         top = min(-(-wave // block) * block, 16384)
         defaults = inspect.signature(JaxVerifyEngine).parameters[
             "pad_sizes"].default
-        pad_sizes = tuple(sorted({s for s in defaults if s < top} | {top}))
+        rungs = {s for s in defaults if s < top} | {top}
+        if args.pipeline > 1:
+            # deduped steady-state launch for a full k-window: one distinct
+            # signature per replica per decision -> k*n lanes
+            pipe_rung = min(-(-(args.pipeline * n) // block) * block, 16384)
+            rungs |= {pipe_rung}
+        pad_sizes = tuple(sorted(rungs))
     else:
         pad_sizes = tuple(int(x) for x in args.pad_sizes.split(","))
 
@@ -315,7 +336,8 @@ def main() -> None:
             res = asyncio.run(
                 run_cluster(kind, args.nodes, args.requests, args.batch,
                             pad_sizes, scheme_name=args.scheme,
-                            share_engine=share, dedupe=dedupe)
+                            share_engine=share, dedupe=dedupe,
+                            pipeline=args.pipeline)
             )
         except TimeoutError as exc:
             _log(f"bench[{kind}]: FAILED — {exc}")

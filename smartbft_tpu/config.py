@@ -76,6 +76,21 @@ class Configuration:
     request_max_bytes: int = 10 * 1024
     request_pool_submit_timeout: float = 5.0
 
+    # Pipelined in-flight window (no reference counterpart — the reference
+    # keeps exactly one sequence in flight: the leader re-acquires the
+    # propose token only after the current decision delivers,
+    # controller.go:555-557, and only pipelines vote COLLECTION one ahead,
+    # view.go:107-113).  pipeline_depth k >= 2 lets the leader keep up to k
+    # consecutive sequences outstanding (propose s+1 before s delivers);
+    # replicas run a per-sequence slot machine with in-order commit
+    # broadcast and in-order delivery.  The payoff is batched quorum
+    # verification ACROSS decisions: k commit waves coalesce into one
+    # device launch instead of k.  Requires leader_rotation off — the
+    # rotation protocol chains each pre-prepare to the PREVIOUS decision's
+    # commit certificate (view.go:606-647), which a pipelined leader does
+    # not yet hold.  k = 1 is the reference-faithful default.
+    pipeline_depth: int = 1
+
     def validate(self) -> None:
         def positive(name: str) -> None:
             v = getattr(self, name)
@@ -115,6 +130,14 @@ class Configuration:
             raise ConfigError("decisions_per_leader should be greater than zero when leader rotation is active")
         if not self.leader_rotation and self.decisions_per_leader != 0:
             raise ConfigError("decisions_per_leader should be zero when leader rotation is off")
+        if self.pipeline_depth < 1:
+            raise ConfigError("pipeline_depth should be at least 1")
+        if self.pipeline_depth > 1 and self.leader_rotation:
+            raise ConfigError(
+                "pipeline_depth > 1 requires leader_rotation off (the rotation "
+                "protocol chains pre-prepares to the previous decision's "
+                "commit certificate)"
+            )
 
     def with_self_id(self, self_id: int) -> "Configuration":
         return replace(self, self_id=self_id)

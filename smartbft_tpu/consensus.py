@@ -387,6 +387,7 @@ class Consensus:
             nodes_list=self.nodes,
             in_msg_q_size=self.config.incoming_message_buffer_size,
             view_sequences=view_sequences,
+            pipeline_depth=self.config.pipeline_depth,
         )
 
     def _create_pool(self) -> None:
@@ -427,6 +428,7 @@ class Consensus:
             self.controller,
             self.controller.view_sequences,
             self.config.num_of_ticks_behind_before_syncing,
+            pipeline_depth=self.config.pipeline_depth,
         )
         self.controller.batcher = batcher
         self.controller.leader_monitor = leader_monitor

@@ -326,7 +326,10 @@ class Controller(RequestTimeoutHandler):
         leader, _ = self.i_am_the_leader()
         role = "follower"
         if leader:
-            if init_phase in (COMMITTED, ABORT):
+            window_has_room = getattr(view, "can_accept_more_proposals", None)
+            if init_phase in (COMMITTED, ABORT) or (
+                window_has_room is not None and window_has_room()
+            ):
                 self._acquire_leader_token()
             role = "leader"
         self.leader_monitor.change_role(role, self.curr_view_number, self.leader_id())
@@ -402,17 +405,28 @@ class Controller(RequestTimeoutHandler):
     # ------------------------------------------------------------------ propose
 
     async def _propose(self) -> None:
-        """controller.go:475-487."""
+        """controller.go:475-487.  In pipelined mode (pipeline_depth > 1)
+        the view accepts proposals while previous decisions are still in
+        flight; the token re-arms after each propose until the window fills,
+        and again on every delivery (_decide)."""
         self._propose_pending = False
         if self._stopped or self.batcher.closed():
             return
+        view = self.curr_view
+        window_has_room = getattr(view, "can_accept_more_proposals", None)
+        if window_has_room is not None and not window_has_room():
+            return  # window full; the next delivery re-arms the token
         next_batch = await self.batcher.next_batch()
         if not next_batch:
             self._acquire_leader_token()  # try again later
             return
-        metadata = self.curr_view.get_metadata()
+        if view is not self.curr_view or self._stopped:
+            return  # view changed while batching
+        metadata = view.get_metadata()
         proposal = self.assembler.assemble_proposal(metadata, next_batch)
-        self.curr_view.propose(proposal)
+        view.propose(proposal)
+        if window_has_room is not None and window_has_room():
+            self._acquire_leader_token()
 
     # ------------------------------------------------------------------ loop
 
@@ -609,7 +623,7 @@ class Controller(RequestTimeoutHandler):
         self.logger.infof(
             "Synced to sequence %d, deleting in-flight as it is stale", sync_md.latest_sequence
         )
-        self.in_flight.clear()
+        self.in_flight.prune_synced(sync_md.latest_sequence)
 
     async def _fetch_state(self) -> Optional[ViewAndSeq]:
         """controller.go:707-716."""

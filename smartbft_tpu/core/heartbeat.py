@@ -36,6 +36,7 @@ class HeartbeatMonitor:
         handler,
         view_sequences: ViewSequencesHolder,
         num_of_ticks_behind_before_syncing: int,
+        pipeline_depth: int = 1,
     ):
         self._log = logger
         self._hb_timeout = heartbeat_timeout
@@ -45,6 +46,11 @@ class HeartbeatMonitor:
         self._handler = handler  # Controller: on_heartbeat_timeout / sync
         self._view_sequences = view_sequences
         self._ticks_behind_limit = num_of_ticks_behind_before_syncing
+        # pipelined mode: a healthy follower may trail the leader by up to
+        # the window depth while quorums it is not part of complete —
+        # lagging inside the window is the persistent-behind case (counter,
+        # then sync), not the fell-off-the-ledger case (immediate sync)
+        self._lag_tolerance = max(1, pipeline_depth)
 
         self._view = 0
         self._leader_id = 0
@@ -179,14 +185,14 @@ class HeartbeatMonitor:
 
         active, our_seq = self._view_active()
         if active and not artificial:
-            if our_seq + 1 < hb.seq:
+            if our_seq + self._lag_tolerance < hb.seq:
                 self._log.debugf(
                     "Heartbeat sequence is bigger than expected, leader's sequence is %d and ours is %d, syncing",
                     hb.seq, our_seq,
                 )
                 self._handler.sync()
                 return
-            if our_seq + 1 == hb.seq:
+            if our_seq < hb.seq <= our_seq + self._lag_tolerance:
                 self._follower_behind = True
                 if our_seq > self._behind_seq:
                     self._behind_seq = our_seq

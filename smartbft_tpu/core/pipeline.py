@@ -1,0 +1,855 @@
+"""Pipelined in-flight window: k sequences outstanding at once.
+
+No reference counterpart — this is the one deliberate protocol DEPARTURE
+from /root/reference (SURVEY §7(c) anticipated it).  The reference keeps
+exactly one sequence in flight: the leader re-acquires the propose token
+only after the current decision delivers (controller.go:555-557) and the
+View pipelines only vote *collection* one sequence ahead
+(view.go:107-113,860-894).  On an accelerator whose fixed per-launch cost
+dominates the quorum-verification kernel, that shape pays one launch per
+decision, strictly serialized — the launch floor can never be amortized.
+
+:class:`WindowedView` runs a window of up to ``2k`` per-sequence slots,
+each a miniature three-phase machine (pre-prepare -> prepare -> commit),
+with three global invariants that keep the safety argument inductive:
+
+* **In-order prepare-send**: a slot persists its ProposedRecord and sends
+  its prepare only after every lower slot did (WAL suffix stays ordered,
+  so crash restore rebuilds the window unambiguously).
+* **In-order commit-send**: a slot signs/broadcasts its commit only after
+  every lower slot did.  Hence a commit quorum at seq s implies quorum
+  commit-sends at every s' < s, and the multi-in-flight view change
+  (viewchanger.check_in_flight_ladder) inherits the single-slot quorum-
+  intersection argument rung by rung.
+* **In-order delivery**: slot s hands its decision to the Controller only
+  after s-1 delivered (the reference's decide rendezvous, unchanged).
+
+Commit-signature verification is NOT ordered: each slot flushes its quorum
+wave as an independent task through ``verify_consenter_sigs_batch_async``,
+so the waves of k consecutive sequences sit in the coalescer concurrently
+and merge into ONE device launch — the cross-decision batching axis that
+divides the launch floor by the window depth.
+
+Rotation must be off (config.validate enforces it): the rotation protocol
+chains each pre-prepare to the previous decision's commit certificate
+(view.go:606-647,1022-1062), which a pipelined leader does not hold yet.
+With ``decisions_per_leader == 0`` the blacklist is empty by protocol and
+pre-prepares carry no prev-commit signatures, which this class enforces.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field, replace
+from typing import Optional
+
+from ..api import Logger, Signer, Verifier
+from ..codec import decode, encode
+from ..messages import (
+    Commit,
+    CommitRecord,
+    Message,
+    PreparesFrom,
+    PrePrepare,
+    Prepare,
+    Proposal,
+    ProposedRecord,
+    Signature,
+    ViewMetadata,
+)
+from ..metrics import ViewMetrics
+from ..types import proposal_digest
+from .state import ABORT, COMMITTED, PREPARED, PROPOSED
+from .util import VoteSet, compute_quorum
+from .view import (
+    ViewAborted,
+    ViewSequence,
+    ViewSequencesHolder,
+    proposal_sequence_of_msg,
+    verify_sigs_batch,
+    view_number_of_msg,
+)
+
+_ABORT_SENTINEL = object()
+
+#: slot-local pseudo-phase: quorum of valid commits collected, awaiting
+#: in-order delivery (the single-slot View has no equivalent state — it
+#: delivers immediately)
+READY = 100
+
+
+@dataclass
+class _Slot:
+    seq: int
+    phase: int = COMMITTED
+    pre_prepare: Optional[PrePrepare] = None
+    proposal: Optional[Proposal] = None
+    digest: str = ""
+    requests: list = field(default_factory=list)
+    prepares: VoteSet = None  # type: ignore[assignment]
+    commits: VoteSet = None  # type: ignore[assignment]
+    prepare_sent: Optional[Prepare] = None
+    commit_sent: Optional[Commit] = None
+    my_sig: Optional[Signature] = None
+    prepare_voters: list[int] = field(default_factory=list)
+    prepares_taken: int = 0
+    commits_taken: int = 0
+    pending_sigs: list = field(default_factory=list)
+    seen_signers: set = field(default_factory=set)
+    valid_sigs: list = field(default_factory=list)
+    verify_inflight: bool = False
+    verify_failures: int = 0
+    begin: float = 0.0
+
+    def __post_init__(self):
+        self.prepares = VoteSet(lambda _s, m: isinstance(m, Prepare))
+
+        def accept_commit(sender: int, m: Message) -> bool:
+            if not isinstance(m, Commit) or m.signature is None:
+                return False
+            return m.signature.signer == sender  # view.go:160-171
+
+        self.commits = VoteSet(accept_commit)
+
+
+@dataclass(frozen=True)
+class _ProposalInfo:
+    digest: str
+    view: int
+    seq: int
+
+
+class WindowedView:
+    """Drop-in View replacement for ``pipeline_depth >= 2`` (rotation off).
+
+    Same interface the Controller and ViewChanger consume: handle_message /
+    start / abort / stopped / propose / get_metadata / get_leader_id plus
+    the ``phase`` / ``proposal_sequence`` / ``number`` attributes.
+    """
+
+    def __init__(
+        self,
+        *,
+        self_id: int,
+        n: int,
+        nodes_list: list[int],
+        leader_id: int,
+        quorum: int,
+        number: int,
+        decider,
+        failure_detector,
+        synchronizer,
+        logger: Logger,
+        comm,
+        verifier: Verifier,
+        signer: Signer,
+        proposal_sequence: int,
+        decisions_in_view: int,
+        state,
+        retrieve_checkpoint,
+        view_sequences: ViewSequencesHolder,
+        window: int,
+        in_flight=None,
+        metrics_view: Optional[ViewMetrics] = None,
+        in_msg_q_size: int = 200,
+    ):
+        self.self_id = self_id
+        self.n = n
+        self.nodes_list = nodes_list
+        self.leader_id = leader_id
+        self.quorum = quorum
+        self.number = number
+        self.decider = decider
+        self.failure_detector = failure_detector
+        self.synchronizer = synchronizer
+        self.logger = logger
+        self.comm = comm
+        self.verifier = verifier
+        self.signer = signer
+        self.proposal_sequence = proposal_sequence  # lowest undelivered seq
+        self.decisions_in_view = decisions_in_view
+        self.state = state
+        self.retrieve_checkpoint = retrieve_checkpoint
+        self.view_sequences = view_sequences
+        self.window = max(2, int(window))
+        self.in_flight = in_flight
+        self.metrics = metrics_view
+        self.in_msg_q_size = in_msg_q_size
+
+        # reference-anchored bookkeeping for metadata checks: the expected
+        # decisions_in_view of seq s is start_dec + (s - start_seq)
+        self._start_seq = proposal_sequence
+        self._start_dec = decisions_in_view
+
+        #: exposed for the Controller's init-phase logic; tracks the lowest
+        #: undelivered slot (COMMITTED when none)
+        self.phase = COMMITTED
+        self.my_proposal_sig: Optional[Signature] = None  # per-slot; kept for API parity
+
+        self.slots: dict[int, _Slot] = {}
+        self._next_propose_seq = proposal_sequence  # leader only
+        self._prepare_frontier = proposal_sequence - 1  # highest seq whose prepare was sent
+        self._commit_frontier = proposal_sequence - 1  # highest seq whose commit was sent
+        # per-seq history of our own prepare/commit for lagging-replica
+        # assists (the single-slot View keeps exactly seq-1,
+        # view.go:718-756; a window keeps its whole trailing edge)
+        self._sent_history: dict[int, tuple[Optional[Prepare], Optional[Commit]]] = {}
+        self._last_voted_proposal_by_id: dict[int, Commit] = {}
+
+        self._inbox: asyncio.Queue = asyncio.Queue()
+        self._dropped_msgs = 0
+        self._aborted = False
+        self._task: Optional[asyncio.Task] = None
+        self._verify_tasks: set[asyncio.Task] = set()
+        self._restored_broadcasts: list[Message] = []
+
+    # ------------------------------------------------------------------ life
+
+    def start(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(
+            self._run(), name=f"wview-{self.self_id}-{self.number}"
+        )
+
+    def stopped(self) -> bool:
+        return self._aborted
+
+    def _stop(self) -> None:
+        if not self._aborted:
+            self._aborted = True
+            self._inbox.put_nowait(_ABORT_SENTINEL)
+
+    async def abort(self) -> None:
+        """view.go:1000-1010 semantics; see View.abort for the cancellation
+        contract."""
+        self._stop()
+        for t in list(self._verify_tasks):
+            t.cancel()
+        if self._task is not None:
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                cur = asyncio.current_task()
+                if not self._task.done() or (cur is not None and cur.cancelling()):
+                    raise
+
+    def get_leader_id(self) -> int:
+        return self.leader_id
+
+    # ------------------------------------------------------------------ intake
+
+    def handle_message(self, sender: int, msg: Message) -> None:
+        if self._aborted:
+            return
+        if self._inbox.qsize() >= self.in_msg_q_size:
+            self._dropped_msgs += 1
+            if self._dropped_msgs == 1 or self._dropped_msgs % 1000 == 0:
+                self.logger.warnf(
+                    "WindowedView %d inbox full (%d), dropped %d messages from %d",
+                    self.number, self.in_msg_q_size, self._dropped_msgs, sender,
+                )
+            return
+        self._inbox.put_nowait((sender, msg))
+
+    # ------------------------------------------------------------------ leader
+
+    def can_accept_more_proposals(self) -> bool:
+        """Leader: may another proposal enter the window right now?"""
+        return (
+            not self._aborted
+            and self._next_propose_seq < self.proposal_sequence + self.window
+        )
+
+    def get_metadata(self) -> bytes:
+        """Metadata for the NEXT unproposed sequence (view.go:896-948; the
+        rotation-off path has an empty blacklist and no prev-commit digest,
+        so no blacklist recomputation happens here)."""
+        return encode(
+            ViewMetadata(
+                view_id=self.number,
+                latest_sequence=self._next_propose_seq,
+                decisions_in_view=self._start_dec
+                + (self._next_propose_seq - self._start_seq),
+            )
+        )
+
+    def propose(self, proposal: Proposal) -> None:
+        """Leader: wrap as pre-prepare for the next window sequence and
+        self-deliver first (WAL-first, view.go:951-977).  The broadcast to
+        peers happens after the slot persists the ProposedRecord."""
+        pp = PrePrepare(
+            view=self.number,
+            seq=self._next_propose_seq,
+            proposal=proposal,
+            prev_commit_signatures=[],
+        )
+        self._next_propose_seq += 1
+        # bypass the inbox bound: the window (can_accept_more_proposals) is
+        # the flow control for our own proposals — a drop here would consume
+        # the sequence number without ever proposing it, wedging the cluster
+        if not self._aborted:
+            self._inbox.put_nowait((self.leader_id, pp))
+        self.logger.debugf(
+            "Proposing sequence %d in view %d (window %d..%d)",
+            pp.seq, self.number, self.proposal_sequence, self._next_propose_seq - 1,
+        )
+
+    # ------------------------------------------------------------------ loop
+
+    async def _run(self) -> None:
+        try:
+            for m in self._restored_broadcasts:
+                self.comm.broadcast_consensus(m)
+            self._restored_broadcasts = []
+            while True:
+                progressed = await self._advance()
+                if self._aborted:
+                    raise ViewAborted()
+                if progressed:
+                    continue
+                await self._next_event()
+                self._drain_inbox()
+        except ViewAborted:
+            pass
+        except Exception as e:  # pragma: no cover - defensive
+            self.logger.errorf("WindowedView %d crashed: %r", self.number, e)
+            raise
+        finally:
+            for t in list(self._verify_tasks):
+                t.cancel()
+            self.view_sequences.store(
+                ViewSequence(view_active=False, proposal_seq=self.proposal_sequence)
+            )
+
+    async def _next_event(self) -> None:
+        item = await self._inbox.get()
+        self._handle_item(item)
+
+    def _drain_inbox(self) -> None:
+        while True:
+            try:
+                item = self._inbox.get_nowait()
+            except asyncio.QueueEmpty:
+                return
+            self._handle_item(item)
+
+    def _handle_item(self, item) -> None:
+        if item is _ABORT_SENTINEL or self._aborted:
+            raise ViewAborted()
+        if isinstance(item, tuple) and len(item) == 4 and item[0] == "verified":
+            _, seq, sigs, results = item
+            self._absorb_verify_results(seq, sigs, results)
+            return
+        sender, msg = item
+        self._process_msg(sender, msg)
+
+    # ------------------------------------------------------------------ routing
+
+    def _process_msg(self, sender: int, m: Message) -> None:
+        """view.go:194-261 adapted to a window of sequences."""
+        if self._aborted:
+            return
+        msg_view = view_number_of_msg(m)
+        msg_seq = proposal_sequence_of_msg(m)
+
+        if msg_view != self.number:
+            if sender != self.leader_id:
+                self._discover_if_sync_needed(sender, m)
+                return
+            self.failure_detector.complain(self.number, False)
+            if msg_view > self.number:
+                self.synchronizer.sync()
+            self._stop()
+            return
+
+        low = self.proposal_sequence
+        if msg_seq < low:
+            self._handle_prev_seq_message(msg_seq, sender, m)
+            return
+        if msg_seq >= low + 2 * self.window:
+            self.logger.warnf(
+                "%d got message from %d with sequence %d outside window [%d, %d)",
+                self.self_id, sender, msg_seq, low, low + 2 * self.window,
+            )
+            self._discover_if_sync_needed(sender, m)
+            return
+
+        slot = self.slots.get(msg_seq)
+        if slot is None:
+            slot = self.slots[msg_seq] = _Slot(seq=msg_seq)
+
+        if isinstance(m, PrePrepare):
+            if m.proposal is None:
+                self.logger.warnf(
+                    "%d got pre-prepare from %d with empty proposal", self.self_id, sender
+                )
+                return
+            if sender != self.leader_id:
+                self.logger.warnf(
+                    "%d got pre-prepare from %d but the leader is %d",
+                    self.self_id, sender, self.leader_id,
+                )
+                return
+            if slot.pre_prepare is None and slot.phase == COMMITTED:
+                slot.pre_prepare = m
+            return
+
+        if sender == self.self_id:
+            return  # own votes are implicit (view.go:238-241)
+
+        if isinstance(m, Prepare):
+            slot.prepares.register_vote(sender, m)
+            # in-window assist (the windowed analogue of view.go:718-756):
+            # each broadcast is one-shot here, so a peer still collecting
+            # prepares at a sequence we have already COMMITTED on likely
+            # lost ours — resend it directly.  Gating on our phase being
+            # ahead keeps steady-state traffic clean: in lockstep operation
+            # prepares arrive while we are still in PROPOSED ourselves.
+            if (
+                not m.assist
+                and slot.phase in (PREPARED, READY)
+                and slot.prepare_sent is not None
+            ):
+                self.comm.send_consensus(sender, slot.prepare_sent)
+        elif isinstance(m, Commit):
+            slot.commits.register_vote(sender, m)
+            if (
+                not m.assist
+                and slot.phase == READY
+                and slot.commit_sent is not None
+            ):
+                self.comm.send_consensus(sender, slot.commit_sent)
+
+    # ------------------------------------------------------------------ advance
+
+    async def _advance(self) -> bool:
+        """Run every enabled state transition once; True if any fired.
+
+        Transitions are attempted lowest-sequence-first so the in-order
+        invariants (prepare-send, commit-send, delivery) fall out of the
+        iteration order plus the frontier guards."""
+        progressed = False
+        # snapshot: _process_prepares drains the inbox mid-iteration, which
+        # may create new slots
+        for seq in sorted(self.slots):
+            slot = self.slots.get(seq)
+            if slot is None:
+                continue
+            if (
+                slot.phase == COMMITTED
+                and slot.pre_prepare is not None
+                and seq == self._prepare_frontier + 1
+            ):
+                await self._process_proposal(slot)
+                progressed = True
+            if (
+                slot.phase == PROPOSED
+                and seq == self._commit_frontier + 1
+                and self._count_prepares(slot) >= self.quorum - 1
+            ):
+                await self._process_prepares(slot)
+                progressed = True
+            if slot.phase == PREPARED:
+                self._maybe_flush_verify(slot)
+        low = self.slots.get(self.proposal_sequence)
+        if low is not None and low.phase == READY:
+            await self._deliver(low)
+            progressed = True
+        self.phase = self._lowest_phase()
+        if self.metrics:
+            self.metrics.phase.set(self.phase)
+        return progressed
+
+    def _lowest_phase(self) -> int:
+        if self._aborted:
+            return ABORT
+        low = self.slots.get(self.proposal_sequence)
+        if low is None:
+            return COMMITTED
+        return low.phase if low.phase != READY else PREPARED
+
+    # -- phase 1: proposal --------------------------------------------------
+
+    async def _process_proposal(self, slot: _Slot) -> None:
+        """COMMITTED -> PROPOSED for one slot (view.go:351-427)."""
+        pp = slot.pre_prepare
+        proposal = pp.proposal
+        try:
+            requests = self._verify_proposal(slot, pp)
+        except Exception as e:
+            self.logger.warnf(
+                "%d received bad proposal from %d at seq %d: %s",
+                self.self_id, self.leader_id, slot.seq, e,
+            )
+            self.failure_detector.complain(self.number, False)
+            self.synchronizer.sync()
+            self._stop()
+            raise ViewAborted() from e
+
+        slot.proposal = proposal
+        slot.digest = proposal_digest(proposal)
+        slot.requests = requests
+        slot.begin = time.monotonic()
+        if self.metrics:
+            self.metrics.count_txs_in_batch.set(len(requests))
+
+        prepare = Prepare(view=self.number, seq=slot.seq, digest=slot.digest)
+        # WAL-first: persist before any dependent send.  Truncation is safe
+        # only when this slot is the whole window (all prior seqs
+        # delivered) — mid-window the previous decisions' records must
+        # survive a crash for restore to rebuild the ladder.
+        truncate = slot.seq == self.proposal_sequence
+        await self._save_state(ProposedRecord(pre_prepare=pp, prepare=prepare), truncate)
+        if self.in_flight is not None:
+            self.in_flight.store_proposal_at(slot.seq, proposal)
+        slot.prepare_sent = replace(prepare, assist=True)
+        slot.phase = PROPOSED
+        self._prepare_frontier = slot.seq
+        self._sent_history[slot.seq] = (slot.prepare_sent, None)
+        if self.self_id == self.leader_id:
+            self.comm.broadcast_consensus(pp)
+        self.comm.broadcast_consensus(prepare)
+        self.logger.infof("Processed proposal with seq %d", slot.seq)
+
+    def _verify_proposal(self, slot: _Slot, pp: PrePrepare) -> list:
+        """view.go:553-607 for the rotation-off pipelined mode."""
+        proposal = pp.proposal
+        requests = self.verifier.verify_proposal(proposal)
+        md = decode(ViewMetadata, proposal.metadata)
+        if md.view_id != self.number:
+            raise ValueError(f"invalid view number: expected {self.number} got {md.view_id}")
+        if md.latest_sequence != slot.seq:
+            raise ValueError(
+                f"invalid proposal sequence: expected {slot.seq} got {md.latest_sequence}"
+            )
+        expected_dec = self._start_dec + (slot.seq - self._start_seq)
+        if md.decisions_in_view != expected_dec:
+            raise ValueError(
+                f"invalid decisions in view: expected {expected_dec} got {md.decisions_in_view}"
+            )
+        expected_seq = self.verifier.verification_sequence()
+        if proposal.verification_sequence != expected_seq:
+            raise ValueError(
+                f"verification sequence mismatch: expected {expected_seq} "
+                f"got {proposal.verification_sequence}"
+            )
+        # rotation-off invariants (config.validate pins decisions_per_leader
+        # to 0 when pipelining): no blacklist, no prev-commit chaining
+        if list(md.black_list):
+            raise ValueError(
+                f"rotation is inactive but blacklist is not empty: {list(md.black_list)}"
+            )
+        if pp.prev_commit_signatures:
+            raise ValueError(
+                "pipelined mode forbids prev commit signatures in pre-prepares"
+            )
+        return requests
+
+    # -- phase 2: prepares --------------------------------------------------
+
+    def _count_prepares(self, slot: _Slot) -> int:
+        while slot.prepares_taken < len(slot.prepares.votes):
+            vote = slot.prepares.votes[slot.prepares_taken]
+            slot.prepares_taken += 1
+            if vote.msg.digest != slot.digest:
+                self.logger.warnf(
+                    "Got wrong digest at processPrepares for prepare with seq %d",
+                    vote.msg.seq,
+                )
+                continue
+            slot.prepare_voters.append(vote.sender)
+        return len(slot.prepare_voters)
+
+    async def _process_prepares(self, slot: _Slot) -> None:
+        """PROPOSED -> PREPARED for one slot (view.go:441-517)."""
+        # sweep any queued prepares into the witness list before signing
+        # (PreparesFrom is liveness evidence; see View._process_prepares)
+        self._drain_inbox()
+        self._count_prepares(slot)
+        prp_from = encode(PreparesFrom(ids=slot.prepare_voters))
+        sig = self.signer.sign_proposal(slot.proposal, prp_from)
+        slot.my_sig = sig
+        commit = Commit(
+            view=self.number,
+            seq=slot.seq,
+            digest=slot.digest,
+            signature=Signature(signer=sig.signer, value=sig.value, msg=sig.msg),
+        )
+        await self._save_state(CommitRecord(commit=commit), truncate=False)
+        if self.in_flight is not None:
+            self.in_flight.store_prepares_at(slot.seq)
+        slot.commit_sent = replace(commit, assist=True)
+        slot.phase = PREPARED
+        self._commit_frontier = slot.seq
+        prev_p, _ = self._sent_history.get(slot.seq, (None, None))
+        self._sent_history[slot.seq] = (prev_p, slot.commit_sent)
+        self.comm.broadcast_consensus(commit)
+        self.logger.infof("Processed prepares for proposal with seq %d", slot.seq)
+
+    # -- phase 3: commits (concurrent verification) -------------------------
+
+    def _maybe_flush_verify(self, slot: _Slot) -> None:
+        """Quorum-feasibility flush (View._process_commits policy), but as
+        an independent task per slot: k slots' waves sit in the coalescer
+        concurrently and merge into one device launch."""
+        if slot.phase != PREPARED:
+            return
+        # drain newly registered votes into the slot's pending pool
+        while slot.commits_taken < len(slot.commits.votes):
+            vote = slot.commits.votes[slot.commits_taken]
+            slot.commits_taken += 1
+            commit: Commit = vote.msg
+            if commit.digest != slot.digest:
+                self.logger.warnf("Got wrong digest at processCommits for seq %d", commit.seq)
+                continue
+            if commit.signature.signer in slot.seen_signers:
+                continue
+            slot.pending_sigs.append(commit.signature)
+        if slot.verify_inflight or not slot.pending_sigs:
+            return
+        # quorum-feasibility flush policy (View._process_commits): launch
+        # only when the batch could complete the quorum
+        if len(slot.valid_sigs) + len(slot.pending_sigs) < self.quorum - 1:
+            return
+        pending, slot.pending_sigs = slot.pending_sigs, []
+        slot.verify_inflight = True
+        proposal = slot.proposal
+        seq = slot.seq
+
+        async def run():
+            try:
+                results = await verify_sigs_batch(
+                    self.verifier, pending, proposal, self.logger
+                )
+            except Exception as e:
+                results = e
+            if not self._aborted:
+                self._inbox.put_nowait(("verified", seq, pending, results))
+
+        t = asyncio.get_running_loop().create_task(
+            run(), name=f"wview-verify-{self.self_id}-{seq}"
+        )
+        self._verify_tasks.add(t)
+        t.add_done_callback(self._verify_tasks.discard)
+
+    def _absorb_verify_results(self, seq: int, sigs, results) -> None:
+        slot = self.slots.get(seq)
+        if slot is None:
+            return
+        slot.verify_inflight = False
+        if isinstance(results, Exception):
+            slot.verify_failures += 1
+            self.logger.warnf(
+                "Batched commit verification failed for seq %d (attempt %d): %r",
+                seq, slot.verify_failures, results,
+            )
+            if slot.verify_failures >= 3:
+                # a persistently failing engine must not spin retries
+                # forever; escalate the way a bad proposal does (the
+                # single-slot View lets the exception kill the view task)
+                self.logger.errorf(
+                    "Verification engine failing persistently at seq %d; "
+                    "aborting view and syncing", seq,
+                )
+                self._stop()
+                self.synchronizer.sync()
+                return
+            # the engine call failed (not the signatures): re-pool the
+            # candidates for a retry on the next flush attempt
+            slot.pending_sigs.extend(
+                s for s in sigs if s.signer not in slot.seen_signers
+            )
+            return
+        slot.verify_failures = 0
+        for sig, aux in zip(sigs, results):
+            if aux is None:
+                self.logger.warnf("Couldn't verify %d's signature", sig.signer)
+                continue
+            if sig.signer in slot.seen_signers:
+                continue
+            # cap at exactly quorum-1 (certificate-size determinism; see
+            # View._process_commits)
+            if len(slot.valid_sigs) >= self.quorum - 1:
+                break
+            slot.seen_signers.add(sig.signer)
+            slot.valid_sigs.append(sig)
+        if slot.valid_sigs and len(slot.valid_sigs) >= self.quorum - 1 and slot.phase == PREPARED:
+            slot.phase = READY
+            self.logger.infof(
+                "%d collected %d commits for seq %d from %s",
+                self.self_id, len(slot.valid_sigs), seq,
+                sorted(s.signer for s in slot.valid_sigs),
+            )
+
+    # -- delivery -----------------------------------------------------------
+
+    async def _deliver(self, slot: _Slot) -> None:
+        """In-order decide rendezvous with the Controller (view.go:851-858)."""
+        self.logger.infof("Deciding on seq %d", slot.seq)
+        if self.metrics:
+            self.metrics.count_batch_all.add(1)
+            self.metrics.count_txs_all.add(len(slot.requests))
+            self.metrics.latency_batch_processing.observe(time.monotonic() - slot.begin)
+        signatures = list(slot.valid_sigs) + [slot.my_sig]
+        self.my_proposal_sig = slot.my_sig
+        del self.slots[slot.seq]
+        self.proposal_sequence = slot.seq + 1
+        self.decisions_in_view += 1
+        if self.metrics:
+            self.metrics.proposal_sequence.set(self.proposal_sequence)
+            self.metrics.decisions_in_view.set(self.decisions_in_view)
+        self.view_sequences.store(
+            ViewSequence(view_active=True, proposal_seq=self.proposal_sequence)
+        )
+        if self.in_flight is not None:
+            self.in_flight.clear_below(self.proposal_sequence)
+        # prune assist history beyond the window's trailing edge: a correct
+        # replica can lag by up to the window depth, so keep a full window
+        # of delivered sequences servable
+        floor = slot.seq - self.window
+        for s in [s for s in self._sent_history if s < floor]:
+            del self._sent_history[s]
+        await self.decider.decide(slot.proposal, signatures, slot.requests)
+        if self._aborted:
+            raise ViewAborted()
+
+    # ------------------------------------------------------------------ misc
+
+    async def _save_state(self, msg, truncate: bool) -> None:
+        save_durable = getattr(self.state, "save_durable", None)
+        if save_durable is not None:
+            await save_durable(msg, truncate=truncate)
+        else:
+            self.state.save(msg, truncate=truncate)
+        if self._aborted:
+            raise ViewAborted()
+
+    def _handle_prev_seq_message(self, msg_seq: int, sender: int, m: Message) -> None:
+        """Lagging-replica assists over the window's trailing edge
+        (view.go:718-756)."""
+        if isinstance(m, PrePrepare):
+            return
+        hist = self._sent_history.get(msg_seq)
+        if hist is None:
+            return
+        prev_prepare, prev_commit = hist
+        if isinstance(m, Prepare) and not m.assist and prev_prepare is not None:
+            self.comm.send_consensus(sender, prev_prepare)
+        elif isinstance(m, Commit) and not m.assist and prev_commit is not None:
+            self.comm.send_consensus(sender, prev_commit)
+
+    def _discover_if_sync_needed(self, sender: int, m: Message) -> None:
+        """f+1 matching future commit votes trigger a sync (view.go:758-818)."""
+        if not isinstance(m, Commit):
+            return
+        _, f = compute_quorum(self.n)
+        threshold = f + 1
+        self._last_voted_proposal_by_id[sender] = m
+        if len(self._last_voted_proposal_by_id) < threshold:
+            return
+        counts: dict[_ProposalInfo, int] = {}
+        for vote in self._last_voted_proposal_by_id.values():
+            info = _ProposalInfo(digest=vote.digest, view=vote.view, seq=vote.seq)
+            counts[info] = counts.get(info, 0) + 1
+        for info, count in counts.items():
+            if count < threshold:
+                continue
+            if info.view < self.number:
+                continue
+            if info.seq < self.proposal_sequence + 2 * self.window and info.view == self.number:
+                continue
+            self.logger.warnf(
+                "Seen %d votes for digest %s in view %d, sequence %d but I am in view %d and seq %d",
+                count, info.digest, info.view, info.seq, self.number, self.proposal_sequence,
+            )
+            self._stop()
+            self.synchronizer.sync()
+            return
+
+    # ------------------------------------------------------------------ restore
+
+    def restore_window(self, records: list) -> None:
+        """Rebuild the window from the WAL suffix after a crash.
+
+        ``records`` are the parsed SavedMessages in append order.  The
+        in-order save invariants make the suffix unambiguous: ProposedRecord
+        seqs ascend, CommitRecord seqs ascend, and C(s) always follows P(s).
+        Slots below ``proposal_sequence`` (the delivered frontier per the
+        checkpoint) are skipped; restored slots re-enter PROPOSED/PREPARED
+        and their prepare/commit are re-broadcast on start
+        (state.go:155-247 generalized)."""
+        low = self.proposal_sequence
+        # Adopt the HIGHEST view present in the records, mirroring the
+        # single-slot recovery (state.py _recover_proposed sets
+        # view.number = pp.view): a view change's NewViewRecord may have
+        # been truncated away by the new view's first proposal, leaving the
+        # constructed view number one behind the records.  Filtering those
+        # records out instead would forget broadcast commits — a fork risk
+        # (the node's ViewData would under-report its in-flight ladder).
+        record_views = [
+            rec.pre_prepare.view
+            for rec in records
+            if isinstance(rec, ProposedRecord) and rec.pre_prepare is not None
+        ]
+        if record_views and max(record_views) > self.number:
+            self.logger.infof(
+                "WAL records are from view %d, adopting it (constructed with %d)",
+                max(record_views), self.number,
+            )
+            self.number = max(record_views)
+        by_seq: dict[int, dict] = {}
+        for rec in records:
+            if isinstance(rec, ProposedRecord) and rec.pre_prepare is not None:
+                if rec.pre_prepare.view != self.number:
+                    continue  # superseded by a later view's records
+                by_seq.setdefault(rec.pre_prepare.seq, {})["P"] = rec
+            elif isinstance(rec, CommitRecord) and rec.commit is not None:
+                if rec.commit.view != self.number:
+                    continue
+                entry = by_seq.get(rec.commit.seq)
+                if entry is None:
+                    raise ValueError(
+                        f"WAL holds a commit for seq {rec.commit.seq} without "
+                        "a matching pre-prepare"
+                    )
+                entry["C"] = rec
+        restored = 0
+        for seq in sorted(by_seq):
+            if seq < low:
+                continue
+            if seq != self._prepare_frontier + 1:
+                break  # a gap: later records belong to an older window shape
+            entry = by_seq[seq]
+            pp: PrePrepare = entry["P"].pre_prepare
+            slot = self.slots[seq] = _Slot(seq=seq)
+            slot.pre_prepare = pp
+            slot.proposal = pp.proposal
+            slot.digest = proposal_digest(pp.proposal)
+            slot.begin = time.monotonic()
+            slot.prepare_sent = replace(entry["P"].prepare, assist=True)
+            slot.phase = PROPOSED
+            self._prepare_frontier = seq
+            self._sent_history[seq] = (slot.prepare_sent, None)
+            self._restored_broadcasts.append(entry["P"].prepare)
+            if self.in_flight is not None:
+                self.in_flight.store_proposal_at(seq, pp.proposal)
+            crec = entry.get("C")
+            if crec is not None and seq == self._commit_frontier + 1:
+                commit: Commit = crec.commit
+                sig = commit.signature
+                slot.my_sig = Signature(signer=sig.signer, value=sig.value, msg=sig.msg)
+                slot.commit_sent = replace(commit, assist=True)
+                slot.phase = PREPARED
+                self._commit_frontier = seq
+                self._sent_history[seq] = (slot.prepare_sent, slot.commit_sent)
+                self._restored_broadcasts.append(commit)
+                if self.in_flight is not None:
+                    self.in_flight.store_prepares_at(seq)
+            restored += 1
+        self._next_propose_seq = max(self._next_propose_seq, self._prepare_frontier + 1)
+        self.phase = self._lowest_phase()
+        if restored:
+            self.logger.infof(
+                "Restored %d pipelined slot(s), window %d..%d",
+                restored, low, self._prepare_frontier,
+            )

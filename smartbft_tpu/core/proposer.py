@@ -8,6 +8,7 @@ from typing import Optional
 from ..api import Logger, MembershipNotifier, Signer, Verifier
 from ..metrics import BlacklistMetrics, ViewMetrics
 from ..types import Checkpoint
+from .pipeline import WindowedView
 from .view import View, ViewSequence, ViewSequencesHolder
 
 
@@ -33,6 +34,7 @@ class ProposalMaker:
         checkpoint: Checkpoint,
         metrics_view: Optional[ViewMetrics] = None,
         metrics_blacklist: Optional[BlacklistMetrics] = None,
+        pipeline_depth: int = 1,
     ):
         self.decisions_per_leader = decisions_per_leader
         self.n = n
@@ -52,6 +54,7 @@ class ProposalMaker:
         self.checkpoint = checkpoint
         self.metrics_view = metrics_view
         self.metrics_blacklist = metrics_blacklist
+        self.pipeline_depth = pipeline_depth
         self._restored_from_wal = False
 
     def new_proposer(
@@ -63,6 +66,10 @@ class ProposalMaker:
         quorum_size: int,
     ) -> tuple[View, int]:
         """util.go:273-329 — returns (view, initial_phase)."""
+        if self.pipeline_depth > 1:
+            return self._new_windowed_proposer(
+                leader, proposal_sequence, view_num, decisions_in_view, quorum_size
+            )
         view = View(
             retrieve_checkpoint=self.checkpoint.get,
             decisions_per_leader=self.decisions_per_leader,
@@ -88,22 +95,67 @@ class ProposalMaker:
             metrics_view=self.metrics_view,
             metrics_blacklist=self.metrics_blacklist,
         )
-        view.view_sequences.store(
-            ViewSequence(view_active=True, proposal_seq=proposal_sequence)
-        )
-        if not self._restored_from_wal:
-            self._restored_from_wal = True
-            self.state.restore(view)
+        self._restore_once_and_publish(view, proposal_sequence)
         if proposal_sequence > view.proposal_sequence:
             view.proposal_sequence = proposal_sequence
             view.decisions_in_view = decisions_in_view
         if view_num > view.number:
             view.number = view_num
             view.decisions_in_view = decisions_in_view
+        self._publish_metrics(view)
+        return view, view.phase
+
+    def _restore_once_and_publish(self, view, proposal_sequence: int) -> None:
+        view.view_sequences.store(
+            ViewSequence(view_active=True, proposal_seq=proposal_sequence)
+        )
+        if not self._restored_from_wal:
+            self._restored_from_wal = True
+            self.state.restore(view)
+
+    def _publish_metrics(self, view) -> None:
         if self.metrics_view:
             self.metrics_view.view_number.set(view.number)
             self.metrics_view.leader_id.set(view.leader_id)
             self.metrics_view.proposal_sequence.set(view.proposal_sequence)
             self.metrics_view.decisions_in_view.set(view.decisions_in_view)
             self.metrics_view.phase.set(view.phase)
+
+    def _new_windowed_proposer(
+        self,
+        leader: int,
+        proposal_sequence: int,
+        view_num: int,
+        decisions_in_view: int,
+        quorum_size: int,
+    ) -> tuple[WindowedView, int]:
+        """Pipelined mode: build a WindowedView (pipeline_depth sequences in
+        flight).  The same restore-exactly-once contract as the single-slot
+        path (util.go:305-311)."""
+        view = WindowedView(
+            retrieve_checkpoint=self.checkpoint.get,
+            n=self.n,
+            nodes_list=self.nodes_list,
+            leader_id=leader,
+            self_id=self.self_id,
+            quorum=quorum_size,
+            number=view_num,
+            decider=self.decider,
+            failure_detector=self.failure_detector,
+            synchronizer=self.synchronizer,
+            logger=self.logger,
+            comm=self.comm,
+            verifier=self.verifier,
+            signer=self.signer,
+            proposal_sequence=proposal_sequence,
+            decisions_in_view=decisions_in_view,
+            state=self.state,
+            in_msg_q_size=self.in_msg_q_size,
+            view_sequences=self.view_sequences,
+            window=self.pipeline_depth,
+            in_flight=getattr(self.state, "in_flight", None),
+            metrics_view=self.metrics_view,
+        )
+        self._restore_once_and_publish(view, proposal_sequence)
+        self._publish_metrics(view)
         return view, view.phase

@@ -44,10 +44,10 @@ class StateRecorder:
     def __init__(self) -> None:
         self.saved_messages: list = []
 
-    def save(self, msg) -> None:
+    def save(self, msg, truncate: Optional[bool] = None) -> None:
         self.saved_messages.append(msg)
 
-    async def save_durable(self, msg) -> None:
+    async def save_durable(self, msg, truncate: Optional[bool] = None) -> None:
         self.save(msg)
 
     def restore(self, view) -> None:
@@ -77,27 +77,33 @@ class PersistedState:
         self.wal = wal
         self.group_commit = group_commit
 
-    def save(self, msg) -> None:
-        """Append a SavedMessage; only ProposedRecord truncates
+    def save(self, msg, truncate: Optional[bool] = None) -> None:
+        """Append a SavedMessage; by default only ProposedRecord truncates
         (state.go:38-59): a new proposal implies the previous decision is a
-        stable checkpoint."""
+        stable checkpoint.  The pipelined window overrides ``truncate`` —
+        a ProposedRecord for seq s+k lands while s is still undelivered, so
+        there truncation is only safe when the window is otherwise empty."""
         data = self._record_and_marshal(msg)
-        self.wal.append(data, truncate_to=isinstance(msg, ProposedRecord))
+        if truncate is None:
+            truncate = isinstance(msg, ProposedRecord)
+        self.wal.append(data, truncate_to=truncate)
 
-    async def save_durable(self, msg) -> None:
+    async def save_durable(self, msg, truncate: Optional[bool] = None) -> None:
         """Like :meth:`save`, but rides the WAL's group-commit path when it
         has one: the append happens immediately, the fsync lands in a wave
         shared with every other WAL on the loop, and this coroutine resumes
         once the record is durable.  Callers hold their dependent broadcast
         until then — the same WAL-first ordering the sync path gives."""
         data = self._record_and_marshal(msg)
+        if truncate is None:
+            truncate = isinstance(msg, ProposedRecord)
         append_async = (
             getattr(self.wal, "append_async", None) if self.group_commit else None
         )
         if append_async is None:
-            self.wal.append(data, truncate_to=isinstance(msg, ProposedRecord))
+            self.wal.append(data, truncate_to=truncate)
             return
-        await append_async(data, truncate_to=isinstance(msg, ProposedRecord))
+        await append_async(data, truncate_to=truncate)
 
     def _record_and_marshal(self, msg) -> bytes:
         if isinstance(msg, ProposedRecord):
@@ -141,12 +147,24 @@ class PersistedState:
 
     def restore(self, view) -> None:
         """Rebuild View runtime state from the last WAL entries
-        (state.go:115-247)."""
+        (state.go:115-247).  A WindowedView (pipeline_depth > 1) restores
+        its whole slot ladder from the suffix instead of just the tail."""
         view.phase = COMMITTED
         if not self.entries:
             self.logger.infof("Nothing to restore")
             return
         self.logger.infof("WAL contains %d entries", len(self.entries))
+        restore_window = getattr(view, "restore_window", None)
+        if restore_window is not None:
+            records = []
+            for raw in self.entries:
+                try:
+                    records.append(unmarshal(raw))
+                except Exception as e:
+                    self.logger.errorf("Failed unmarshaling WAL entry: %s", e)
+                    raise
+            restore_window(records)
+            return
         last = self._last_entry()
         if isinstance(last, ProposedRecord):
             self._recover_proposed(last, view)

@@ -106,16 +106,28 @@ class NextViews:
 
 class InFlightData:
     """The proposal currently being agreed on + its prepared flag
-    (util.go:184-247).  Read by the ViewChanger when building ViewData."""
+    (util.go:184-247).  Read by the ViewChanger when building ViewData.
+
+    Pipelined-window extension (pipeline_depth > 1): a seq-keyed WINDOW of
+    in-flight proposals.  When the window is non-empty the single-slot
+    accessors report the LOWEST rung, so every single-slot consumer (the
+    ViewChanger's rung-0 ViewData field, the controller's stale-in-flight
+    pruning) keeps working; :meth:`ladder` exposes the full ordered window
+    for the multi-in-flight view change."""
 
     def __init__(self) -> None:
         self._proposal = None
         self._prepared = False
+        self._window: dict[int, list] = {}  # seq -> [proposal, prepared]
 
     def in_flight_proposal(self):
+        if self._window:
+            return self._window[min(self._window)][0]
         return self._proposal
 
     def is_in_flight_prepared(self) -> bool:
+        if self._window:
+            return self._window[min(self._window)][1]
         return self._prepared
 
     def store_proposal(self, proposal) -> None:
@@ -124,12 +136,52 @@ class InFlightData:
 
     def store_prepares(self, view: int, seq: int) -> None:
         if self._proposal is None:
+            if self._window:
+                # pipelined mode after a crash restore: the WindowedView
+                # tracks prepared-ness per rung via store_prepares_at; the
+                # legacy singular slot may legitimately be empty here
+                return
             raise RuntimeError("stored prepares but proposal is not initialized")
         self._prepared = True
 
     def clear(self) -> None:
         self._proposal = None
         self._prepared = False
+        self._window.clear()
+
+    # -- windowed API (pipeline_depth > 1) ---------------------------------
+
+    def store_proposal_at(self, seq: int, proposal) -> None:
+        self._window[seq] = [proposal, False]
+
+    def store_prepares_at(self, seq: int) -> None:
+        slot = self._window.get(seq)
+        if slot is None:
+            raise RuntimeError(
+                f"stored prepares at seq {seq} but its proposal is not initialized"
+            )
+        slot[1] = True
+
+    def clear_below(self, seq: int) -> None:
+        """Drop window rungs for delivered sequences (< ``seq``)."""
+        for s in [s for s in self._window if s < seq]:
+            del self._window[s]
+
+    def prune_synced(self, synced_seq: int) -> None:
+        """A sync advanced the checkpoint to ``synced_seq``: drop what it
+        covers.  Windowed mode keeps rungs ABOVE the synced sequence — they
+        are still genuinely in flight and must stay reportable in ViewData
+        (the ladder's quorum-intersection argument needs every broadcast
+        commit remembered); single-slot mode clears the lone proposal,
+        matching the reference (controller.go:682-705)."""
+        if self._window:
+            self.clear_below(synced_seq + 1)
+        else:
+            self.clear()
+
+    def ladder(self) -> list[tuple[int, object, bool]]:
+        """Ordered (seq, proposal, prepared) rungs of the window."""
+        return [(s, *self._window[s]) for s in sorted(self._window)]
 
 
 def compute_blacklist_update(

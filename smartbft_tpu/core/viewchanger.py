@@ -68,6 +68,28 @@ def validate_in_flight(in_flight_proposal: Optional[Proposal], last_sequence: in
         )
 
 
+def validate_in_flight_ladder(vd: ViewData, last_sequence: int) -> None:
+    """Ladder extension of :func:`validate_in_flight` (pipelined window):
+    rung 0 sits at last_sequence+1 and every ``in_flight_more[i]`` must be
+    the consecutive rung above it.  Raises if invalid."""
+    validate_in_flight(vd.in_flight_proposal, last_sequence)
+    if not vd.in_flight_more:
+        return
+    if vd.in_flight_proposal is None:
+        raise ValueError("in flight ladder extension without a first rung")
+    if len(vd.in_flight_more_prepared) != len(vd.in_flight_more):
+        raise ValueError("in flight ladder prepared flags do not match rung count")
+    for i, prop in enumerate(vd.in_flight_more):
+        if not prop.metadata:
+            raise ValueError("in flight proposal metadata is nil")
+        md = decode(ViewMetadata, prop.metadata)
+        if md.latest_sequence != last_sequence + 2 + i:
+            raise ValueError(
+                f"in flight ladder rung {i + 1} has sequence {md.latest_sequence}, "
+                f"expected {last_sequence + 2 + i}"
+            )
+
+
 async def validate_last_decision(
     vd: ViewData, quorum: int, n: int, verifier: Verifier
 ) -> int:
@@ -115,6 +137,62 @@ def max_last_decision_sequence(messages: list[ViewData]) -> int:
     return mx
 
 
+def _in_flight_rungs(vd: ViewData) -> dict[int, tuple[Proposal, bool]]:
+    """seq -> (proposal, prepared) for every in-flight rung a ViewData
+    carries: the reference-shaped singular field plus the pipelined-window
+    extension (``in_flight_more``).  Raises on nil-metadata rungs, like the
+    reference's check (viewchanger.go:837-841)."""
+    rungs: dict[int, tuple[Proposal, bool]] = {}
+    if vd.in_flight_proposal is not None:
+        if not vd.in_flight_proposal.metadata:
+            raise ValueError("view data message has in flight proposal with nil metadata")
+        md = decode(ViewMetadata, vd.in_flight_proposal.metadata)
+        rungs[md.latest_sequence] = (vd.in_flight_proposal, vd.in_flight_prepared)
+    for i, prop in enumerate(vd.in_flight_more):
+        if not prop.metadata:
+            raise ValueError("view data message has in flight proposal with nil metadata")
+        md = decode(ViewMetadata, prop.metadata)
+        prepared = (
+            vd.in_flight_more_prepared[i] if i < len(vd.in_flight_more_prepared) else False
+        )
+        rungs[md.latest_sequence] = (prop, prepared)
+    return rungs
+
+
+def _check_rung(
+    entries: list[Optional[tuple[Proposal, bool]]], f: int, quorum: int
+) -> tuple[Optional[Proposal], int]:
+    """One rung of the agreed-in-flight decision rule: the A/B counters of
+    viewchanger.go:813-908 over per-ViewData entries at ONE sequence.
+
+    ``entries[i]`` is (proposal, prepared) if ViewData i carries an
+    in-flight rung at the sequence under examination, else None (covers
+    no-in-flight, wrong-sequence, and absent rungs — all of which the
+    reference counts identically).  Returns (chosen_proposal_or_None,
+    no_in_flight_count)."""
+    possible: list[dict] = []
+    no_in_flight_count = 0
+    for e in entries:
+        if e is None or not e[1]:
+            no_in_flight_count += 1
+        if e is not None and e[1] and not any(p["proposal"] == e[0] for p in possible):
+            possible.append({"proposal": e[0], "preprepared": 0, "no_argument": 0})
+    for e in entries:
+        for p in possible:
+            if e is None:
+                p["no_argument"] += 1
+            elif e[0] == p["proposal"]:
+                p["no_argument"] += 1
+                p["preprepared"] += 1
+    for p in possible:
+        if p["preprepared"] < f + 1:
+            continue  # condition A2 fails
+        if p["no_argument"] < quorum:
+            continue  # condition A1 fails
+        return p["proposal"], no_in_flight_count
+    return None, no_in_flight_count
+
+
 def check_in_flight(
     messages: list[ViewData], f: int, quorum: int, n: int, verifier: Verifier
 ) -> tuple[bool, bool, Optional[Proposal]]:
@@ -126,51 +204,50 @@ def check_in_flight(
       condition B — >=quorum of messages support that nothing is in flight.
     """
     expected_sequence = max_last_decision_sequence(messages) + 1
-    possible: list[dict] = []
-    props_and_md: list[tuple[Optional[Proposal], Optional[ViewMetadata]]] = []
-    no_in_flight_count = 0
-
-    for vd in messages:
-        if vd.in_flight_proposal is None:
-            no_in_flight_count += 1
-            props_and_md.append((None, None))
-            continue
-        if not vd.in_flight_proposal.metadata:
-            raise ValueError("view data message has in flight proposal with nil metadata")
-        md = decode(ViewMetadata, vd.in_flight_proposal.metadata)
-        props_and_md.append((vd.in_flight_proposal, md))
-        if md.latest_sequence != expected_sequence:
-            no_in_flight_count += 1
-            continue
-        if not vd.in_flight_prepared:
-            no_in_flight_count += 1
-            continue
-        if not any(p["proposal"] == vd.in_flight_proposal for p in possible):
-            possible.append({"proposal": vd.in_flight_proposal, "preprepared": 0, "no_argument": 0})
-
-    for prop, md in props_and_md:
-        for p in possible:
-            if prop is None:
-                p["no_argument"] += 1
-                continue
-            if md.latest_sequence != expected_sequence:
-                p["no_argument"] += 1
-                continue
-            if prop == p["proposal"]:
-                p["no_argument"] += 1
-                p["preprepared"] += 1
-
-    for p in possible:
-        if p["preprepared"] < f + 1:
-            continue  # condition A2 fails
-        if p["no_argument"] < quorum:
-            continue  # condition A1 fails
-        return True, False, p["proposal"]
-
+    entries = [_in_flight_rungs(vd).get(expected_sequence) for vd in messages]
+    chosen, no_in_flight_count = _check_rung(entries, f, quorum)
+    if chosen is not None:
+        return True, False, chosen
     if no_in_flight_count >= quorum:
         return True, True, None
-
     return False, False, None
+
+
+def check_in_flight_ladder(
+    messages: list[ViewData], f: int, quorum: int, n: int, verifier: Verifier
+) -> tuple[bool, list[Proposal]]:
+    """Multi-in-flight generalization of :func:`check_in_flight` for the
+    pipelined window (pipeline_depth > 1; no reference counterpart).
+
+    Applies the A/B rule rung by rung starting at max-last-decision+1:
+    every rung where condition A holds contributes an agreed proposal that
+    MUST be committed before the new view starts (a commit quorum may have
+    existed for it); the first rung where condition B holds terminates the
+    ladder (quorum intersection: nothing at or above it can have gathered
+    a commit quorum, because commit broadcasts are in-order within the
+    window — see core/pipeline.py).  A rung satisfying neither fails the
+    whole check, exactly as the single-slot rule does.
+
+    Returns (ok, agreed_proposals_in_sequence_order).  With no ladder
+    extensions present this reduces exactly to check_in_flight: one rung,
+    then B on the empty rung above it.
+    """
+    expected_sequence = max_last_decision_sequence(messages) + 1
+    all_rungs = [_in_flight_rungs(vd) for vd in messages]
+    agreed: list[Proposal] = []
+    # the ladder is bounded by the highest rung any ViewData carries
+    highest = max((max(r) for r in all_rungs if r), default=0)
+    while expected_sequence <= highest + 1:
+        entries = [rungs.get(expected_sequence) for rungs in all_rungs]
+        chosen, no_in_flight_count = _check_rung(entries, f, quorum)
+        if chosen is not None:
+            agreed.append(chosen)
+            expected_sequence += 1
+            continue
+        if no_in_flight_count >= quorum:
+            return True, agreed
+        return False, []
+    return True, agreed
 
 
 class _InFlightDecider:
@@ -612,16 +689,44 @@ class ViewChanger:
         )
 
     def _prepare_view_data_msg(self) -> SignedViewData:
-        """viewchanger.go:433-456."""
+        """viewchanger.go:433-456; the pipelined window adds the in-flight
+        LADDER (every undelivered consecutive rung above the checkpoint)."""
         last_decision, last_decision_signatures = self.checkpoint.get()
         in_flight = self._get_in_flight(last_decision)
         prepared = self.in_flight.is_in_flight_prepared()
+        more: list[Proposal] = []
+        more_prepared: list[bool] = []
+        ladder = self.in_flight.ladder()
+        if ladder:
+            last_seq = 0
+            if last_decision is not None and last_decision.metadata:
+                last_seq = decode(ViewMetadata, last_decision.metadata).latest_sequence
+            # consecutive prefix starting right above the checkpoint; stale
+            # rungs (<= last_seq, e.g. committed during the view change)
+            # are dropped, gaps cut the ladder
+            want = last_seq + 1
+            rungs: list[tuple[Proposal, bool]] = []
+            for seq, prop, prepped in ladder:
+                if seq < want:
+                    continue
+                if seq != want:
+                    break
+                rungs.append((prop, prepped))
+                want += 1
+            if rungs:
+                in_flight, prepared = rungs[0]
+                more = [p for p, _ in rungs[1:]]
+                more_prepared = [pr for _, pr in rungs[1:]]
+            else:
+                in_flight, prepared = None, False
         vd = ViewData(
             next_view=self.curr_view,
             last_decision=last_decision,
             last_decision_signatures=list(last_decision_signatures),
             in_flight_proposal=in_flight,
             in_flight_prepared=prepared,
+            in_flight_more=more,
+            in_flight_more_prepared=more_prepared,
         )
         vd_bytes = encode(vd)
         sig = self.signer.sign(vd_bytes)
@@ -687,7 +792,7 @@ class ViewChanger:
             )
             return False
         try:
-            validate_in_flight(vd.in_flight_proposal, last_decision_sequence)
+            validate_in_flight_ladder(vd, last_decision_sequence)
         except ValueError as e:
             self.logger.warnf(
                 "Node %d got viewData from %d, but the in flight proposal is invalid: %s",
@@ -787,7 +892,7 @@ class ViewChanger:
             return
         self.logger.debugf("Node %d got a quorum of viewData messages", self.self_id)
         messages = [decode(ViewData, v.msg.raw_view_data) for v in self.view_data_msgs.votes]
-        ok, _, _ = check_in_flight(messages, self.f, self.quorum, self.n, self.verifier)
+        ok, _ = check_in_flight_ladder(messages, self.f, self.quorum, self.n, self.verifier)
         if not ok:
             self.logger.debugf("Node %d checked the in flight and it was invalid", self.self_id)
             return
@@ -832,7 +937,7 @@ class ViewChanger:
             if not vd.last_decision.metadata:  # genesis
                 if my_sequence > 0:
                     try:
-                        validate_in_flight(vd.in_flight_proposal, 0)
+                        validate_in_flight_ladder(vd, 0)
                     except ValueError:
                         return False, False, False
                     valid_count += 1
@@ -841,7 +946,7 @@ class ViewChanger:
                     self.verifier.verify_signature(
                         Signature(signer=svd.signer, value=svd.signature, msg=svd.raw_view_data)
                     )
-                    validate_in_flight(vd.in_flight_proposal, 0)
+                    validate_in_flight_ladder(vd, 0)
                 except Exception:
                     return False, False, False
                 valid_count += 1
@@ -858,7 +963,7 @@ class ViewChanger:
 
             if last_md.latest_sequence < my_sequence:
                 try:
-                    validate_in_flight(vd.in_flight_proposal, last_md.latest_sequence)
+                    validate_in_flight_ladder(vd, last_md.latest_sequence)
                 except ValueError:
                     return False, False, False
                 valid_count += 1
@@ -874,7 +979,7 @@ class ViewChanger:
                 if vd.last_decision != my_last_decision:
                     return False, False, False
                 try:
-                    validate_in_flight(vd.in_flight_proposal, last_md.latest_sequence)
+                    validate_in_flight_ladder(vd, last_md.latest_sequence)
                 except ValueError:
                     return False, False, False
                 valid_count += 1
@@ -898,7 +1003,7 @@ class ViewChanger:
                 self.verifier.verify_signature(
                     Signature(signer=svd.signer, value=svd.signature, msg=svd.raw_view_data)
                 )
-                validate_in_flight(vd.in_flight_proposal, last_md.latest_sequence)
+                validate_in_flight_ladder(vd, last_md.latest_sequence)
             except Exception:
                 return False, False, False
             return True, False, True
@@ -926,18 +1031,25 @@ class ViewChanger:
         messages = [
             decode(ViewData, svd.raw_view_data) for svd in msg.signed_view_data
         ]
-        ok, no_in_flight, in_flight_proposal = check_in_flight(
+        ok, agreed = check_in_flight_ladder(
             messages, self.f, self.quorum, self.n, self.verifier
         )
         if not ok:
             self.logger.debugf("In flight check by node %d did not pass", self.self_id)
             return
-        if not no_in_flight and not await self._commit_in_flight_proposal(in_flight_proposal):
-            self.logger.warnf(
-                "Node %d was unable to commit the in flight proposal, not changing the view",
-                self.self_id,
-            )
-            return
+        # commit every agreed in-flight proposal, in sequence order: each
+        # commit advances the checkpoint, satisfying the next rung's
+        # last-decision precondition (single-rung ladders are the
+        # reference-shaped case, viewchanger.go:1110-1167)
+        for in_flight_proposal in agreed:
+            if self._stopped:
+                return
+            if not await self._commit_in_flight_proposal(in_flight_proposal):
+                self.logger.warnf(
+                    "Node %d was unable to commit the in flight proposal, not changing the view",
+                    self.self_id,
+                )
+                return
 
         my_sequence, _ = self._extract_current_sequence()
         self.state.save(

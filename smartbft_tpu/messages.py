@@ -104,10 +104,21 @@ class ViewData:
     last_decision_signatures: list[Signature] = None  # type: ignore[assignment]
     in_flight_proposal: Optional[Proposal] = None
     in_flight_prepared: bool = False
+    # Pipelined-window extension (pipeline_depth > 1, no reference
+    # counterpart): the in-flight LADDER above the singular rung.
+    # ``in_flight_proposal`` remains the rung at last_decision_seq+1, so all
+    # single-slot validation applies unchanged; ``in_flight_more[i]`` is the
+    # rung at last_decision_seq+2+i with ``in_flight_more_prepared[i]``.
+    in_flight_more: list[Proposal] = None  # type: ignore[assignment]
+    in_flight_more_prepared: list[bool] = None  # type: ignore[assignment]
 
     def __post_init__(self):
         if self.last_decision_signatures is None:
             object.__setattr__(self, "last_decision_signatures", [])
+        if self.in_flight_more is None:
+            object.__setattr__(self, "in_flight_more", [])
+        if self.in_flight_more_prepared is None:
+            object.__setattr__(self, "in_flight_more_prepared", [])
 
 
 @wiremsg

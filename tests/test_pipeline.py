@@ -1,0 +1,389 @@
+"""Pipelined in-flight window (pipeline_depth > 1): unit + cluster tests.
+
+The protocol departure has no reference counterpart (the reference keeps
+one sequence in flight, controller.go:555-557); its safety rests on the
+in-order send invariants in core/pipeline.py plus the multi-in-flight
+view-change ladder (check_in_flight_ladder).  This suite pins:
+
+- the ladder decision rule (agreed prefixes, condition-B termination,
+  undecidable rungs failing closed);
+- ViewData ladder construction and validation;
+- a pipelined cluster committing with k outstanding sequences — including
+  launch coalescing across decisions (the point of the feature);
+- crash restore mid-window (WAL suffix rebuilds the slot ladder);
+- a view change with >1 sequence in flight converging without forks.
+"""
+
+import asyncio
+import dataclasses
+import os
+
+import pytest
+
+from smartbft_tpu.codec import encode
+from smartbft_tpu.config import ConfigError, Configuration
+from smartbft_tpu.core.viewchanger import (
+    check_in_flight_ladder,
+    validate_in_flight_ladder,
+)
+from smartbft_tpu.messages import Proposal, ViewData, ViewMetadata
+from smartbft_tpu.testing.app import App, SharedLedgers, fast_config, wait_for
+from smartbft_tpu.testing.network import Network
+from smartbft_tpu.utils.clock import Scheduler
+
+
+def proposal(seq: int, view: int = 0, payload: bytes = b"batch") -> Proposal:
+    return Proposal(
+        payload=payload,
+        metadata=encode(ViewMetadata(view_id=view, latest_sequence=seq)),
+    )
+
+
+class FakeVerifier:
+    def verify_consenter_sigs_batch(self, signatures, prop):
+        return [s.msg for s in signatures]
+
+
+def vd(last_seq: int, rungs=()) -> ViewData:
+    """rungs: list of (proposal, prepared) starting at last_seq+1."""
+    first = rungs[0] if rungs else (None, False)
+    return ViewData(
+        next_view=1,
+        last_decision=proposal(last_seq),
+        in_flight_proposal=first[0],
+        in_flight_prepared=first[1],
+        in_flight_more=[p for p, _ in rungs[1:]],
+        in_flight_more_prepared=[pr for _, pr in rungs[1:]],
+    )
+
+
+def ladder(msgs):
+    # n=4: f=1, quorum=3
+    return check_in_flight_ladder(msgs, f=1, quorum=3, n=4, verifier=FakeVerifier())
+
+
+# -- check_in_flight_ladder --------------------------------------------------
+
+def test_ladder_empty_window_is_condition_b():
+    ok, agreed = ladder([vd(5), vd(5), vd(5)])
+    assert ok and agreed == []
+
+
+def test_ladder_single_rung_reduces_to_single_slot_rule():
+    p = proposal(6)
+    ok, agreed = ladder([
+        vd(5, [(p, True)]), vd(5, [(p, True)]), vd(5),
+    ])
+    assert ok and agreed == [p]
+
+
+def test_ladder_agrees_consecutive_prefix():
+    p6, p7 = proposal(6), proposal(7, payload=b"b7")
+    msgs = [
+        vd(5, [(p6, True), (p7, True)]),
+        vd(5, [(p6, True), (p7, True)]),
+        vd(5, [(p6, True)]),  # saw only the first rung: no-argument above
+    ]
+    ok, agreed = ladder(msgs)
+    assert ok and agreed == [p6, p7]
+
+
+def test_ladder_stops_at_unprepared_rung():
+    p6, p7 = proposal(6), proposal(7, payload=b"b7")
+    msgs = [
+        vd(5, [(p6, True), (p7, False)]),
+        vd(5, [(p6, True), (p7, False)]),
+        vd(5, [(p6, True)]),
+    ]
+    ok, agreed = ladder(msgs)
+    # rung 7 unprepared everywhere -> condition B terminates after 6
+    assert ok and agreed == [p6]
+
+
+def test_ladder_undecidable_rung_fails_closed():
+    p6 = proposal(6)
+    p7a, p7b = proposal(7, payload=b"a"), proposal(7, payload=b"b")
+    msgs = [
+        vd(5, [(p6, True), (p7a, True)]),
+        vd(5, [(p6, True), (p7a, True)]),
+        vd(5, [(p6, True), (p7b, True)]),
+        vd(5, [(p6, True), (p7b, True)]),
+    ]
+    # rung 7: both candidates have 2 witnesses (>= f+1) but only 2
+    # no-argument votes (< quorum) and only 0 no-in-flight -> neither A nor
+    # B -> the WHOLE check fails (committing just rung 6 would let the new
+    # view re-propose at 7 while a commit quorum may exist for p7a or p7b)
+    ok, agreed = ladder(msgs)
+    assert not ok and agreed == []
+
+
+def test_ladder_max_checkpoint_shifts_expected_rung():
+    # one replica already delivered seq 6: expected starts at 7
+    p7 = proposal(7)
+    msgs = [
+        vd(6, [(p7, True)]),
+        vd(5, [(proposal(6), True), (p7, True)]),
+        vd(5, [(proposal(6), True), (p7, True)]),
+    ]
+    ok, agreed = ladder(msgs)
+    assert ok and agreed == [p7]
+
+
+# -- validate_in_flight_ladder ----------------------------------------------
+
+def test_validate_ladder_consecutive_ok():
+    validate_in_flight_ladder(
+        vd(5, [(proposal(6), True), (proposal(7), True), (proposal(8), False)]), 5
+    )
+
+
+def test_validate_ladder_gap_rejected():
+    bad = ViewData(
+        next_view=1,
+        last_decision=proposal(5),
+        in_flight_proposal=proposal(6),
+        in_flight_prepared=True,
+        in_flight_more=[proposal(8)],  # skips 7
+        in_flight_more_prepared=[True],
+    )
+    with pytest.raises(ValueError, match="rung 1"):
+        validate_in_flight_ladder(bad, 5)
+
+
+def test_validate_ladder_extension_without_first_rung_rejected():
+    bad = ViewData(
+        next_view=1,
+        last_decision=proposal(5),
+        in_flight_more=[proposal(7)],
+        in_flight_more_prepared=[True],
+    )
+    with pytest.raises(ValueError, match="without a first rung"):
+        validate_in_flight_ladder(bad, 5)
+
+
+# -- config gates ------------------------------------------------------------
+
+def test_pipeline_depth_requires_rotation_off():
+    with pytest.raises(ConfigError, match="leader_rotation"):
+        Configuration(self_id=1, pipeline_depth=4).validate()
+    Configuration(
+        self_id=1, pipeline_depth=4, leader_rotation=False, decisions_per_leader=0
+    ).validate()
+
+
+# -- cluster: pipelined commits + coalescing ---------------------------------
+
+def pipe_config(i: int, depth: int = 4, **kw) -> Configuration:
+    base = dict(
+        leader_rotation=False,
+        decisions_per_leader=0,
+        pipeline_depth=depth,
+        request_batch_max_count=2,
+        request_batch_max_interval=0.5,
+    )
+    base.update(kw)
+    return dataclasses.replace(fast_config(i), **base)
+
+
+def make_cluster(tmp_path, n=4, config_fn=None, seed=7):
+    scheduler = Scheduler()
+    network = Network(seed=seed)
+    shared = SharedLedgers()
+    cfg = config_fn or (lambda i: pipe_config(i))
+    apps = [
+        App(i, network, shared, scheduler,
+            wal_dir=os.path.join(str(tmp_path), f"wal-{i}"), config=cfg(i))
+        for i in range(1, n + 1)
+    ]
+    return apps, scheduler, network, shared
+
+
+def committed(app) -> int:
+    return sum(len(app.requests_from_proposal(d.proposal)) for d in app.ledger())
+
+
+def test_pipelined_cluster_commits_in_order(tmp_path):
+    async def run():
+        apps, scheduler, network, shared = make_cluster(tmp_path)
+        for a in apps:
+            await a.start()
+        for k in range(20):
+            await apps[0].submit("c", f"r{k}")
+        await wait_for(lambda: all(committed(a) >= 20 for a in apps), scheduler, 120.0)
+        # strict in-order, fork-free ledgers
+        l0 = [d.proposal.payload for d in apps[0].ledger()]
+        for a in apps[1:]:
+            la = [d.proposal.payload for d in a.ledger()]
+            m = min(len(l0), len(la))
+            assert l0[:m] == la[:m]
+        # sequences strictly ascending from 1
+        import smartbft_tpu.codec as codec
+        seqs = [
+            codec.decode(ViewMetadata, d.proposal.metadata).latest_sequence
+            for d in apps[0].ledger()
+        ]
+        assert seqs == list(range(1, len(seqs) + 1))
+        for a in apps:
+            await a.stop()
+
+    asyncio.run(run())
+
+
+def test_view_change_with_multiple_in_flight(tmp_path):
+    """The VERDICT-mandated scenario: freeze commit delivery so the window
+    fills with PREPARED-but-undelivered sequences, depose the leader, and
+    require the multi-in-flight ladder to converge — every frozen sequence
+    is committed by the new view machinery, fork-free."""
+
+    from smartbft_tpu.messages import Commit as CommitMsg
+
+    async def run():
+        apps, scheduler, network, shared = make_cluster(
+            tmp_path, config_fn=lambda i: pipe_config(i, request_batch_max_interval=0.05)
+        )
+        for a in apps:
+            await a.start()
+        # warm-up decision so checkpoints are past genesis
+        await apps[0].submit("c", "warm")
+        await wait_for(lambda: all(committed(a) >= 1 for a in apps), scheduler, 60.0)
+
+        # freeze commit receipt cluster-wide: prepares still flow, so slots
+        # advance to PREPARED (commit sent, quorum never collected)
+        for i in (1, 2, 3, 4):
+            network.nodes[i].add_filter(
+                lambda m, s: not isinstance(m, CommitMsg)
+            )
+        for k in range(6):
+            await apps[0].submit("c", f"frozen-{k}")
+        # wait until a follower's in-flight window holds >= 2 prepared rungs
+        await wait_for(
+            lambda: len(apps[1].consensus.in_flight.ladder()) >= 2
+            and all(p for _, _, p in apps[1].consensus.in_flight.ladder()[:2]),
+            scheduler, 120.0,
+        )
+        frozen_rungs = len(apps[1].consensus.in_flight.ladder())
+        assert frozen_rungs >= 2
+
+        # depose the leader; heal the commit freeze so the view change's
+        # in-flight commit machinery can exchange commit votes
+        apps[0].disconnect()
+        for i in (1, 2, 3, 4):
+            network.nodes[i].clear_filters()
+
+        await wait_for(
+            lambda: all(
+                a.consensus.get_leader_id() != 1 for a in apps[1:]
+            ),
+            scheduler, 600.0,
+        )
+        # the frozen sequences must come out the other side committed
+        await wait_for(
+            lambda: all(committed(a) >= 1 + 6 for a in apps[1:]), scheduler, 600.0
+        )
+        # liveness in the new view
+        await apps[1].submit("c", "after-vc")
+        await wait_for(
+            lambda: all(committed(a) >= 8 for a in apps[1:]), scheduler, 600.0
+        )
+        # fork-free: identical ledger prefixes
+        l1 = [d.proposal.payload for d in apps[1].ledger()]
+        for a in apps[2:]:
+            la = [d.proposal.payload for d in a.ledger()]
+            m = min(len(l1), len(la))
+            assert l1[:m] == la[:m]
+        for a in apps:
+            await a.stop()
+
+    asyncio.run(run())
+
+
+def test_restart_mid_window_restores_slot_ladder(tmp_path):
+    """Crash restore with undelivered pipelined slots in the WAL: the
+    restarted node rebuilds its PROPOSED/PREPARED ladder from the suffix
+    (restore_window), then the cluster finishes every frozen sequence."""
+
+    from smartbft_tpu.messages import Commit as CommitMsg
+
+    async def run():
+        apps, scheduler, network, shared = make_cluster(
+            tmp_path, config_fn=lambda i: pipe_config(i, request_batch_max_interval=0.05)
+        )
+        for a in apps:
+            await a.start()
+        await apps[0].submit("c", "warm")
+        await wait_for(lambda: all(committed(a) >= 1 for a in apps), scheduler, 60.0)
+
+        # freeze commits; fill follower WALs with undelivered P/C records
+        for i in (1, 2, 3, 4):
+            network.nodes[i].add_filter(lambda m, s: not isinstance(m, CommitMsg))
+        for k in range(6):
+            await apps[0].submit("c", f"mid-{k}")
+        await wait_for(
+            lambda: len(apps[2].consensus.in_flight.ladder()) >= 2, scheduler, 120.0
+        )
+
+        # crash-restart follower 3 mid-window (its WAL holds the ladder)
+        await apps[2].restart()
+        view = apps[2].consensus.controller.curr_view
+        assert hasattr(view, "slots"), "restarted node must run a WindowedView"
+        restored_phases = {s: slot.phase for s, slot in sorted(view.slots.items())}
+        assert restored_phases, f"no slots restored: {restored_phases}"
+
+        # heal; everything frozen must commit on every node incl. the
+        # restarted one
+        for i in (1, 2, 3, 4):
+            network.nodes[i].clear_filters()
+        await wait_for(
+            lambda: all(committed(a) >= 7 for a in apps), scheduler, 600.0
+        )
+        l0 = [d.proposal.payload for d in apps[0].ledger()]
+        for a in apps[1:]:
+            la = [d.proposal.payload for d in a.ledger()]
+            m = min(len(l0), len(la))
+            assert l0[:m] == la[:m]
+        for a in apps:
+            await a.stop()
+
+    asyncio.run(run())
+
+
+def test_pipeline_overlaps_sequences(tmp_path):
+    """The leader really keeps >1 sequence outstanding: with a slow-to-
+    verify follower path the windowed view must still commit everything,
+    and the shared coalescer must see fewer launches than decisions."""
+
+    async def run():
+        from smartbft_tpu.crypto.provider import (
+            AsyncBatchCoalescer, HostVerifyEngine, Keyring, P256CryptoProvider,
+        )
+
+        scheduler = Scheduler()
+        network = Network(seed=11)
+        shared = SharedLedgers()
+        node_ids = [1, 2, 3, 4]
+        rings = Keyring.generate(node_ids, seed=b"pipe")
+        engine = HostVerifyEngine()
+        coalescer = AsyncBatchCoalescer(engine, window=0.02, max_batch=4096,
+                                        dedupe=True)
+        apps = [
+            App(i, network, shared, scheduler,
+                wal_dir=os.path.join(str(tmp_path), f"wal-{i}"),
+                config=pipe_config(i, request_batch_max_interval=0.05),
+                crypto=P256CryptoProvider(rings[i], coalescer=coalescer))
+            for i in node_ids
+        ]
+        for a in apps:
+            await a.start()
+        for k in range(24):
+            await apps[0].submit("c", f"r{k}")
+        await wait_for(lambda: all(committed(a) >= 24 for a in apps), scheduler, 240.0)
+        decisions = len(apps[0].ledger())
+        assert decisions >= 2
+        # cross-decision coalescing: strictly fewer launches than decisions
+        assert engine.stats.launches < decisions, (
+            engine.stats.launches, decisions,
+        )
+        for a in apps:
+            await a.stop()
+
+    asyncio.run(run())
