@@ -322,6 +322,13 @@ def main() -> None:
 
     if args.cpu or os.environ.get("SMARTBFT_BENCH_CPU") == "1":
         force_cpu()
+    else:
+        # persistent XLA compile cache on the device path too (force_cpu
+        # enables it for the CPU path): pad-shape prewarms cost full
+        # compiles otherwise, every run
+        from smartbft_tpu.utils.jaxenv import enable_compile_cache
+
+        enable_compile_cache()
 
     results = []
     for kind in args.engines.split(","):

@@ -176,6 +176,13 @@ class InFlightData:
         matching the reference (controller.go:682-705)."""
         if self._window:
             self.clear_below(synced_seq + 1)
+            if not self._window:
+                # the sync covered the whole window: the legacy singular
+                # fields (still written by PersistedState on every windowed
+                # save) would otherwise surface a long-delivered proposal
+                # through in_flight_proposal() and poison our ViewData
+                self._proposal = None
+                self._prepared = False
         else:
             self.clear()
 

@@ -1044,6 +1044,19 @@ class ViewChanger:
         for in_flight_proposal in agreed:
             if self._stopped:
                 return
+            # skip rungs this node already delivered: with pipelining a node
+            # can hold commit quorums (and a checkpoint) SEVERAL sequences
+            # past the quorum's reported max — the single-slot protocol
+            # could only ever be one ahead, which _commit_in_flight_proposal
+            # handles; two-plus ahead would hit its sequence panic
+            rung_md = decode(ViewMetadata, in_flight_proposal.metadata)
+            my_sequence, _ = self._extract_current_sequence()
+            if rung_md.latest_sequence <= my_sequence:
+                self.logger.debugf(
+                    "Node %d already delivered rung %d, skipping its in-flight commit",
+                    self.self_id, rung_md.latest_sequence,
+                )
+                continue
             if not await self._commit_in_flight_proposal(in_flight_proposal):
                 self.logger.warnf(
                     "Node %d was unable to commit the in flight proposal, not changing the view",

@@ -396,6 +396,7 @@ class AsyncBatchCoalescer:
         self._pending: list[tuple] = []
         self._futures: list[tuple[asyncio.Future, int, int]] = []
         self._flush_scheduled = False
+        self._launch_inflight = False
         self._lock = asyncio.Lock()
 
     async def submit(self, items) -> list[bool]:
@@ -408,9 +409,16 @@ class AsyncBatchCoalescer:
             self._pending.extend(items)
             self._futures.append((fut, start, len(items)))
             # _flush_scheduled covers exactly the CURRENT batch: it resets
-            # when a flush swaps the batch out, so items arriving while a
-            # previous flush's kernel is still running get their own flush
-            if len(self._pending) >= self.max_batch:
+            # when a flush swaps the batch out.  While a launch is already
+            # in flight nothing is scheduled here — completion-triggered
+            # flushing (below) drains whatever accumulated the moment the
+            # engine frees, which is what lets k pipelined decisions'
+            # quorum waves merge into one launch: queueing a second launch
+            # behind a busy device would only split the batch without
+            # finishing any earlier.
+            if self._launch_inflight:
+                pass
+            elif len(self._pending) >= self.max_batch:
                 asyncio.ensure_future(self._flush_after(0.0))
                 self._flush_scheduled = True
             elif not self._flush_scheduled:
@@ -424,9 +432,15 @@ class AsyncBatchCoalescer:
         # swap under the lock, verify outside it — submissions arriving
         # during the kernel launch accumulate into the NEXT batch
         async with self._lock:
+            if self._launch_inflight:
+                # a completion-triggered flush will pick the batch up
+                self._flush_scheduled = False
+                return
             pending, futures = self._pending, self._futures
             self._pending, self._futures = [], []
             self._flush_scheduled = False
+            if pending:
+                self._launch_inflight = True
         if not pending:
             return
         try:
@@ -437,10 +451,20 @@ class AsyncBatchCoalescer:
                     fut.set_exception(
                         RuntimeError(f"batch verify failed: {exc!r}")
                     )
+            await self._launch_done()
             return
         for fut, start, count in futures:
             if not fut.done():
                 fut.set_result(results[start : start + count])
+        await self._launch_done()
+
+    async def _launch_done(self) -> None:
+        """Completion-triggered flush: drain accumulated submissions now."""
+        async with self._lock:
+            self._launch_inflight = False
+            if self._pending and not self._flush_scheduled:
+                self._flush_scheduled = True
+                asyncio.ensure_future(self._flush_after(0.0))
 
     def _verify_batch(self, pending: list) -> list[bool]:
         """One engine call for the flushed batch, optionally deduplicated."""
