@@ -110,6 +110,12 @@ class Consensus:
         if self.view_changer is not None:
             self.view_changer.start_view_change(view_num, stop_view)
 
+    @property
+    def blocking_deliver(self) -> bool:
+        """Forward the embedder app's deliver-blocking capability so the
+        controller can skip the executor offload for in-memory delivers."""
+        return getattr(self.application, "blocking_deliver", True)
+
     def deliver(self, proposal: Proposal, signatures) -> Reconfig:
         """Application wrapper that detects reconfig (consensus.go:76-84).
         Runs on an executor thread — route reconfigs back thread-safely."""

@@ -71,8 +71,6 @@ from .view import (
     view_number_of_msg,
 )
 
-_ABORT_SENTINEL = object()
-
 #: slot-local pseudo-phase: quorum of valid commits collected, awaiting
 #: in-order delivery (the single-slot View has no equivalent state — it
 #: delivers immediately)
@@ -152,7 +150,6 @@ class WindowedView:
         window: int,
         in_flight=None,
         metrics_view: Optional[ViewMetrics] = None,
-        in_msg_q_size: int = 200,
     ):
         self.self_id = self_id
         self.n = n
@@ -175,7 +172,6 @@ class WindowedView:
         self.window = max(2, int(window))
         self.in_flight = in_flight
         self.metrics = metrics_view
-        self.in_msg_q_size = in_msg_q_size
 
         # reference-anchored bookkeeping for metadata checks: the expected
         # decisions_in_view of seq s is start_dec + (s - start_seq)
@@ -197,8 +193,17 @@ class WindowedView:
         self._sent_history: dict[int, tuple[Optional[Prepare], Optional[Commit]]] = {}
         self._last_voted_proposal_by_id: dict[int, Commit] = {}
 
-        self._inbox: asyncio.Queue = asyncio.Queue()
-        self._dropped_msgs = 0
+        # Direct synchronous ingest — no per-message queue.  Every task in
+        # this process shares one event loop, so _process_msg (which never
+        # awaits) is atomic with respect to the advance loop; routing a
+        # message straight into its slot's vote set replaces the reference's
+        # channel hop (view.go:274) and saves a queue put/get plus a task
+        # wakeup per message — at n=64 that is ~12k hops per decision.
+        # Memory stays bounded WITHOUT an inbox cap: vote sets dedup per
+        # sender, pre-prepare slots are 1-per-seq, and the window holds at
+        # most 2*window slots.
+        self._work = asyncio.Event()
+        self._verify_results: list[tuple] = []
         self._aborted = False
         self._task: Optional[asyncio.Task] = None
         self._verify_tasks: set[asyncio.Task] = set()
@@ -217,7 +222,7 @@ class WindowedView:
     def _stop(self) -> None:
         if not self._aborted:
             self._aborted = True
-            self._inbox.put_nowait(_ABORT_SENTINEL)
+            self._work.set()
 
     async def abort(self) -> None:
         """view.go:1000-1010 semantics; see View.abort for the cancellation
@@ -241,15 +246,20 @@ class WindowedView:
     def handle_message(self, sender: int, msg: Message) -> None:
         if self._aborted:
             return
-        if self._inbox.qsize() >= self.in_msg_q_size:
-            self._dropped_msgs += 1
-            if self._dropped_msgs == 1 or self._dropped_msgs % 1000 == 0:
-                self.logger.warnf(
-                    "WindowedView %d inbox full (%d), dropped %d messages from %d",
-                    self.number, self.in_msg_q_size, self._dropped_msgs, sender,
-                )
-            return
-        self._inbox.put_nowait((sender, msg))
+        try:
+            self._process_msg(sender, msg)
+        except ViewAborted:
+            pass  # _stop() already latched; the run loop exits on its own
+        except Exception as e:
+            # contain ingest failures the way the old queued path's run-loop
+            # handler did: tear the view down loudly instead of letting the
+            # exception escape into the transport's receive loop
+            self.logger.errorf(
+                "WindowedView %d failed processing a message from %d: %r",
+                self.number, sender, e,
+            )
+            self._stop()
+        self._work.set()
 
     # ------------------------------------------------------------------ leader
 
@@ -284,11 +294,12 @@ class WindowedView:
             prev_commit_signatures=[],
         )
         self._next_propose_seq += 1
-        # bypass the inbox bound: the window (can_accept_more_proposals) is
-        # the flow control for our own proposals — a drop here would consume
-        # the sequence number without ever proposing it, wedging the cluster
         if not self._aborted:
-            self._inbox.put_nowait((self.leader_id, pp))
+            try:
+                self._process_msg(self.leader_id, pp)
+            except ViewAborted:
+                pass
+            self._work.set()
         self.logger.debugf(
             "Proposing sequence %d in view %d (window %d..%d)",
             pp.seq, self.number, self.proposal_sequence, self._next_propose_seq - 1,
@@ -302,13 +313,18 @@ class WindowedView:
                 self.comm.broadcast_consensus(m)
             self._restored_broadcasts = []
             while True:
+                self._absorb_pending_verify_results()
                 progressed = await self._advance()
                 if self._aborted:
                     raise ViewAborted()
                 if progressed:
                     continue
-                await self._next_event()
-                self._drain_inbox()
+                if self._verify_results:
+                    continue  # arrived during _advance's awaits
+                await self._work.wait()
+                self._work.clear()
+                if self._aborted:
+                    raise ViewAborted()
         except ViewAborted:
             pass
         except Exception as e:  # pragma: no cover - defensive
@@ -321,27 +337,10 @@ class WindowedView:
                 ViewSequence(view_active=False, proposal_seq=self.proposal_sequence)
             )
 
-    async def _next_event(self) -> None:
-        item = await self._inbox.get()
-        self._handle_item(item)
-
-    def _drain_inbox(self) -> None:
-        while True:
-            try:
-                item = self._inbox.get_nowait()
-            except asyncio.QueueEmpty:
-                return
-            self._handle_item(item)
-
-    def _handle_item(self, item) -> None:
-        if item is _ABORT_SENTINEL or self._aborted:
-            raise ViewAborted()
-        if isinstance(item, tuple) and len(item) == 4 and item[0] == "verified":
-            _, seq, sigs, results = item
+    def _absorb_pending_verify_results(self) -> None:
+        while self._verify_results:
+            seq, sigs, results = self._verify_results.pop(0)
             self._absorb_verify_results(seq, sigs, results)
-            return
-        sender, msg = item
-        self._process_msg(sender, msg)
 
     # ------------------------------------------------------------------ routing
 
@@ -429,8 +428,13 @@ class WindowedView:
         invariants (prepare-send, commit-send, delivery) fall out of the
         iteration order plus the frontier guards."""
         progressed = False
-        # snapshot: _process_prepares drains the inbox mid-iteration, which
-        # may create new slots
+        # Stage -> one durability wave -> finalize: each ready slot's WAL
+        # record is WRITTEN during staging (record order = staged order =
+        # sequence order, keeping the in-order save invariants), then ALL
+        # staged records await one shared fsync wave, then finalization
+        # broadcasts in sequence order.  Sequentially awaiting per-slot
+        # saves instead cost k wave round-trips per window.
+        staged: list = []  # (durability_future_or_None, finalize)
         for seq in sorted(self.slots):
             slot = self.slots.get(seq)
             if slot is None:
@@ -440,17 +444,25 @@ class WindowedView:
                 and slot.pre_prepare is not None
                 and seq == self._prepare_frontier + 1
             ):
-                await self._process_proposal(slot)
+                staged.append(self._stage_proposal(slot))
                 progressed = True
             if (
                 slot.phase == PROPOSED
                 and seq == self._commit_frontier + 1
                 and self._count_prepares(slot) >= self.quorum - 1
             ):
-                await self._process_prepares(slot)
+                staged.append(self._stage_commit(slot))
                 progressed = True
             if slot.phase == PREPARED:
                 self._maybe_flush_verify(slot)
+        if staged:
+            futs = [f for f, _ in staged if f is not None]
+            if futs:
+                await asyncio.gather(*futs)
+            if self._aborted:
+                raise ViewAborted()
+            for _, finalize in staged:
+                finalize()
         low = self.slots.get(self.proposal_sequence)
         if low is not None and low.phase == READY:
             await self._deliver(low)
@@ -470,8 +482,10 @@ class WindowedView:
 
     # -- phase 1: proposal --------------------------------------------------
 
-    async def _process_proposal(self, slot: _Slot) -> None:
-        """COMMITTED -> PROPOSED for one slot (view.go:351-427)."""
+    def _stage_proposal(self, slot: _Slot):
+        """COMMITTED -> PROPOSED for one slot (view.go:351-427), split into
+        stage (verify + WAL write now) and finalize (sends, after the shared
+        durability wave)."""
         pp = slot.pre_prepare
         proposal = pp.proposal
         try:
@@ -499,17 +513,21 @@ class WindowedView:
         # delivered) — mid-window the previous decisions' records must
         # survive a crash for restore to rebuild the ladder.
         truncate = slot.seq == self.proposal_sequence
-        await self._save_state(ProposedRecord(pre_prepare=pp, prepare=prepare), truncate)
-        if self.in_flight is not None:
-            self.in_flight.store_proposal_at(slot.seq, proposal)
-        slot.prepare_sent = replace(prepare, assist=True)
-        slot.phase = PROPOSED
+        fut = self._write_state(ProposedRecord(pre_prepare=pp, prepare=prepare), truncate)
         self._prepare_frontier = slot.seq
-        self._sent_history[slot.seq] = (slot.prepare_sent, None)
-        if self.self_id == self.leader_id:
-            self.comm.broadcast_consensus(pp)
-        self.comm.broadcast_consensus(prepare)
-        self.logger.infof("Processed proposal with seq %d", slot.seq)
+
+        def finalize() -> None:
+            if self.in_flight is not None:
+                self.in_flight.store_proposal_at(slot.seq, proposal)
+            slot.prepare_sent = replace(prepare, assist=True)
+            slot.phase = PROPOSED
+            self._sent_history[slot.seq] = (slot.prepare_sent, None)
+            if self.self_id == self.leader_id:
+                self.comm.broadcast_consensus(pp)
+            self.comm.broadcast_consensus(prepare)
+            self.logger.infof("Processed proposal with seq %d", slot.seq)
+
+        return fut, finalize
 
     def _verify_proposal(self, slot: _Slot, pp: PrePrepare) -> list:
         """view.go:553-607 for the rotation-off pipelined mode."""
@@ -560,11 +578,11 @@ class WindowedView:
             slot.prepare_voters.append(vote.sender)
         return len(slot.prepare_voters)
 
-    async def _process_prepares(self, slot: _Slot) -> None:
-        """PROPOSED -> PREPARED for one slot (view.go:441-517)."""
-        # sweep any queued prepares into the witness list before signing
-        # (PreparesFrom is liveness evidence; see View._process_prepares)
-        self._drain_inbox()
+    def _stage_commit(self, slot: _Slot):
+        """PROPOSED -> PREPARED for one slot (view.go:441-517), stage/
+        finalize split like _stage_proposal.  Every arrived prepare is
+        already registered (direct ingest), so the witness sweep is just the
+        counting pass (PreparesFrom is liveness evidence)."""
         self._count_prepares(slot)
         prp_from = encode(PreparesFrom(ids=slot.prepare_voters))
         sig = self.signer.sign_proposal(slot.proposal, prp_from)
@@ -575,16 +593,20 @@ class WindowedView:
             digest=slot.digest,
             signature=Signature(signer=sig.signer, value=sig.value, msg=sig.msg),
         )
-        await self._save_state(CommitRecord(commit=commit), truncate=False)
-        if self.in_flight is not None:
-            self.in_flight.store_prepares_at(slot.seq)
-        slot.commit_sent = replace(commit, assist=True)
-        slot.phase = PREPARED
+        fut = self._write_state(CommitRecord(commit=commit), truncate=False)
         self._commit_frontier = slot.seq
-        prev_p, _ = self._sent_history.get(slot.seq, (None, None))
-        self._sent_history[slot.seq] = (prev_p, slot.commit_sent)
-        self.comm.broadcast_consensus(commit)
-        self.logger.infof("Processed prepares for proposal with seq %d", slot.seq)
+
+        def finalize() -> None:
+            if self.in_flight is not None:
+                self.in_flight.store_prepares_at(slot.seq)
+            slot.commit_sent = replace(commit, assist=True)
+            slot.phase = PREPARED
+            prev_p, _ = self._sent_history.get(slot.seq, (None, None))
+            self._sent_history[slot.seq] = (prev_p, slot.commit_sent)
+            self.comm.broadcast_consensus(commit)
+            self.logger.infof("Processed prepares for proposal with seq %d", slot.seq)
+
+        return fut, finalize
 
     # -- phase 3: commits (concurrent verification) -------------------------
 
@@ -624,7 +646,8 @@ class WindowedView:
             except Exception as e:
                 results = e
             if not self._aborted:
-                self._inbox.put_nowait(("verified", seq, pending, results))
+                self._verify_results.append((seq, pending, results))
+                self._work.set()
 
         t = asyncio.get_running_loop().create_task(
             run(), name=f"wview-verify-{self.self_id}-{seq}"
@@ -715,14 +738,14 @@ class WindowedView:
 
     # ------------------------------------------------------------------ misc
 
-    async def _save_state(self, msg, truncate: bool) -> None:
-        save_durable = getattr(self.state, "save_durable", None)
-        if save_durable is not None:
-            await save_durable(msg, truncate=truncate)
-        else:
-            self.state.save(msg, truncate=truncate)
-        if self._aborted:
-            raise ViewAborted()
+    def _write_state(self, msg, truncate: bool):
+        """Write a SavedMessage now; return its durability future (None when
+        the write was synchronously durable — blocking WAL or test double)."""
+        save_nowait = getattr(self.state, "save_nowait", None)
+        if save_nowait is not None:
+            return save_nowait(msg, truncate=truncate)
+        self.state.save(msg, truncate=truncate)
+        return None
 
     def _handle_prev_seq_message(self, msg_seq: int, sender: int, m: Message) -> None:
         """Lagging-replica assists over the window's trailing edge

@@ -134,8 +134,11 @@ class Pool:
         self._size_bytes = 0
         self._closed = False
         self._stopped = False
-        self._del_map: set[RequestInfo] = set()
-        self._del_slice: list[RequestInfo] = []
+        # recently-deleted dedup: one insertion-ordered dict doubles as
+        # membership set and eviction queue (requestpool.go:418-437 keeps a
+        # map + slice pair; popping oldest entries from one dict halves the
+        # per-removal hash traffic on the n=64 bulk-removal hot path)
+        self._del_map: "OrderedDict[RequestInfo, None]" = OrderedDict()
         self._space_waiters: "list[asyncio.Future]" = []
 
     # ------------------------------------------------------------------ submit
@@ -314,14 +317,11 @@ class Pool:
     def _move_to_del(self, info: RequestInfo) -> None:
         if info in self._del_map:
             return
-        self._del_map.add(info)
-        self._del_slice.append(info)
+        self._del_map[info] = None
         # bounded dedup memory (requestpool.go:418-437)
-        if len(self._del_slice) > 2 * DEFAULT_SIZE_OF_DEL_ELEMENTS:
-            drop = len(self._del_slice) - DEFAULT_SIZE_OF_DEL_ELEMENTS
-            for r in self._del_slice[:drop]:
-                self._del_map.discard(r)
-            self._del_slice = self._del_slice[drop:]
+        if len(self._del_map) > 2 * DEFAULT_SIZE_OF_DEL_ELEMENTS:
+            for _ in range(len(self._del_map) - DEFAULT_SIZE_OF_DEL_ELEMENTS):
+                self._del_map.popitem(last=False)
 
     def _release_space(self) -> None:
         # wake as many parked submitters as there is capacity (the bulk

@@ -150,7 +150,6 @@ class ProposalMaker:
             proposal_sequence=proposal_sequence,
             decisions_in_view=decisions_in_view,
             state=self.state,
-            in_msg_q_size=self.in_msg_q_size,
             view_sequences=self.view_sequences,
             window=self.pipeline_depth,
             in_flight=getattr(self.state, "in_flight", None),

@@ -50,6 +50,10 @@ class StateRecorder:
     async def save_durable(self, msg, truncate: Optional[bool] = None) -> None:
         self.save(msg)
 
+    def save_nowait(self, msg, truncate: Optional[bool] = None):
+        self.save(msg)
+        return None
+
     def restore(self, view) -> None:
         raise RuntimeError("should not be used")
 
@@ -104,6 +108,24 @@ class PersistedState:
             self.wal.append(data, truncate_to=truncate)
             return
         await append_async(data, truncate_to=truncate)
+
+    def save_nowait(self, msg, truncate: Optional[bool] = None):
+        """Write the record NOW; return its durability future, or None when
+        the write was synchronously durable (blocking-save configuration).
+
+        The pipelined window stages several slots' records back to back and
+        awaits ONE shared fsync wave for all of them — sequentially awaiting
+        :meth:`save_durable` per slot costs a wave round-trip each."""
+        data = self._record_and_marshal(msg)
+        if truncate is None:
+            truncate = isinstance(msg, ProposedRecord)
+        append_async = (
+            getattr(self.wal, "append_async", None) if self.group_commit else None
+        )
+        if append_async is None:
+            self.wal.append(data, truncate_to=truncate)
+            return None
+        return append_async(data, truncate_to=truncate)
 
     def _record_and_marshal(self, msg) -> bytes:
         if isinstance(msg, ProposedRecord):

@@ -163,9 +163,23 @@ class InFlightData:
         slot[1] = True
 
     def clear_below(self, seq: int) -> None:
-        """Drop window rungs for delivered sequences (< ``seq``)."""
+        """Drop window rungs for delivered sequences (< ``seq``).
+
+        When this empties the window, a provably-stale legacy singular slot
+        (PersistedState writes it on every windowed save) is cleared too —
+        otherwise in_flight_proposal() would fall back to a long-delivered
+        proposal and poison this node's next ViewData."""
         for s in [s for s in self._window if s < seq]:
             del self._window[s]
+        if not self._window and self._proposal is not None \
+                and getattr(self._proposal, "metadata", b""):
+            from ..codec import decode
+            from ..messages import ViewMetadata
+
+            md = decode(ViewMetadata, self._proposal.metadata)
+            if md.latest_sequence < seq:
+                self._proposal = None
+                self._prepared = False
 
     def prune_synced(self, synced_seq: int) -> None:
         """A sync advanced the checkpoint to ``synced_seq``: drop what it
@@ -176,13 +190,6 @@ class InFlightData:
         matching the reference (controller.go:682-705)."""
         if self._window:
             self.clear_below(synced_seq + 1)
-            if not self._window:
-                # the sync covered the whole window: the legacy singular
-                # fields (still written by PersistedState on every windowed
-                # save) would otherwise surface a long-delivered proposal
-                # through in_flight_proposal() and poison our ViewData
-                self._proposal = None
-                self._prepared = False
         else:
             self.clear()
 

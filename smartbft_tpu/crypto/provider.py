@@ -37,6 +37,7 @@ import numpy as np
 from ..codec import decode, encode, wiremsg
 from ..messages import Proposal, Signature
 from ..types import proposal_digest
+from ..utils.memo import BoundedMemo
 from . import bls12381, ed25519, p256
 
 
@@ -508,6 +509,7 @@ class CryptoProvider:
         replicas run against one chip, their concurrent quorum checks merge
         into shared kernel launches instead of queueing per-replica ones."""
         self.keyring = keyring
+        self._sig_msg_memo: BoundedMemo[bytes, "ConsenterSigMsg"] = BoundedMemo(8192)
         if coalescer is not None and engine is not None \
                 and coalescer.engine is not engine:
             raise ValueError("shared coalescer wraps a different engine")
@@ -587,8 +589,13 @@ class CryptoProvider:
 
         ``digest``: the proposal's digest if the caller already computed it
         — hashing a batch-sized proposal costs ~50 us, and quorum
-        validation checks one proposal against dozens of signatures."""
-        decoded = decode(ConsenterSigMsg, signature.msg)
+        validation checks one proposal against dozens of signatures.  The
+        sig-msg decode is memoized: every replica sharing this provider's
+        process re-checks the same wire bytes (~42k decodes per n=64 bench
+        run before the memo)."""
+        decoded = self._sig_msg_memo.get_or(
+            signature.msg, lambda: decode(ConsenterSigMsg, signature.msg)
+        )
         if digest is None:
             digest = proposal_digest(proposal)
         if decoded.proposal_digest != digest:

@@ -169,6 +169,10 @@ class App(Application, Assembler, Comm, Signer, Verifier, RequestInspector,
 
     # ------------------------------------------------------------------ app
 
+    #: in-memory ledger append — never blocks, so the controller may run
+    #: deliver inline instead of paying an executor round-trip per decision
+    blocking_deliver = False
+
     def deliver(self, proposal: Proposal, signatures) -> Reconfig:
         decision = Decision(proposal=proposal, signatures=tuple(signatures))
         self.shared.append(self.id, decision)

@@ -111,6 +111,10 @@ class Checkpoint:
         self._lock = threading.Lock()
         self._proposal = Proposal()
         self._signatures: tuple[Signature, ...] = ()
+        #: bumped on every set — cheap change-detection for derived caches
+        #: (e.g. the controller's leader memo, which depends on the
+        #: blacklist carried in the checkpoint metadata)
+        self.version = 0
 
     def get(self) -> tuple[Proposal, tuple[Signature, ...]]:
         with self._lock:
@@ -120,6 +124,7 @@ class Checkpoint:
         with self._lock:
             self._proposal = proposal
             self._signatures = tuple(signatures)
+            self.version += 1
 
 
 def view_metadata_of(p: Proposal) -> ViewMetadata:
