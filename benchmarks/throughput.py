@@ -94,6 +94,24 @@ def build_engine(kind: str, pad_sizes, scheme):
 
         return ShardedVerifyEngine(mesh=build_mesh(), pad_sizes=pad_sizes,
                                    scheme=scheme)
+    if kind == "sharded2d":
+        # the 2D (seq x vote) quorum-block path: waves group by sequence
+        # and vote counts psum across the 'vote' mesh axis (quorum_decide
+        # under live consensus); multi-chip validation shape, CPU mesh on
+        # this rig
+        import jax
+
+        from smartbft_tpu.parallel import QuorumMeshVerifyEngine, build_mesh
+
+        ndev = len(jax.devices())
+        vote_par = 2 if ndev % 2 == 0 else 1
+        mesh = build_mesh((ndev // vote_par, vote_par), ("seq", "vote"))
+        # honor --pad-sizes: the engine's block is seq_tile x vote_tile
+        # lanes, sized so one block covers the requested top rung
+        vote_tile = 16
+        seq_tile = max(1, -(-max(pad_sizes) // vote_tile))
+        return QuorumMeshVerifyEngine(mesh=mesh, seq_tile=seq_tile,
+                                      vote_tile=vote_tile, scheme=scheme)
     if kind == "host":
         return HostVerifyEngine(scheme=scheme)
     raise ValueError(f"unknown engine {kind}")
@@ -158,7 +176,7 @@ async def run_cluster(engine_kind: str, n: int, requests: int, batch: int,
 
     # pre-warm every engine at every lane size so no XLA compile lands
     # inside the timed window
-    if engine_kind in ("jax", "sharded"):
+    if engine_kind in ("jax", "sharded", "sharded2d"):
         # warm with a RING key: a foreign key would grow the comb-table
         # registry past the membership (65 keys -> npad 128) and force a
         # recompile of every padded shape mid-run
@@ -332,7 +350,7 @@ def main() -> None:
 
     results = []
     for kind in args.engines.split(","):
-        share = (kind in ("jax", "sharded")) if args.share_engine == "auto" \
+        share = (kind in ("jax", "sharded", "sharded2d")) if args.share_engine == "auto" \
             else args.share_engine == "yes"
         # dedupe lives in the shared coalescer: without --share-engine there
         # is no cross-replica batch to deduplicate, so report it as off

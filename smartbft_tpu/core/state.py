@@ -98,16 +98,9 @@ class PersistedState:
         shared with every other WAL on the loop, and this coroutine resumes
         once the record is durable.  Callers hold their dependent broadcast
         until then — the same WAL-first ordering the sync path gives."""
-        data = self._record_and_marshal(msg)
-        if truncate is None:
-            truncate = isinstance(msg, ProposedRecord)
-        append_async = (
-            getattr(self.wal, "append_async", None) if self.group_commit else None
-        )
-        if append_async is None:
-            self.wal.append(data, truncate_to=truncate)
-            return
-        await append_async(data, truncate_to=truncate)
+        fut = self.save_nowait(msg, truncate=truncate)
+        if fut is not None:
+            await fut
 
     def save_nowait(self, msg, truncate: Optional[bool] = None):
         """Write the record NOW; return its durability future, or None when

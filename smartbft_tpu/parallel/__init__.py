@@ -19,6 +19,16 @@ Two products:
   the flagship multi-chip step `__graft_entry__.dryrun_multichip` compiles.
 """
 
-from .engine import ShardedVerifyEngine, build_mesh, quorum_decide
+from .engine import (
+    QuorumMeshVerifyEngine,
+    ShardedVerifyEngine,
+    build_mesh,
+    quorum_decide,
+)
 
-__all__ = ["ShardedVerifyEngine", "build_mesh", "quorum_decide"]
+__all__ = [
+    "QuorumMeshVerifyEngine",
+    "ShardedVerifyEngine",
+    "build_mesh",
+    "quorum_decide",
+]

@@ -78,6 +78,144 @@ class ShardedVerifyEngine(JaxVerifyEngine):
         return self._jax.device_put(a, self._sharding)
 
 
+class QuorumMeshVerifyEngine(JaxVerifyEngine):
+    """2D (seq x vote) mesh engine: live cluster waves through the psum.
+
+    A coalesced cluster flush holds commit votes for one or more in-flight
+    sequences (each vote's message bytes identify its sequence).  This
+    engine groups the flush into a (seq_tile x vote_tile) quorum block —
+    one row per distinct message — and runs ONE sharded step per block:
+    each device verifies its tile of the block, then weighted vote counts
+    ``psum`` across the 'vote' mesh axis (the quorum-decision collective
+    of :func:`quorum_decide`).  Per-item verdicts feed the protocol's
+    certificate construction unchanged; the psum'd per-sequence counts are
+    exposed via :attr:`last_counts` and checked against the host-side
+    quorum decisions in CI.
+
+    Padding cells replicate a real item of the same block with weight 0,
+    so they cannot inflate counts and the compiled shape is static.
+    """
+
+    supports_pallas = False  # mesh-placed lanes stay on the XLA kernel
+
+    def __init__(self, mesh=None, quorum: int = 3, seq_tile: int = 8,
+                 vote_tile: int = 16, scheme=p256):
+        if mesh is None:
+            import jax
+
+            n = len(jax.devices())
+            vote_par = 2 if n % 2 == 0 else 1
+            mesh = build_mesh((n // vote_par, vote_par), ("seq", "vote"))
+        if tuple(mesh.axis_names) != ("seq", "vote"):
+            raise ValueError("QuorumMeshVerifyEngine wants a ('seq','vote') mesh")
+        self.mesh = mesh
+        seq_par, vote_par = (int(x) for x in mesh.devices.shape)
+        self.seq_tile = -(-seq_tile // seq_par) * seq_par
+        self.vote_tile = -(-vote_tile // vote_par) * vote_par
+        self.quorum = quorum
+        super().__init__(pad_sizes=(self.seq_tile * self.vote_tile,),
+                         scheme=scheme)
+        self._step = None
+        #: sharded quorum steps executed (each = one psum over 'vote')
+        self.psum_steps = 0
+        #: message bytes -> psum'd valid-vote count, from the last flush
+        self.last_counts: dict[bytes, int] = {}
+        #: message bytes -> count >= quorum, the mesh-side quorum decision
+        self.last_decided: dict[bytes, bool] = {}
+
+    def _build_step(self):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        scheme = self.scheme
+
+        def step(w, *arrays):
+            local = scheme.verify_kernel(*arrays)  # (S/seq, V/vote)
+            counts = jax.lax.psum(jnp.sum(local * w, axis=-1), "vote")
+            return local, counts
+
+        nargs = len(scheme.verify_inputs([self._probe_item()]))
+        in_specs = (P("seq", "vote"),) + tuple(
+            P("seq", "vote", None) for _ in range(nargs)
+        )
+        kw = {"mesh": self.mesh, "in_specs": in_specs,
+              "out_specs": (P("seq", "vote"), P("seq"))}
+        try:
+            sharded = jax.shard_map(step, check_vma=False, **kw)
+        except TypeError:  # older jax spells it check_rep
+            sharded = jax.shard_map(step, check_rep=False, **kw)
+        return jax.jit(sharded)
+
+    def _probe_item(self):
+        sk, pub = self.scheme.keygen(b"quorum-mesh-probe")
+        return self.scheme.make_item(b"p", self.scheme.sign_raw(sk, b"p"), pub)
+
+    def verify(self, items) -> list[bool]:
+        if not items:
+            return []
+        import time as _time
+
+        import jax.numpy as jnp
+
+        if self._step is None:
+            self._step = self._build_step()
+        # group the flush into rows by message; rows with more votes than
+        # the tile split across rows (verdicts stay exact; the split rows'
+        # counts are partial and merged host-side below)
+        rows: list[tuple[bytes, list[int]]] = []
+        by_msg: dict[bytes, int] = {}
+        for idx, it in enumerate(items):
+            msg = it[0]
+            at = by_msg.get(msg)
+            if at is None or len(rows[at][1]) >= self.vote_tile:
+                by_msg[msg] = len(rows)
+                rows.append((msg, [idx]))
+            else:
+                rows[at][1].append(idx)
+
+        out = [False] * len(items)
+        self.last_counts = {}
+        t0 = _time.perf_counter()
+        lanes = 0
+        for off in range(0, len(rows), self.seq_tile):
+            block = rows[off : off + self.seq_tile]
+            flat: list = []
+            weights = np.zeros((self.seq_tile, self.vote_tile), np.uint32)
+            for r in range(self.seq_tile):
+                idxs = block[r][1] if r < len(block) else []
+                fill = items[idxs[0]] if idxs else (
+                    items[block[0][1][0]] if block else self._probe_item()
+                )
+                for v in range(self.vote_tile):
+                    if v < len(idxs):
+                        flat.append(items[idxs[v]])
+                        weights[r, v] = 1
+                    else:
+                        flat.append(fill)
+            arrays = self.scheme.verify_inputs(flat)
+            shape = (self.seq_tile, self.vote_tile)
+            blocks = tuple(
+                jnp.asarray(a.reshape(shape + a.shape[1:])) for a in arrays
+            )
+            mask2d, counts = self._step(jnp.asarray(weights), *blocks)
+            mask2d = np.asarray(mask2d)
+            counts = np.asarray(counts)
+            self.psum_steps += 1
+            lanes += self.seq_tile * self.vote_tile
+            for r, (msg, idxs) in enumerate(block):
+                for v, idx in enumerate(idxs):
+                    out[idx] = bool(mask2d[r, v])
+                self.last_counts[msg] = (
+                    self.last_counts.get(msg, 0) + int(counts[r])
+                )
+        self.last_decided = {
+            m: c >= self.quorum for m, c in self.last_counts.items()
+        }
+        self.stats.record(len(items), lanes, _time.perf_counter() - t0)
+        return out
+
+
 def quorum_decide(mesh, quorum: int, scheme=p256):
     """The distributed quorum step: (S, V, ...) vote block -> (S,) decided.
 
