@@ -73,7 +73,7 @@ def get_provider_cls(name: str):
             "bls": BlsCryptoProvider}[name]
 
 
-def build_engine(kind: str, pad_sizes, scheme):
+def build_engine(kind: str, pad_sizes, scheme, n_nodes: int = 4):
     from smartbft_tpu.crypto.provider import HostVerifyEngine, JaxVerifyEngine
 
     if kind == "openssl":
@@ -108,9 +108,11 @@ def build_engine(kind: str, pad_sizes, scheme):
         mesh = build_mesh((ndev // vote_par, vote_par), ("seq", "vote"))
         # honor --pad-sizes: the engine's block is seq_tile x vote_tile
         # lanes, sized so one block covers the requested top rung
-        vote_tile = 16
+        vote_tile = max(16, n_nodes)
         seq_tile = max(1, -(-max(pad_sizes) // vote_tile))
-        return QuorumMeshVerifyEngine(mesh=mesh, seq_tile=seq_tile,
+        quorum = (n_nodes + (n_nodes - 1) // 3 + 1 + 1) // 2
+        return QuorumMeshVerifyEngine(mesh=mesh, quorum=quorum,
+                                      seq_tile=seq_tile,
                                       vote_tile=vote_tile, scheme=scheme)
     if kind == "host":
         return HostVerifyEngine(scheme=scheme)
@@ -157,7 +159,7 @@ async def run_cluster(engine_kind: str, n: int, requests: int, batch: int,
     node_ids = list(range(1, n + 1))
     rings = Keyring.generate(node_ids, seed=b"bench-tput", scheme=scheme)
     if share_engine:
-        one = build_engine(engine_kind, pad_sizes, scheme)
+        one = build_engine(engine_kind, pad_sizes, scheme, n_nodes=n)
         engines = {i: one for i in node_ids}
         # wider fan-in window when a whole cluster shares one chip: a
         # kernel launch costs ~100ms over the tunnel, so waiting ~20ms to
@@ -170,7 +172,7 @@ async def run_cluster(engine_kind: str, n: int, requests: int, batch: int,
                                         dedupe=dedupe)
         coalescers = {i: coalescer for i in node_ids}
     else:
-        engines = {i: build_engine(engine_kind, pad_sizes, scheme)
+        engines = {i: build_engine(engine_kind, pad_sizes, scheme, n_nodes=n)
                    for i in node_ids}
         coalescers = {i: None for i in node_ids}
 

@@ -27,16 +27,24 @@ class Configuration:
     request_batch_max_interval: float = 0.05
 
     # Buffers / pool (config.go:30-35).
-    # Divergence from the reference: when a View/ViewChanger inbox reaches
-    # incoming_message_buffer_size, further messages are DROPPED (with a
-    # rate-limited warning), whereas the reference blocks the sender on a
-    # full channel for backpressure (view.go:190, viewchanger.go:206).
-    # Dropping bounds a Byzantine flooder's memory without letting it stall
-    # the shared event loop; the cost is that an honest burst near the bound
-    # (e.g. a view-change storm at large n) can shed prepares/commits/
-    # view-data and pay an extra view change.  Size the bound generously for
-    # large clusters — the throughput harness uses max(2000, 40*n).
+    # When a View/ViewChanger inbox reaches incoming_message_buffer_size:
+    # - inbox_backpressure=False (default): further messages are DROPPED
+    #   (with a rate-limited warning).  Dropping bounds a Byzantine
+    #   flooder's memory without letting it stall the shared event loop;
+    #   the cost is that an honest burst near the bound (e.g. a view-change
+    #   storm at large n) can shed prepares/commits/view-data and pay an
+    #   extra view change.  Size the bound generously for large clusters —
+    #   the throughput harness uses max(2000, 40*n).
+    # - inbox_backpressure=True: the SENDING task blocks until space frees,
+    #   matching the reference's full-channel semantics (view.go:190,
+    #   viewchanger.go:206).  Requires the transport to deliver through the
+    #   async intake (Consensus.handle_message_async); transports calling
+    #   the sync intake still get drop semantics.
+    # Pipelined views (pipeline_depth > 1) use direct ingest with no inbox:
+    # vote-set dedup and the slot window bound memory, so neither policy
+    # applies there.
     incoming_message_buffer_size: int = 200
+    inbox_backpressure: bool = False
     request_pool_size: int = 400
 
     # Group-commit WAL durability (no reference counterpart — the reference

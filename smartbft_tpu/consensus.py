@@ -272,6 +272,16 @@ class Consensus:
         if self.controller is not None:
             self.controller.process_messages(sender, m)
 
+    async def handle_message_async(self, sender: int, m: Message) -> None:
+        """Async intake: lets a backpressure-configured cluster block the
+        delivering transport task on full component inboxes (the
+        reference's full-channel sender semantics, view.go:190)."""
+        if sender not in self._node_set:
+            self.logger.warnf("Received message from unexpected node %d", sender)
+            return
+        if self.controller is not None:
+            await self.controller.process_messages_async(sender, m)
+
     async def handle_request(self, sender: int, req: bytes) -> None:
         if self.controller is not None:
             await self.controller.handle_request(sender, req)
@@ -322,6 +332,7 @@ class Consensus:
             resend_timeout=self.config.view_change_resend_interval,
             view_change_timeout=self.config.view_change_timeout,
             in_msg_q_size=self.config.incoming_message_buffer_size,
+            backpressure=self.config.inbox_backpressure,
             metrics_view_change=self.metrics.view_change,
             metrics_blacklist=self.metrics.blacklist,
             metrics_view=self.metrics.view,
@@ -394,6 +405,7 @@ class Consensus:
             in_msg_q_size=self.config.incoming_message_buffer_size,
             view_sequences=view_sequences,
             pipeline_depth=self.config.pipeline_depth,
+            backpressure=self.config.inbox_backpressure,
         )
 
     def _create_pool(self) -> None:

@@ -299,18 +299,23 @@ class Controller(RequestTimeoutHandler):
 
     # ------------------------------------------------------------------ routing
 
+    def _route_view_message_tail(self, sender: int, m: Message) -> None:
+        """Shared tail of pre-prepare/prepare/commit routing: view-change
+        evidence fan-out + artificial leader heartbeat (both intakes)."""
+        if self.view_changer is not None:
+            self.view_changer.handle_view_message(sender, m)
+        if sender == self.leader_id():
+            self.leader_monitor.inject_artificial_heartbeat(
+                sender,
+                HeartBeat(view=view_number_of_msg(m), seq=proposal_sequence_of_msg(m)),
+            )
+
     def process_messages(self, sender: int, m: Message) -> None:
         """Dispatch inbound consensus messages (controller.go:321-344)."""
         if isinstance(m, (PrePrepare, Prepare, Commit)):
             if self.curr_view is not None:
                 self.curr_view.handle_message(sender, m)
-            if self.view_changer is not None:
-                self.view_changer.handle_view_message(sender, m)
-            if sender == self.leader_id():
-                self.leader_monitor.inject_artificial_heartbeat(
-                    sender,
-                    HeartBeat(view=view_number_of_msg(m), seq=proposal_sequence_of_msg(m)),
-                )
+            self._route_view_message_tail(sender, m)
         elif isinstance(m, (ViewChange, SignedViewData, NewView)):
             if self.view_changer is not None:
                 self.view_changer.handle_message(sender, m)
@@ -322,6 +327,25 @@ class Controller(RequestTimeoutHandler):
             self.collector.handle_message(sender, m)
         else:
             self.logger.warnf("Unexpected message type, ignoring")
+
+    async def process_messages_async(self, sender: int, m: Message) -> None:
+        """Async intake mirror of :meth:`process_messages` for transports
+        that can block on backpressure (Configuration.inbox_backpressure):
+        View/ViewChanger intake may suspend the sending task on a full
+        inbox; every other route is synchronous."""
+        if isinstance(m, (PrePrepare, Prepare, Commit)):
+            if self.curr_view is not None:
+                intake = getattr(self.curr_view, "handle_message_async", None)
+                if intake is not None:
+                    await intake(sender, m)
+                else:
+                    self.curr_view.handle_message(sender, m)
+            self._route_view_message_tail(sender, m)
+        elif isinstance(m, (ViewChange, SignedViewData, NewView)):
+            if self.view_changer is not None:
+                await self.view_changer.handle_message_async(sender, m)
+        else:
+            self.process_messages(sender, m)
 
     def _respond_to_state_transfer_request(self, sender: int) -> None:
         vs = self.view_sequences.load()

@@ -224,6 +224,11 @@ class WindowedView:
             self._aborted = True
             self._work.set()
 
+    async def handle_message_async(self, sender: int, msg: Message) -> None:
+        """Async-intake shim: direct ingest never blocks (memory is bounded
+        by vote-set dedup + the slot window), so backpressure is a no-op."""
+        self.handle_message(sender, msg)
+
     async def abort(self) -> None:
         """view.go:1000-1010 semantics; see View.abort for the cancellation
         contract."""

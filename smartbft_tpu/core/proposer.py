@@ -35,6 +35,7 @@ class ProposalMaker:
         metrics_view: Optional[ViewMetrics] = None,
         metrics_blacklist: Optional[BlacklistMetrics] = None,
         pipeline_depth: int = 1,
+        backpressure: bool = False,
     ):
         self.decisions_per_leader = decisions_per_leader
         self.n = n
@@ -55,6 +56,7 @@ class ProposalMaker:
         self.metrics_view = metrics_view
         self.metrics_blacklist = metrics_blacklist
         self.pipeline_depth = pipeline_depth
+        self.backpressure = backpressure
         self._restored_from_wal = False
 
     def new_proposer(
@@ -94,6 +96,7 @@ class ProposalMaker:
             view_sequences=self.view_sequences,
             metrics_view=self.metrics_view,
             metrics_blacklist=self.metrics_blacklist,
+            backpressure=self.backpressure,
         )
         self._restore_once_and_publish(view, proposal_sequence)
         if proposal_sequence > view.proposal_sequence:

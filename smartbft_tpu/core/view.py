@@ -169,6 +169,7 @@ class View:
         metrics_view: Optional[ViewMetrics] = None,
         metrics_blacklist: Optional[BlacklistMetrics] = None,
         in_msg_q_size: int = 200,
+        backpressure: bool = False,
     ):
         self.self_id = self_id
         self.n = n
@@ -211,7 +212,12 @@ class View:
         self._last_voted_proposal_by_id: dict[int, Commit] = {}
         self._blacklist_supported = False
 
-        self._inbox: asyncio.Queue = asyncio.Queue()
+        self.backpressure = backpressure
+        # backpressure mode uses the queue's own bound so senders can block
+        # on put(); drop mode keeps the unbounded queue + explicit check
+        self._inbox: asyncio.Queue = asyncio.Queue(
+            maxsize=in_msg_q_size if backpressure else 0
+        )
         self._dropped_msgs = 0  # overflow counter for the bounded inbox
         self._aborted = False
         self._task: Optional[asyncio.Task] = None
@@ -249,7 +255,11 @@ class View:
     def _stop(self) -> None:
         if not self._aborted:
             self._aborted = True
-            self._inbox.put_nowait(_ABORT)
+            try:
+                self._inbox.put_nowait(_ABORT)
+            except asyncio.QueueFull:
+                pass  # a full (backpressure) inbox wakes the loop anyway;
+                # every dequeue re-checks self._aborted
 
     async def abort(self) -> None:
         """Force the view to end and wait for its task (view.go:1000-1010)."""
@@ -275,11 +285,13 @@ class View:
         return self.leader_id
 
     def handle_message(self, sender: int, msg: Message) -> None:
+        """Sync intake: drop on overflow (the default policy).
+
+        Bounded inbox (consensus.go:337 IncomingMessageBufferSize; the
+        reference's View drains a buffered channel, view.go:274): drop on
+        overflow so a Byzantine flooder cannot grow memory without limit."""
         if self._aborted:
             return
-        # Bounded inbox (consensus.go:337 IncomingMessageBufferSize; the
-        # reference's View drains a buffered channel, view.go:274): drop on
-        # overflow so a Byzantine flooder cannot grow memory without limit.
         if self._inbox.qsize() >= self.in_msg_q_size:
             self._dropped_msgs += 1
             if self._dropped_msgs == 1 or self._dropped_msgs % 1000 == 0:
@@ -289,6 +301,18 @@ class View:
                 )
             return
         self._inbox.put_nowait((sender, msg))
+
+    async def handle_message_async(self, sender: int, msg: Message) -> None:
+        """Async intake: with ``backpressure`` on, a full inbox BLOCKS the
+        sending task until the view drains — the reference's full-channel
+        semantics (view.go:190).  Without backpressure, same as the sync
+        path."""
+        if not self.backpressure:
+            self.handle_message(sender, msg)
+            return
+        if self._aborted:
+            return
+        await self._inbox.put((sender, msg))
 
     # ------------------------------------------------------------------ loop
 
@@ -313,6 +337,23 @@ class View:
             self.logger.errorf("View %d crashed: %r", self.number, e)
             raise
         finally:
+            # release EVERY sender blocked in handle_message_async's put()
+            # on the (bounded) inbox of a view that is going away: each
+            # drain pass frees at most qsize putters, and a freed putter
+            # immediately re-fills the slot — so drain repeatedly, yielding
+            # between passes, until a pass finds nothing (more concurrent
+            # senders than the bound is the norm at large n)
+            while True:
+                drained = False
+                while True:
+                    try:
+                        self._inbox.get_nowait()
+                        drained = True
+                    except asyncio.QueueEmpty:
+                        break
+                if not drained:
+                    break
+                await asyncio.sleep(0)
             self.view_sequences.store(
                 ViewSequence(view_active=False, proposal_seq=self.proposal_sequence)
             )

@@ -306,6 +306,7 @@ class ViewChanger:
         resend_timeout: float,
         view_change_timeout: float,
         in_msg_q_size: int,
+        backpressure: bool = False,
         metrics_view_change: Optional[ViewChangeMetrics] = None,
         metrics_blacklist: Optional[BlacklistMetrics] = None,
         metrics_view: Optional[ViewMetrics] = None,
@@ -325,6 +326,8 @@ class ViewChanger:
         self.resend_timeout = resend_timeout
         self.view_change_timeout = view_change_timeout
         self.in_msg_q_size = in_msg_q_size
+        self.backpressure = backpressure
+        self._space_event = asyncio.Event()
         self.metrics = metrics_view_change
         self.metrics_blacklist = metrics_blacklist
         self.metrics_view = metrics_view
@@ -416,6 +419,7 @@ class ViewChanger:
             self._stopped = True
             if self.controller_started_event is not None:
                 self.controller_started_event.set()  # release the start barrier
+            self._space_event.set()  # release blocked async senders
             self._events.put_nowait(("stop",))
             for fut in (self._in_flight_decide, self._in_flight_sync):
                 if fut is not None and not fut.done():
@@ -442,6 +446,21 @@ class ViewChanger:
                     "ViewChanger inbox full (%d), dropped %d messages from %d",
                     self.in_msg_q_size, self._dropped_msgs, sender,
                 )
+            return
+        self._queued_msgs += 1
+        self._events.put_nowait(("msg", sender, m))
+
+    async def handle_message_async(self, sender: int, m: Message) -> None:
+        """Async intake: with ``backpressure`` on, a full intake BLOCKS the
+        sending task until the run loop drains below the bound — the
+        reference's full-channel semantics (viewchanger.go:206)."""
+        if not self.backpressure:
+            self.handle_message(sender, m)
+            return
+        while not self._stopped and self._queued_msgs >= self.in_msg_q_size:
+            self._space_event.clear()
+            await self._space_event.wait()
+        if self._stopped:
             return
         self._queued_msgs += 1
         self._events.put_nowait(("msg", sender, m))
@@ -510,6 +529,7 @@ class ViewChanger:
             try:
                 if kind == "msg":
                     self._queued_msgs -= 1
+                    self._space_event.set()  # wake blocked async senders
                     await self._process_msg(evt[1], evt[2])
                 elif kind == "change":
                     self._pending_changes -= 1

@@ -165,8 +165,21 @@ class QuorumMeshVerifyEngine(JaxVerifyEngine):
         # counts are partial and merged host-side below)
         rows: list[tuple[bytes, list[int]]] = []
         by_msg: dict[bytes, int] = {}
+        counted: set = set()  # distinct items whose lane weights count
+        duplicate_lanes: set[int] = set()
         for idx, it in enumerate(items):
             msg = it[0]
+            # duplicate votes (colocated replicas re-checking the same
+            # signature in an un-deduped flush) get verified lanes but
+            # weight 0, so the psum'd quorum count tallies DISTINCT valid
+            # votes; unhashable scheme items degrade to counting all
+            try:
+                if it in counted:
+                    duplicate_lanes.add(idx)
+                else:
+                    counted.add(it)
+            except TypeError:
+                pass
             at = by_msg.get(msg)
             if at is None or len(rows[at][1]) >= self.vote_tile:
                 by_msg[msg] = len(rows)
@@ -190,7 +203,8 @@ class QuorumMeshVerifyEngine(JaxVerifyEngine):
                 for v in range(self.vote_tile):
                     if v < len(idxs):
                         flat.append(items[idxs[v]])
-                        weights[r, v] = 1
+                        if idxs[v] not in duplicate_lanes:
+                            weights[r, v] = 1
                     else:
                         flat.append(fill)
             arrays = self.scheme.verify_inputs(flat)

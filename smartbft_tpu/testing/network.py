@@ -61,7 +61,17 @@ class Node:
             kind, sender, payload = item
             try:
                 if kind == "consensus":
-                    self.consensus.handle_message(sender, payload)
+                    # async intake: a backpressure-configured cluster blocks
+                    # THIS node's delivery task on a full component inbox
+                    # (the reference's full-channel semantics); in drop mode
+                    # it behaves exactly like the sync intake
+                    intake = getattr(
+                        self.consensus, "handle_message_async", None
+                    )
+                    if intake is not None:
+                        await intake(sender, payload)
+                    else:  # injected doubles without the async surface
+                        self.consensus.handle_message(sender, payload)
                 else:
                     await self.consensus.handle_request(sender, payload)
             except Exception as e:  # pragma: no cover — harness robustness

@@ -1,0 +1,165 @@
+"""Inbox overflow policies: drop (default divergence) vs sender backpressure.
+
+The reference blocks the sender on a full component channel (view.go:190,
+viewchanger.go:206); this framework defaults to dropping with a warning
+(bounded memory under Byzantine flooding — rationale at
+Configuration.incoming_message_buffer_size) and offers the reference's
+blocking semantics behind ``inbox_backpressure=True`` through the async
+intake (Consensus.handle_message_async).
+"""
+
+import asyncio
+import dataclasses
+import os
+
+import pytest
+
+from smartbft_tpu.config import Configuration
+from smartbft_tpu.core.view import View, ViewSequencesHolder
+from smartbft_tpu.messages import Prepare
+from smartbft_tpu.testing.app import App, SharedLedgers, fast_config, wait_for
+from smartbft_tpu.testing.network import Network
+from smartbft_tpu.utils.clock import Scheduler
+from smartbft_tpu.utils.logging import RecordingLogger
+
+
+def _bare_view(backpressure: bool, bound: int = 4) -> View:
+    return View(
+        self_id=1, n=4, nodes_list=[1, 2, 3, 4], leader_id=2, quorum=3,
+        number=0, decider=None, failure_detector=None, synchronizer=None,
+        logger=RecordingLogger("bp"), comm=None, verifier=None, signer=None,
+        membership_notifier=None, proposal_sequence=1, decisions_in_view=0,
+        state=None, retrieve_checkpoint=None, decisions_per_leader=0,
+        view_sequences=ViewSequencesHolder(), in_msg_q_size=bound,
+        backpressure=backpressure,
+    )
+
+
+def test_view_sync_intake_drops_on_overflow():
+    async def run():
+        view = _bare_view(backpressure=False)
+        for k in range(10):  # bound is 4
+            view.handle_message(2, Prepare(view=0, seq=1, digest="d%d" % k))
+        assert view._inbox.qsize() == 4
+        assert view._dropped_msgs == 6
+
+    asyncio.run(run())
+
+
+def test_view_async_intake_blocks_sender_until_drained():
+    async def run():
+        view = _bare_view(backpressure=True)
+        sent = []
+
+        async def sender():
+            for k in range(10):
+                await view.handle_message_async(
+                    2, Prepare(view=0, seq=1, digest="d%d" % k)
+                )
+                sent.append(k)
+
+        task = asyncio.create_task(sender())
+        for _ in range(20):
+            await asyncio.sleep(0)
+        # the sender is parked on the full inbox: 4 queued + 1 in flight
+        assert not task.done()
+        assert len(sent) == 4 and view._inbox.qsize() == 4
+        assert view._dropped_msgs == 0
+        # draining unblocks the sender, message by message
+        while not task.done():
+            view._inbox.get_nowait()
+            for _ in range(10):
+                await asyncio.sleep(0)
+        assert sent == list(range(10))
+        assert view._dropped_msgs == 0
+
+    asyncio.run(run())
+
+
+def test_view_abort_releases_blocked_sender():
+    async def run():
+        view = _bare_view(backpressure=True)
+        view.start()
+
+        async def sender():
+            for k in range(50):
+                await view.handle_message_async(
+                    3, Prepare(view=0, seq=1, digest="x%d" % k)
+                )
+
+        task = asyncio.create_task(sender())
+        for _ in range(10):
+            await asyncio.sleep(0)
+        await view.abort()
+        await asyncio.wait_for(task, timeout=5)
+
+    asyncio.run(run())
+
+
+# -- storm at n=64: drop vs block liveness -----------------------------------
+
+def storm_config(i: int, backpressure: bool) -> Configuration:
+    return dataclasses.replace(
+        fast_config(i),
+        # a bound far below one quorum wave (63 prepares + 63 commits per
+        # seq land back-to-back at every replica before its view task runs)
+        incoming_message_buffer_size=24,
+        inbox_backpressure=backpressure,
+        request_batch_max_count=4,
+        request_forward_timeout=60.0, request_complain_timeout=120.0,
+        request_auto_remove_timeout=600.0,
+        view_change_resend_interval=60.0, view_change_timeout=240.0,
+        leader_heartbeat_timeout=120.0,
+    )
+
+
+@pytest.mark.parametrize("backpressure", [False, True], ids=["drop", "block"])
+def test_storm_n64(tmp_path, backpressure):
+    """n=64 under an inbox bound far below one quorum wave: block mode
+    commits everything with ZERO drops (senders pace themselves, the
+    reference's semantics); drop mode sheds messages and STALLS within the
+    same logical-time budget — the documented cost of the drop divergence,
+    which is why drop-mode deployments must size the bound generously
+    (Configuration.incoming_message_buffer_size rationale)."""
+
+    async def run():
+        scheduler = Scheduler()
+        network = Network(seed=3)
+        shared = SharedLedgers()
+        apps = [
+            App(i, network, shared, scheduler,
+                wal_dir=os.path.join(str(tmp_path), f"wal-{i}"),
+                config=storm_config(i, backpressure))
+            for i in range(1, 65)
+        ]
+        for a in apps:
+            await a.start()
+        for k in range(8):
+            await apps[0].submit("storm", f"req-{k}")
+        try:
+            await wait_for(
+                lambda: all(a.height() >= 2 for a in apps), scheduler, 300.0
+            )
+            converged = True
+        except TimeoutError:
+            converged = False
+        dropped = sum(
+            a.consensus.controller.curr_view._dropped_msgs
+            for a in apps
+            if a.consensus.controller.curr_view is not None
+        )
+        heights = sorted(a.height() for a in apps)
+        for a in apps:
+            await a.stop()
+        return converged, dropped, heights
+
+    converged, dropped, heights = asyncio.run(run())
+    if backpressure:
+        assert converged, f"block mode stalled: heights {heights[:5]}..."
+        assert dropped == 0, f"block mode must never drop, dropped {dropped}"
+    else:
+        assert dropped > 0, "the storm should overflow a 24-message inbox"
+        assert not converged, (
+            "drop mode unexpectedly converged — tighten the storm so the "
+            f"comparison stays meaningful (heights {heights[:5]}...)"
+        )
