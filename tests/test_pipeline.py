@@ -347,6 +347,57 @@ def test_restart_mid_window_restores_slot_ladder(tmp_path):
     asyncio.run(run())
 
 
+def test_pipelined_reconfig_add_node(tmp_path):
+    """Dynamic reconfiguration mid-stream with the window active: a
+    reconfig decision (grow 4 -> 5) lands among pipelined traffic; every
+    component restarts with the new membership (windowed views rebuilt for
+    n=5), the joiner syncs the chain, and ordering continues fork-free."""
+    import dataclasses as dc
+
+    from smartbft_tpu.testing.app import App as TApp
+
+    async def run():
+        apps, scheduler, network, shared = make_cluster(
+            tmp_path, config_fn=lambda i: pipe_config(i, request_batch_max_interval=0.05)
+        )
+        for a in apps:
+            await a.start()
+        for k in range(8):
+            await apps[0].submit("c", f"pre-{k}")
+        await wait_for(lambda: all(committed(a) >= 8 for a in apps), scheduler, 120.0)
+
+        cfg5 = dc.replace(
+            pipe_config(5, request_batch_max_interval=0.05), sync_on_start=True
+        )
+        app5 = TApp(5, network, shared, scheduler,
+                    wal_dir=os.path.join(str(tmp_path), "wal-5"), config=cfg5)
+        await apps[0].submit_reconfig("rc-add", [1, 2, 3, 4, 5])
+        await wait_for(
+            lambda: all(a.consensus.num_nodes == 5 for a in apps), scheduler, 240.0
+        )
+        await app5.start()
+        await wait_for(lambda: app5.height() >= 1, scheduler, 360.0)
+
+        # post-reconfig pipelined traffic across the grown cluster
+        all_apps = apps + [app5]
+        for k in range(8):
+            await apps[0].submit("c", f"post-{k}")
+        await wait_for(
+            lambda: all(committed(a) >= 17 for a in all_apps), scheduler, 600.0
+        )
+        # the new views must still be windowed (pipeline_depth carried over)
+        assert hasattr(apps[0].consensus.controller.curr_view, "slots")
+        l0 = [d.proposal.payload for d in apps[0].ledger()]
+        for a in all_apps[1:]:
+            la = [d.proposal.payload for d in a.ledger()]
+            m = min(len(l0), len(la))
+            assert l0[:m] == la[:m]
+        for a in all_apps:
+            await a.stop()
+
+    asyncio.run(run())
+
+
 def test_pipelined_soak_with_faults(tmp_path):
     """Soak the window under churn: a follower disconnects mid-stream and
     reconnects (catching up via assists/heartbeat sync), another follower
