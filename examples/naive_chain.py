@@ -130,8 +130,9 @@ class ChainNode(Application, Assembler, Signer, Verifier, RequestInspector,
     """One replica of the blockchain (node.go:90-273 equivalent)."""
 
     def __init__(self, node_id: int, mesh: ChannelMesh, scheduler: Scheduler,
-                 keyring: Keyring, wal_dir: str):
+                 keyring: Keyring, wal_dir: str, pipeline: int = 1):
         self.id = node_id
+        self.pipeline = pipeline
         self.mesh = mesh
         self.scheduler = scheduler
         self.comm = NodeComm(node_id, mesh)
@@ -153,8 +154,19 @@ class ChainNode(Application, Assembler, Signer, Verifier, RequestInspector,
         self._inbox: asyncio.Queue = mesh.register(node_id)
         self._inbox_task: asyncio.Task | None = None
         self._wal = None
+        # Pipelined-embedder pattern: with pipeline_depth > 1 the leader
+        # assembles block s+1 BEFORE block s delivers, so a hash-chained
+        # application must chain on its PENDING ladder (assembled/verified
+        # headers above the delivered tip), not on the delivered tip alone.
+        # seq -> BlockHeader; pruned at delivery, branches above a
+        # re-verified sequence dropped (a view change may replace an
+        # uncommitted block, invalidating everything chained on it).
+        self._pending_headers: dict[int, BlockHeader] = {}
 
     # -- Application -------------------------------------------------------
+
+    #: in-memory ledger append — lets the controller deliver inline
+    blocking_deliver = False
 
     def deliver(self, proposal: Proposal, signatures) -> Reconfig:
         header = decode(BlockHeader, proposal.header)
@@ -163,24 +175,61 @@ class ChainNode(Application, Assembler, Signer, Verifier, RequestInspector,
         self.decisions.append(
             Decision(proposal=proposal, signatures=tuple(signatures))
         )
+        for s in [s for s in self._pending_headers if s <= len(self.blocks)]:
+            del self._pending_headers[s]
         for q in self.block_listeners:
             q.put_nowait((header, list(data.transactions)))
         return Reconfig(in_latest_decision=False)
 
     # -- Assembler ---------------------------------------------------------
 
-    def _prev_hash(self) -> bytes:
-        if not self.blocks:
+    def _tip_hash_at(self, seq: int) -> bytes | None:
+        """Hash of the chain header AT ``seq`` — delivered or pending —
+        or None when this node doesn't know it (catch-up handles that)."""
+        if seq == 0:
             return b"genesis"
-        return hashlib.sha256(encode(self.blocks[-1][0])).digest()
+        if seq <= len(self.blocks):
+            return hashlib.sha256(encode(self.blocks[seq - 1][0])).digest()
+        pending = self._pending_headers.get(seq)
+        if pending is not None:
+            return hashlib.sha256(encode(pending)).digest()
+        return None
+
+    def _remember_header(self, header: BlockHeader) -> None:
+        """Record a pending (assembled/verified) header — bounded to the
+        window above the delivered tip so a bogus far-future sequence can
+        never poison the ladder or grow it without bound."""
+        if not (len(self.blocks) < header.sequence
+                <= len(self.blocks) + max(self.pipeline, 1)):
+            return
+        existing = self._pending_headers.get(header.sequence)
+        if existing is not None and existing != header:
+            # a superseded branch: everything chained above it is invalid
+            for s in [s for s in self._pending_headers if s > header.sequence]:
+                del self._pending_headers[s]
+        self._pending_headers[header.sequence] = header
 
     def assemble_proposal(self, metadata: bytes, requests) -> Proposal:
         payload = encode(BlockData(transactions=list(requests)))
+        # the consensus core tells us which sequence this proposal will
+        # occupy (ViewMetadata.latest_sequence) — deriving it from the
+        # pending ladder instead would let a stale entry from an abandoned
+        # proposal (view change before commit) skip a height
+        md = decode(ViewMetadata, metadata)
+        next_seq = md.latest_sequence
+        # re-proposing at a height supersedes anything remembered at or
+        # above it (only possible after a view change abandoned it)
+        for s in [s for s in self._pending_headers if s >= next_seq]:
+            del self._pending_headers[s]
+        prev_hash = self._tip_hash_at(next_seq - 1)
+        if prev_hash is None:  # a leader always has its own frontier's context
+            raise ValueError(f"assembling at {next_seq} without chain context")
         header = BlockHeader(
-            sequence=len(self.blocks) + 1,
-            prev_hash=self._prev_hash(),
+            sequence=next_seq,
+            prev_hash=prev_hash,
             data_hash=hashlib.sha256(payload).digest(),
         )
+        self._remember_header(header)
         return Proposal(
             header=encode(header),
             payload=payload,
@@ -203,11 +252,17 @@ class ChainNode(Application, Assembler, Signer, Verifier, RequestInspector,
             raise ValueError("block data hash mismatch")
         if proposal.verification_sequence != self.verification_sequence():
             raise ValueError("wrong verification sequence")
-        # chain linkage: the proposal must extend OUR chain tip (a lagging
-        # replica syncs first; the protocol retries after catch-up)
-        if header.sequence == len(self.blocks) + 1 and \
-                header.prev_hash != self._prev_hash():
-            raise ValueError("block does not extend the chain tip")
+        # chain linkage: the proposal must extend the chain at its height —
+        # the delivered tip, or (pipelined mode) a pending verified header
+        # above it.  Unknown heights pass here and are handled by catch-up.
+        expected_prev = self._tip_hash_at(header.sequence - 1)
+        if expected_prev is not None:
+            if header.prev_hash != expected_prev:
+                raise ValueError("block does not extend the chain tip")
+            # remember only VERIFIED linkage: an unknown height must stay
+            # transient (catch-up handles it), or a bogus far sequence
+            # could sit in the ladder forever
+            self._remember_header(header)
         return [self.request_id(r) for r in data.transactions]
 
     def verify_request(self, raw_request: bytes) -> RequestInfo:
@@ -321,6 +376,14 @@ class ChainNode(Application, Assembler, Signer, Verifier, RequestInspector,
             self._wal.close()
 
     def _config(self) -> Configuration:
+        # pipeline >= 2 runs the pipelined in-flight window (rotation-off
+        # mode): the leader keeps k blocks outstanding so consecutive
+        # blocks' quorum waves coalesce into shared verify launches
+        pipe = (
+            dict(leader_rotation=False, decisions_per_leader=0,
+                 pipeline_depth=self.pipeline)
+            if self.pipeline > 1 else {}
+        )
         return Configuration(
             self_id=self.id,
             request_batch_max_count=10,
@@ -334,11 +397,21 @@ class ChainNode(Application, Assembler, Signer, Verifier, RequestInspector,
             leader_heartbeat_count=10,
             collect_timeout=1.0,
             sync_on_start=False,
+            **pipe,
         )
 
     async def submit(self, client_id: str, tx_id: str, payload: bytes) -> None:
         tx = encode(Transaction(client_id=client_id, tx_id=tx_id, payload=payload))
         await self.consensus.submit_request(tx)
+
+
+def verify_chain(node: "ChainNode") -> None:
+    """Assert every block's prev_hash links to its predecessor's header —
+    the chain-integrity check shared by the demo and the tests."""
+    for i in range(1, len(node.blocks)):
+        prev_hdr = node.blocks[i - 1][0]
+        want = hashlib.sha256(encode(prev_hdr)).digest()
+        assert node.blocks[i][0].prev_hash == want, f"chain broken at {i}!"
 
 
 # --------------------------------------------------------------------------
@@ -377,10 +450,7 @@ async def main(num_blocks: int = 10) -> None:
     # verify chain links + re-verify every commit signature offline
     verifier = P256CryptoProvider(keyrings[2])
     for node in nodes:
-        for i in range(1, len(node.blocks)):
-            prev_hdr = node.blocks[i - 1][0]
-            want = hashlib.sha256(encode(prev_hdr)).digest()
-            assert node.blocks[i][0].prev_hash == want, "chain broken!"
+        verify_chain(node)
     n_sigs = 0
     for decision in nodes[0].decisions:
         assert len(decision.signatures) >= 3  # quorum for n=4
