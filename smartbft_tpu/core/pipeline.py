@@ -35,6 +35,15 @@ chains each pre-prepare to the previous decision's commit certificate
 (view.go:606-647,1022-1062), which a pipelined leader does not hold yet.
 With ``decisions_per_leader == 0`` the blacklist is empty by protocol and
 pre-prepares carry no prev-commit signatures, which this class enforces.
+
+WAL truncation cadence: a ProposedRecord carries the truncate mark only
+when its sequence IS the delivery frontier (mid-window records must
+survive a crash for restore to rebuild the ladder).  Under sustained
+saturation the frontier-aligned append happens only when the pipeline
+drains, so old segments accumulate until a load dip; any dip — including
+the gap between request bursts — truncates.  A deployment that truly
+never dips should bound segment growth by occasionally pausing proposing
+for one window (the cost is one window's latency).
 """
 
 from __future__ import annotations
