@@ -129,6 +129,82 @@ def test_ladder_max_checkpoint_shifts_expected_rung():
     assert ok and agreed == [p7]
 
 
+def test_ladder_differential_fuzz_vs_single_slot_rule():
+    """Differential fuzz: on inputs with NO ladder extension (the
+    reference-shaped case), check_in_flight_ladder must agree exactly with
+    the reference-faithful check_in_flight on every random configuration."""
+    import random
+
+    from smartbft_tpu.core.viewchanger import check_in_flight
+
+    rng = random.Random(42)
+    payloads = [b"a", b"b", b"c"]
+    for trial in range(400):
+        n = rng.choice([4, 7, 10])
+        f = (n - 1) // 3
+        quorum = -(-(n + f + 1) // 2)
+        base = rng.randrange(0, 4)
+        msgs = []
+        for _ in range(rng.randrange(quorum, n + 1)):
+            last = base + rng.choice([0, 0, 0, 1])  # some nodes ahead
+            if rng.random() < 0.4:
+                msgs.append(vd(last))
+            else:
+                p = proposal(last + 1, payload=rng.choice(payloads))
+                msgs.append(vd(last, [(p, rng.random() < 0.7)]))
+        ok1, none_in_flight, prop1 = check_in_flight(
+            msgs, f=f, quorum=quorum, n=n, verifier=FakeVerifier()
+        )
+        ok2, agreed = check_in_flight_ladder(
+            msgs, f=f, quorum=quorum, n=n, verifier=FakeVerifier()
+        )
+        assert ok1 == ok2, (trial, msgs)
+        if ok1:
+            if none_in_flight:
+                assert agreed == [] or prop1 is None, trial
+            else:
+                assert agreed and agreed[0] == prop1, trial
+
+
+def test_ladder_malformed_inputs_never_crash_silently():
+    """Byzantine-shaped ladders (gaps, duplicate sequences, nil metadata,
+    mismatched prepared flags) either raise ValueError (rejected upstream
+    per-ViewData) or produce a sound (ok, agreed) — never any other
+    exception."""
+    import random
+
+    rng = random.Random(99)
+    for trial in range(300):
+        msgs = []
+        for _ in range(rng.randrange(3, 6)):
+            last = rng.randrange(0, 3)
+            rungs = []
+            seq = last + rng.choice([0, 1, 2])  # may violate consecutiveness
+            for _ in range(rng.randrange(0, 4)):
+                if rng.random() < 0.15:
+                    p = Proposal(payload=b"nilmd")  # nil metadata
+                else:
+                    p = proposal(seq, payload=bytes([rng.randrange(97, 100)]))
+                rungs.append((p, rng.random() < 0.5))
+                seq += rng.choice([0, 1, 3])  # duplicates and gaps
+            msgs.append(vd(last, rungs))
+        try:
+            ok, agreed = ladder(msgs)
+        except ValueError:
+            continue  # malformed input rejected — acceptable
+        # sound result shape: agreed proposals are consecutive from the
+        # max checkpoint + 1
+        from smartbft_tpu.core.viewchanger import max_last_decision_sequence
+
+        expected = max_last_decision_sequence(msgs) + 1
+        import smartbft_tpu.codec as codec
+        from smartbft_tpu.messages import ViewMetadata as VM
+
+        for i, p in enumerate(agreed):
+            md = codec.decode(VM, p.metadata)
+            assert md.latest_sequence == expected + i, (trial, i)
+
+
 # -- validate_in_flight_ladder ----------------------------------------------
 
 def test_validate_ladder_consecutive_ok():
