@@ -474,6 +474,38 @@ def test_pipelined_reconfig_add_node(tmp_path):
     asyncio.run(run())
 
 
+def test_pipelined_lossy_network(tmp_path):
+    """5% random message loss on every node: one-shot broadcasts get
+    shedded, so progress leans on the in-window assists, the trailing-edge
+    assist history, and the heartbeat behind-rescue — the cluster must
+    still commit everything fork-free."""
+
+    async def run():
+        apps, scheduler, network, shared = make_cluster(
+            tmp_path,
+            config_fn=lambda i: pipe_config(i, request_batch_max_interval=0.05),
+            seed=23,
+        )
+        for a in apps:
+            await a.start()
+        for i in (1, 2, 3, 4):
+            network.nodes[i].lose_messages(0.05)
+        for k in range(20):
+            await apps[0].submit("c", f"lossy-{k}")
+        await wait_for(
+            lambda: all(committed(a) >= 20 for a in apps), scheduler, 900.0
+        )
+        l0 = [d.proposal.payload for d in apps[0].ledger()]
+        for a in apps[1:]:
+            la = [d.proposal.payload for d in a.ledger()]
+            m = min(len(l0), len(la))
+            assert l0[:m] == la[:m]
+        for a in apps:
+            await a.stop()
+
+    asyncio.run(run())
+
+
 def test_pipelined_soak_with_faults(tmp_path):
     """Soak the window under churn: a follower disconnects mid-stream and
     reconnects (catching up via assists/heartbeat sync), another follower
