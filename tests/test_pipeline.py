@@ -237,6 +237,42 @@ def test_validate_ladder_extension_without_first_rung_rejected():
         validate_in_flight_ladder(bad, 5)
 
 
+# -- InFlightData window semantics -------------------------------------------
+
+def test_in_flight_window_sync_pruning_keeps_live_rungs():
+    """A sync that covers part of the window drops only the covered rungs;
+    rungs above stay reportable (their broadcast commits must remain in
+    ViewData for the ladder's quorum-intersection argument).  A sync that
+    covers EVERYTHING also clears the legacy singular slot."""
+    from smartbft_tpu.core.util import InFlightData
+
+    inf = InFlightData()
+    for seq in (5, 6, 7):
+        inf.store_proposal_at(seq, proposal(seq))
+        inf.store_prepares_at(seq)
+    # PersistedState keeps writing the legacy singular on every save
+    inf.store_proposal(proposal(7))
+
+    inf.prune_synced(5)
+    assert [s for s, _, _ in inf.ladder()] == [6, 7]
+    assert inf.in_flight_proposal() == proposal(6)  # lowest live rung
+
+    inf.prune_synced(9)  # covers the whole window
+    assert inf.ladder() == []
+    assert inf.in_flight_proposal() is None  # stale singular cleared too
+
+
+def test_in_flight_window_delivery_drain_clears_stale_singular():
+    from smartbft_tpu.core.util import InFlightData
+
+    inf = InFlightData()
+    inf.store_proposal_at(3, proposal(3))
+    inf.store_proposal(proposal(3))  # legacy singular written at save time
+    inf.clear_below(4)  # normal delivery drain empties the window
+    assert inf.ladder() == []
+    assert inf.in_flight_proposal() is None
+
+
 # -- config gates ------------------------------------------------------------
 
 def test_pipeline_depth_requires_rotation_off():
