@@ -6,12 +6,17 @@ Prints ONE JSON line:
 
 The device row runs the full consensus cluster (64 replicas, RequestBatch
 500, real P-256 signatures on every commit vote, group-commit WALs) with
-the pipelined in-flight window (pipeline_depth=8) and the shared device
-verify engine + dedupe coalescer; the baseline row is the SAME cluster at
-its best CPU configuration: OpenSSL verify (the reference's Go
-crypto/ecdsa class, /root/reference/internal/bft/view.go:537-541) at
-pipeline_depth=1 (pipelining measurably hurts the GIL-serialized CPU
-verify path, so k=1 is the baseline's best foot forward).
+the pipelined in-flight window (pipeline_depth=16, launch-shadow overlap)
+in SUSTAINED-BURST mode (32 back-to-back decisions, so the first launch's
+fixed cost is amortized over the burst) and the shared device verify
+engine + dedupe coalescer; the baseline row is the SAME cluster at its
+best CPU configuration: OpenSSL verify (the reference's Go crypto/ecdsa
+class, /root/reference/internal/bft/view.go:537-541) at pipeline_depth=1
+(pipelining measurably hurts the GIL-serialized CPU verify path, so k=1
+is the baseline's best foot forward).  Every row records its warm-launch
+probe (launch_probe_ms) and the output carries BOTH the raw ratio and the
+probe-normalized ratio (projected to the rig's historical 110 ms launch
+floor) so cross-round comparisons survive tunnel weather.
 
 Platform: uses whatever JAX platform the environment provides (the axon
 TPU tunnel on the driver; CPU elsewhere).  A subprocess probe guards
@@ -22,8 +27,10 @@ runs instead so the driver always records a line.
 
 Env knobs: SMARTBFT_BENCH_E2E=0 forces the kernel micro bench;
 SMARTBFT_BENCH_NODES / SMARTBFT_BENCH_REQUESTS / SMARTBFT_BENCH_PIPELINE
-resize the cluster; SMARTBFT_BENCH_BATCH / SMARTBFT_BENCH_REPS /
-SMARTBFT_BN_UNROLL tune the kernel micro bench as before.
+/ SMARTBFT_BENCH_DECISIONS (sustained-burst length, 0 = legacy
+request-count mode) resize the cluster; SMARTBFT_BENCH_BATCH /
+SMARTBFT_BENCH_REPS / SMARTBFT_BN_UNROLL tune the kernel micro bench as
+before.
 """
 
 from __future__ import annotations
@@ -174,20 +181,55 @@ def _run_throughput_row(extra_args: list[str], cpu_mode: bool,
     return rows[-1]
 
 
+#: historical best warm-launch probe on this rig (ms) — the normalization
+#: anchor for weather-independent cross-round ratio comparisons
+LAUNCH_PROBE_FLOOR_MS = 110.0
+
+
+def _probe_normalized_tx(row: dict) -> float:
+    """Project a row's tx/s to the rig's historical launch floor: subtract
+    the excess (probe - floor) paid on each launch from the elapsed time.
+    Returns 0.0 when the row lacks the inputs (old rows, no launches)."""
+    probe = row.get("launch_probe_ms") or 0.0
+    launches = row.get("launches") or 0
+    elapsed = row.get("elapsed_s") or 0.0
+    tx = row.get("tx_per_sec") or 0.0
+    if not (probe and launches and elapsed and tx):
+        return 0.0
+    excess_s = launches * max(probe - LAUNCH_PROBE_FLOOR_MS, 0.0) / 1e3
+    adj = elapsed - excess_s
+    if adj <= 0:
+        return 0.0
+    return round(tx * elapsed / adj, 1)
+
+
 def e2e_bench(cpu_mode: bool) -> None:
-    """The north-star metric: device cluster vs best-CPU cluster."""
+    """The north-star metric: device cluster vs best-CPU cluster.
+
+    Sustained-burst protocol (round 6): both rows commit
+    SMARTBFT_BENCH_DECISIONS (default 32) back-to-back decisions so the
+    first launch's fixed cost is actually amortized; every row carries the
+    warm-launch probe (launch_probe_ms) and the output reports the raw AND
+    the probe-normalized ratio (tunnel-weather-independent)."""
     nodes = int(os.environ.get(
         "SMARTBFT_BENCH_NODES", "16" if cpu_mode else "64"))
     requests = int(os.environ.get(
         "SMARTBFT_BENCH_REQUESTS", "1200" if cpu_mode else "4000"))
-    pipeline = int(os.environ.get("SMARTBFT_BENCH_PIPELINE", "8"))
+    decisions = int(os.environ.get("SMARTBFT_BENCH_DECISIONS", "32"))
+    pipeline = int(os.environ.get("SMARTBFT_BENCH_PIPELINE", "16"))
     timeout = float(os.environ.get("SMARTBFT_BENCH_E2E_TIMEOUT", "580"))
+    # rigs without the `cryptography` wheel can still run the e2e with the
+    # pure-Python CPU engine (SMARTBFT_BENCH_CPU_ENGINE=host) — the ratio
+    # is then NOT comparable to the OpenSSL baseline, only the row shape
+    cpu_engine = os.environ.get("SMARTBFT_BENCH_CPU_ENGINE", "openssl")
     common = ["--nodes", str(nodes), "--requests", str(requests),
               "--batch", "500"]
-    _log(f"bench: e2e n={nodes} requests={requests} pipeline={pipeline} "
-         f"(cpu_mode={cpu_mode})")
+    if decisions > 0:
+        common += ["--burst-decisions", str(decisions)]
+    _log(f"bench: e2e n={nodes} requests={requests} decisions={decisions} "
+         f"pipeline={pipeline} (cpu_mode={cpu_mode})")
     cpu_row = _run_throughput_row(
-        common + ["--engines", "openssl", "--pipeline", "1"],
+        common + ["--engines", cpu_engine, "--pipeline", "1"],
         cpu_mode=False, timeout=timeout,  # openssl row needs no device
     )
     _log(f"bench: cpu-best row {cpu_row}")
@@ -196,6 +238,7 @@ def e2e_bench(cpu_mode: bool) -> None:
         cpu_mode=cpu_mode, timeout=timeout,
     )
     _log(f"bench: device row {dev_row}")
+    norm_tx = _probe_normalized_tx(dev_row)
     print(json.dumps({
         "metric": f"committed_tx_per_sec_n{nodes}",
         "value": dev_row["tx_per_sec"],
@@ -204,8 +247,18 @@ def e2e_bench(cpu_mode: bool) -> None:
         if cpu_row["tx_per_sec"] else 0.0,
         "baseline_tx_per_sec": cpu_row["tx_per_sec"],
         "pipeline": pipeline,
+        "burst_decisions": decisions,
         "launches": dev_row.get("launches"),
         "decisions": dev_row.get("decisions"),
+        "launches_per_decision": dev_row.get("launches_per_decision"),
+        "window_launches": dev_row.get("window_launches"),
+        "batch_fill_pct": dev_row.get("batch_fill_pct"),
+        "launch_probe_ms": dev_row.get("launch_probe_ms"),
+        "baseline_launch_probe_ms": cpu_row.get("launch_probe_ms"),
+        "tx_per_sec_probe_normalized": norm_tx,
+        "vs_baseline_probe_normalized": round(
+            norm_tx / cpu_row["tx_per_sec"], 3)
+        if norm_tx and cpu_row["tx_per_sec"] else 0.0,
     }), flush=True)
 
 

@@ -123,7 +123,13 @@ async def run_cluster(engine_kind: str, n: int, requests: int, batch: int,
                       pad_sizes, scheme_name: str = "p256",
                       share_engine: bool = False,
                       dedupe: bool = False,
-                      pipeline: int = 1) -> dict:
+                      pipeline: int = 1,
+                      burst_decisions: int = 0) -> dict:
+    """``burst_decisions`` > 0 enables the sustained-burst mode: the request
+    count is sized to commit that many decisions back to back (decisions x
+    batch requests submitted up front), so the FIRST launch's fixed cost is
+    amortized over a long window train instead of a single window, and the
+    JSON row carries per-window launch counts."""
     import dataclasses
 
     from smartbft_tpu.crypto.provider import AsyncBatchCoalescer, Keyring
@@ -133,6 +139,8 @@ async def run_cluster(engine_kind: str, n: int, requests: int, batch: int,
 
     scheme = get_scheme(scheme_name)
     provider_cls = get_provider_cls(scheme_name)
+    if burst_decisions > 0:
+        requests = burst_decisions * batch
 
     def cfg(i):
         pipe = {}
@@ -165,10 +173,11 @@ async def run_cluster(engine_kind: str, n: int, requests: int, batch: int,
         # kernel launch costs ~100ms over the tunnel, so waiting ~20ms to
         # merge every replica's quorum check into ONE launch is cheap
         window = float(os.environ.get("SMARTBFT_BENCH_WINDOW", "0.02"))
-        # pipelined mode: up to `pipeline` decisions' quorum waves coalesce
-        # into one flush — max_batch must not force-flush a single wave
+        # pipelined mode: up to 2*`pipeline` decisions' quorum waves (base
+        # window + launch shadow) coalesce into one flush — max_batch must
+        # not force-flush a single wave
         coalescer = AsyncBatchCoalescer(one, window=window,
-                                        max_batch=pipeline * max(pad_sizes),
+                                        max_batch=2 * pipeline * max(pad_sizes),
                                         dedupe=dedupe)
         coalescers = {i: coalescer for i in node_ids}
     else:
@@ -176,16 +185,16 @@ async def run_cluster(engine_kind: str, n: int, requests: int, batch: int,
                    for i in node_ids}
         coalescers = {i: None for i in node_ids}
 
-    # pre-warm every engine at every lane size so no XLA compile lands
-    # inside the timed window
+    # warm with a RING key: a foreign key would grow the comb-table
+    # registry past the membership (65 keys -> npad 128) and force a
+    # recompile of every padded shape mid-run
+    sk, pub = scheme.keygen(b"bench-tput-1")
+    item = scheme.make_item(
+        b"warm-msg", scheme.sign_raw(sk, b"warm-msg"), pub
+    )
+    # pre-warm every device engine at every lane size so no XLA compile
+    # lands inside the timed window
     if engine_kind in ("jax", "sharded", "sharded2d"):
-        # warm with a RING key: a foreign key would grow the comb-table
-        # registry past the membership (65 keys -> npad 128) and force a
-        # recompile of every padded shape mid-run
-        sk, pub = scheme.keygen(b"bench-tput-1")
-        item = scheme.make_item(
-            b"warm-msg", scheme.sign_raw(sk, b"warm-msg"), pub
-        )
         for eng in set(engines.values()):
             if hasattr(eng, "prewarm_keys"):
                 eng.prewarm_keys(
@@ -198,19 +207,23 @@ async def run_cluster(engine_kind: str, n: int, requests: int, batch: int,
         _log(f"bench[{engine_kind}/{scheme_name}]: pre-warmed pad sizes "
              f"{tuple(pad_sizes)} on {len(set(engines.values()))} engine(s) "
              f"in {time.perf_counter() - t0:.1f}s")
-        # measure the steady-state per-launch overhead (tunnel RTT + pad):
-        # one warm launch at the smallest pad size
-        t0 = time.perf_counter()
-        for _ in range(3):
-            eng.verify([item])
-        launch_s = (time.perf_counter() - t0) / 3
-        _log(f"bench[{engine_kind}/{scheme_name}]: warm launch overhead "
-             f"{1e3 * launch_s:.1f} ms")
-        # drop warm-up traffic from the reported stats
-        from smartbft_tpu.crypto.provider import VerifyStats
+    # measure the steady-state per-launch overhead (device: tunnel RTT +
+    # pad; host engines: one warm single-item verify) for EVERY engine kind
+    # — launch_probe_ms in the JSON row is what lets ratios be
+    # weather-normalized across measurement days (VERDICT round-5 item 6)
+    probe_eng = engines[node_ids[0]]
+    probe_eng.verify([item])  # warm the single-item shape itself
+    t0 = time.perf_counter()
+    for _ in range(3):
+        probe_eng.verify([item])
+    launch_probe_ms = 1e3 * (time.perf_counter() - t0) / 3
+    _log(f"bench[{engine_kind}/{scheme_name}]: warm launch overhead "
+         f"{launch_probe_ms:.1f} ms")
+    # drop warm-up/probe traffic from the reported stats
+    from smartbft_tpu.crypto.provider import VerifyStats
 
-        for eng in set(engines.values()):
-            eng.stats = VerifyStats()
+    for eng in set(engines.values()):
+        eng.stats = VerifyStats()
 
     scheduler = Scheduler()
     driver = WallClockDriver(scheduler, tick_interval=0.01)
@@ -241,7 +254,20 @@ async def run_cluster(engine_kind: str, n: int, requests: int, batch: int,
                 len(app.requests_from_proposal(d.proposal)) for d in app.ledger()
             )
 
+        # per-window launch sampling: snapshot the launch counter each time
+        # the leader's ledger crosses a k-decision window boundary, so the
+        # row shows how the coalescer amortizes launches ACROSS the burst
+        # (window_launches[i] = launches during the i-th window of k
+        # decisions), not just the end-to-end total
+        stats_eng = engines[node_ids[1]]  # follower / shared engine
+        window_size = max(1, pipeline)
+        marks: list[int] = []
+        next_mark = window_size
         while time.perf_counter() < deadline:
+            d = len(apps[0].ledger())
+            while d >= next_mark:
+                marks.append(stats_eng.stats.launches)
+                next_mark += window_size
             if all(committed(a) >= target for a in apps):
                 break
             await asyncio.sleep(0.02)
@@ -250,7 +276,12 @@ async def run_cluster(engine_kind: str, n: int, requests: int, batch: int,
         elapsed = time.perf_counter() - t0
 
         decisions = len(apps[0].ledger())
-        stats = engines[node_ids[1]].stats  # follower / shared engine
+        stats = stats_eng.stats
+        if len(marks) * window_size < decisions:
+            marks.append(stats.launches)  # tail window (partial)
+        window_launches = [
+            b - a for a, b in zip([0] + marks[:-1], marks)
+        ]
         return {
             "engine": engine_kind,
             "scheme": scheme_name,
@@ -258,11 +289,16 @@ async def run_cluster(engine_kind: str, n: int, requests: int, batch: int,
             "shared_engine": share_engine,
             "dedupe": dedupe,
             "pipeline": pipeline,
+            "burst_decisions": burst_decisions,
             "tx_per_sec": round(requests / elapsed, 1),
             "decisions": decisions,
             "batch_fill_pct": round(stats.batch_fill_pct, 1),
             "verify_us_per_sig": round(stats.us_per_sig, 1),
             "launches": stats.launches,
+            "launches_per_decision": round(stats.launches / decisions, 3)
+            if decisions else 0.0,
+            "window_launches": window_launches,
+            "launch_probe_ms": round(launch_probe_ms, 2),
             "sigs_verified": stats.sigs_verified,
             "elapsed_s": round(elapsed, 2),
         }
@@ -310,8 +346,15 @@ def main() -> None:
     ap.add_argument("--pipeline", type=int, default=1,
                     help="pipelined in-flight window depth k (k>=2 runs "
                          "rotation-off mode: the leader keeps k sequences "
-                         "outstanding so consecutive quorum waves coalesce "
-                         "into shared device launches)")
+                         "outstanding — up to 2k under the launch shadow — "
+                         "so consecutive quorum waves coalesce into shared "
+                         "device launches)")
+    ap.add_argument("--burst-decisions", type=int, default=0,
+                    help="sustained-burst mode: size the request load to "
+                         "commit this many decisions back to back "
+                         "(overrides --requests with N*batch); the JSON row "
+                         "then carries per-window launch counts so launch "
+                         "amortization over the burst is visible")
     args = ap.parse_args()
     if args.pad_sizes == "auto":
         from smartbft_tpu.crypto.provider import JaxVerifyEngine
@@ -332,10 +375,15 @@ def main() -> None:
             "pad_sizes"].default
         rungs = {s for s in defaults if s < top} | {top}
         if args.pipeline > 1:
-            # deduped steady-state launch for a full k-window: one distinct
-            # signature per replica per decision -> k*n lanes
+            # deduped steady-state launch for a full window train: one
+            # distinct signature per replica per decision, and under the
+            # launch shadow up to 2k decisions' waves can sit in one
+            # coalesced flush -> k*n and 2k*n lanes
             pipe_rung = min(-(-(args.pipeline * n) // block) * block, 16384)
-            rungs |= {pipe_rung}
+            shadow_rung = min(
+                -(-(2 * args.pipeline * n) // block) * block, 16384
+            )
+            rungs |= {pipe_rung, shadow_rung}
         pad_sizes = tuple(sorted(rungs))
     else:
         pad_sizes = tuple(int(x) for x in args.pad_sizes.split(","))
@@ -364,7 +412,8 @@ def main() -> None:
                 run_cluster(kind, args.nodes, args.requests, args.batch,
                             pad_sizes, scheme_name=args.scheme,
                             share_engine=share, dedupe=dedupe,
-                            pipeline=args.pipeline)
+                            pipeline=args.pipeline,
+                            burst_decisions=args.burst_decisions)
             )
         except TimeoutError as exc:
             _log(f"bench[{kind}]: FAILED — {exc}")

@@ -93,10 +93,17 @@ class Configuration:
     # replicas run a per-sequence slot machine with in-order commit
     # broadcast and in-order delivery.  The payoff is batched quorum
     # verification ACROSS decisions: k commit waves coalesce into one
-    # device launch instead of k.  Requires leader_rotation off — the
-    # rotation protocol chains each pre-prepare to the PREVIOUS decision's
-    # commit certificate (view.go:606-647), which a pipelined leader does
-    # not yet hold.  k = 1 is the reference-faithful default.
+    # device launch instead of k.  Under the launch shadow the leader may
+    # keep up to 2k sequences outstanding (it fills window w+1's protocol
+    # plane while window w's verify wave is on device), and replicas hold
+    # at most 3k slots (one extra window of frontier-skew tolerance on
+    # intake) — so the per-view memory bound is 3k slots x one proposal
+    # each.  Deep windows (k=16/32) are the launch-amortization lever; the
+    # validation cap below keeps the slot ladder, the view-change ladder
+    # message, and crash-restore replay bounded.  Requires leader_rotation
+    # off — the rotation protocol chains each pre-prepare to the PREVIOUS
+    # decision's commit certificate (view.go:606-647), which a pipelined
+    # leader does not yet hold.  k = 1 is the reference-faithful default.
     pipeline_depth: int = 1
 
     def validate(self) -> None:
@@ -140,6 +147,13 @@ class Configuration:
             raise ConfigError("decisions_per_leader should be zero when leader rotation is off")
         if self.pipeline_depth < 1:
             raise ConfigError("pipeline_depth should be at least 1")
+        if self.pipeline_depth > 256:
+            raise ConfigError(
+                "pipeline_depth is capped at 256: replicas hold up to "
+                "3*pipeline_depth proposal slots per view (base window + "
+                "launch shadow + intake skew) and the view-change ViewData "
+                "carries one in-flight rung per undelivered sequence"
+            )
         if self.pipeline_depth > 1 and self.leader_rotation:
             raise ConfigError(
                 "pipeline_depth > 1 requires leader_rotation off (the rotation "

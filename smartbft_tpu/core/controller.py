@@ -415,6 +415,15 @@ class Controller(RequestTimeoutHandler):
         self._propose_pending = False  # drain leader token
         if self.curr_view is not None:
             await self.curr_view.abort()
+        # Uncommitted in-flight batches must become proposable again in the
+        # next view.  Batches the view-change ladder DOES redeliver cannot
+        # be double-proposed despite the release: delivery removal runs on
+        # every delivery path and also populates the recently-deleted dedup
+        # map on pool misses, so a released request is either removed before
+        # the new view can batch it (it was pooled here) or rejected at
+        # re-submission/forwarding (ReqAlreadyProcessedError) — pinned by
+        # the exactly-once assertion in the ladder view-change test.
+        self.request_pool.release_in_flight()
         return True
 
     # -- externally invoked transitions ------------------------------------
@@ -445,6 +454,17 @@ class Controller(RequestTimeoutHandler):
             self._propose_pending = True
             self._events.put_nowait(_ProposeEvt())
 
+    def on_window_capacity(self) -> None:
+        """A pipelined view re-opened propose capacity WITHOUT a delivery
+        (its launch-shadow gate unlocked, or a WAL-bounding drain finished).
+        Deliveries re-arm the token in _decide; this seam covers the two
+        windowed transitions that happen between deliveries — otherwise the
+        leader would idle under the in-flight launch with room to propose."""
+        if self._stopped:
+            return
+        if self.i_am_the_leader()[0]:
+            self._acquire_leader_token()
+
     # ------------------------------------------------------------------ propose
 
     async def _propose(self) -> None:
@@ -458,7 +478,9 @@ class Controller(RequestTimeoutHandler):
         view = self.curr_view
         window_has_room = getattr(view, "can_accept_more_proposals", None)
         if window_has_room is not None and not window_has_room():
-            return  # window full; the next delivery re-arms the token
+            # window full: the next delivery (_decide) or the view's
+            # capacity seam (on_window_capacity) re-arms the token
+            return
         next_batch = await self.batcher.next_batch()
         if not next_batch:
             self._acquire_leader_token()  # try again later
@@ -468,8 +490,15 @@ class Controller(RequestTimeoutHandler):
         metadata = view.get_metadata()
         proposal = self.assembler.assemble_proposal(metadata, next_batch)
         view.propose(proposal)
-        if window_has_room is not None and window_has_room():
-            self._acquire_leader_token()
+        if window_has_room is not None:
+            # pipelined mode: reserve the batch until delivery removes it —
+            # the next window slot's batch must be FRESH requests, not the
+            # same FIFO front re-proposed (duplicate delivery otherwise)
+            self.request_pool.mark_in_flight(
+                self.request_inspector.request_id(r) for r in next_batch
+            )
+            if window_has_room():
+                self._acquire_leader_token()
 
     # ------------------------------------------------------------------ loop
 

@@ -47,10 +47,13 @@ class HeartbeatMonitor:
         self._view_sequences = view_sequences
         self._ticks_behind_limit = num_of_ticks_behind_before_syncing
         # pipelined mode: a healthy follower may trail the leader by up to
-        # the window depth while quorums it is not part of complete —
-        # lagging inside the window is the persistent-behind case (counter,
-        # then sync), not the fell-off-the-ledger case (immediate sync)
-        self._lag_tolerance = max(1, pipeline_depth)
+        # TWO window depths (base window + launch shadow) while quorums it
+        # is not part of complete — lagging inside that span is the
+        # persistent-behind case (counter, then sync), not the
+        # fell-off-the-ledger case (immediate sync).  Single-slot mode
+        # (depth 1) has no shadow: keep the reference-faithful tolerance
+        # of 1 so a 2-behind follower still syncs immediately.
+        self._lag_tolerance = 2 * pipeline_depth if pipeline_depth > 1 else 1
 
         self._view = 0
         self._leader_id = 0

@@ -30,6 +30,23 @@ so the waves of k consecutive sequences sit in the coalescer concurrently
 and merge into ONE device launch — the cross-decision batching axis that
 divides the launch floor by the window depth.
 
+**Launch-shadow overlap.**  The propose window is TWO windows deep: the
+leader fills the base window [low, low+k) unconditionally, and once every
+base-window slot has staged its commit — the point where the only work
+left in the base window is the device verify wave plus in-order delivery
+— it keeps proposing into the shadow region [low+k, low+2k).  The shadow
+sequences run their whole protocol plane (pre-prepare, prepares, commit
+staging) UNDER the in-flight launch, and their verify waves accumulate in
+the coalescer, flushing the moment the device frees.  Without the shadow
+the protocol plane idles for the full launch duration at every window
+boundary, so the launch cost is serialized with the protocol cost instead
+of hidden behind it.  When shadow capacity opens without a delivery the
+view notifies the Controller through the ``capacity_cb`` seam so the
+leader token re-arms (``Controller.on_window_capacity``).  Message intake
+accepts sequences up to 3k ahead of the delivery frontier — one extra
+window of skew tolerance for replicas whose frontier trails the
+leader's — so slot memory is bounded by 3k slots.
+
 Rotation must be off (config.validate enforces it): the rotation protocol
 chains each pre-prepare to the previous decision's commit certificate
 (view.go:606-647,1022-1062), which a pipelined leader does not hold yet.
@@ -39,11 +56,14 @@ pre-prepares carry no prev-commit signatures, which this class enforces.
 WAL truncation cadence: a ProposedRecord carries the truncate mark only
 when its sequence IS the delivery frontier (mid-window records must
 survive a crash for restore to rebuild the ladder).  Under sustained
-saturation the frontier-aligned append happens only when the pipeline
-drains, so old segments accumulate until a load dip; any dip — including
-the gap between request bursts — truncates.  A deployment that truly
-never dips should bound segment growth by occasionally pausing proposing
-for one window (the cost is one window's latency).
+saturation the frontier-aligned append would otherwise never land, so the
+view bounds segment growth itself: after ``max(8k, 64)`` consecutive
+non-truncating saves it stops admitting new proposals (``_drain_pending``)
+until the window drains; the next proposal then lands at the delivery
+frontier with the truncate mark, old segments are deleted at the next
+file rotation, and proposing resumes.  The cost is one window's latency
+every few dozen decisions; any natural load dip truncates for free and
+resets the counter.
 """
 
 from __future__ import annotations
@@ -135,6 +155,12 @@ class WindowedView:
     the ``phase`` / ``proposal_sequence`` / ``number`` attributes.
     """
 
+    #: WAL-drain trigger: consecutive non-truncating saves before proposing
+    #: pauses for one window so a truncating append can land.  None derives
+    #: max(8 * window, 64); tests/deployments override the class attribute
+    #: to tighten the segment-growth bound.
+    DRAIN_AFTER_SAVES: Optional[int] = None
+
     def __init__(
         self,
         *,
@@ -159,6 +185,7 @@ class WindowedView:
         window: int,
         in_flight=None,
         metrics_view: Optional[ViewMetrics] = None,
+        capacity_cb=None,
     ):
         self.self_id = self_id
         self.n = n
@@ -181,6 +208,10 @@ class WindowedView:
         self.window = max(2, int(window))
         self.in_flight = in_flight
         self.metrics = metrics_view
+        #: called (no args) when propose capacity re-opens WITHOUT a
+        #: delivery — the launch-shadow gate unlocking, or a WAL drain
+        #: completing; the Controller re-arms the leader token on it
+        self.capacity_cb = capacity_cb
 
         # reference-anchored bookkeeping for metadata checks: the expected
         # decisions_in_view of seq s is start_dec + (s - start_seq)
@@ -210,13 +241,22 @@ class WindowedView:
         # wakeup per message — at n=64 that is ~12k hops per decision.
         # Memory stays bounded WITHOUT an inbox cap: vote sets dedup per
         # sender, pre-prepare slots are 1-per-seq, and the window holds at
-        # most 2*window slots.
+        # most 3*window slots (base + launch shadow + intake skew).
         self._work = asyncio.Event()
         self._verify_results: list[tuple] = []
         self._aborted = False
+        self._abort_event = asyncio.Event()
         self._task: Optional[asyncio.Task] = None
         self._verify_tasks: set[asyncio.Task] = set()
         self._restored_broadcasts: list[Message] = []
+
+        # WAL segment-growth bound under saturation (module docstring): a
+        # drain pauses proposing until the window empties so the next
+        # ProposedRecord lands frontier-aligned with the truncate mark
+        self._drain_after = self.DRAIN_AFTER_SAVES or max(8 * self.window, 64)
+        self._saves_since_truncate = 0
+        self._drain_pending = False
+        self._could_accept = True  # last can_accept_more_proposals() edge
 
     # ------------------------------------------------------------------ life
 
@@ -232,6 +272,7 @@ class WindowedView:
         if not self._aborted:
             self._aborted = True
             self._work.set()
+            self._abort_event.set()
 
     async def handle_message_async(self, sender: int, msg: Message) -> None:
         """Async-intake shim: direct ingest never blocks (memory is bounded
@@ -249,7 +290,12 @@ class WindowedView:
                 await self._task
             except asyncio.CancelledError:
                 cur = asyncio.current_task()
-                if not self._task.done() or (cur is not None and cur.cancelling()):
+                # Task.cancelling is 3.11+; on 3.10 a finished view task
+                # means the cancellation was the view's own — swallow it
+                cancelling = getattr(cur, "cancelling", None)
+                if not self._task.done() or (
+                    cancelling is not None and cancelling()
+                ):
                     raise
 
     def get_leader_id(self) -> int:
@@ -278,11 +324,23 @@ class WindowedView:
     # ------------------------------------------------------------------ leader
 
     def can_accept_more_proposals(self) -> bool:
-        """Leader: may another proposal enter the window right now?"""
-        return (
-            not self._aborted
-            and self._next_propose_seq < self.proposal_sequence + self.window
-        )
+        """Leader: may another proposal enter the window right now?
+
+        Base window [low, low+k) is always proposable.  The shadow region
+        [low+k, low+2k) opens only once every base-window slot has staged
+        its commit (commit frontier at the base edge): from that point the
+        base window is waiting purely on the device wave + in-order
+        delivery, so the next window's protocol plane runs in the shadow
+        of the in-flight launch instead of idling behind it."""
+        if self._aborted or self._drain_pending:
+            return False
+        nxt = self._next_propose_seq
+        low = self.proposal_sequence
+        if nxt < low + self.window:
+            return True
+        if nxt >= low + 2 * self.window:
+            return False
+        return self._commit_frontier >= low + self.window - 1
 
     def get_metadata(self) -> bytes:
         """Metadata for the NEXT unproposed sequence (view.go:896-948; the
@@ -379,10 +437,14 @@ class WindowedView:
         if msg_seq < low:
             self._handle_prev_seq_message(msg_seq, sender, m)
             return
-        if msg_seq >= low + 2 * self.window:
+        # intake span = propose span (2 windows: base + launch shadow) + one
+        # window of frontier-skew tolerance, so a replica whose delivery
+        # frontier trails the leader's still accepts shadow pre-prepares
+        span = 3 * self.window
+        if msg_seq >= low + span:
             self.logger.warnf(
                 "%d got message from %d with sequence %d outside window [%d, %d)",
-                self.self_id, sender, msg_seq, low, low + 2 * self.window,
+                self.self_id, sender, msg_seq, low, low + span,
             )
             self._discover_if_sync_needed(sender, m)
             return
@@ -484,6 +546,18 @@ class WindowedView:
         self.phase = self._lowest_phase()
         if self.metrics:
             self.metrics.phase.set(self.phase)
+        # launch-shadow/drain edge: capacity can re-open WITHOUT a delivery
+        # (the base window's last commit staged, or a drain completed) — the
+        # Controller only re-arms the leader token on deliveries, so tell it
+        can_now = self.can_accept_more_proposals()
+        if (
+            can_now
+            and not self._could_accept
+            and self.self_id == self.leader_id
+            and self.capacity_cb is not None
+        ):
+            self.capacity_cb()
+        self._could_accept = can_now
         return progressed
 
     def _lowest_phase(self) -> int:
@@ -746,7 +820,38 @@ class WindowedView:
         floor = slot.seq - self.window
         for s in [s for s in self._sent_history if s < floor]:
             del self._sent_history[s]
-        await self.decider.decide(slot.proposal, signatures, slot.requests)
+        if self._drain_pending and not self.slots:
+            # WAL drain complete: the window is empty, so the next proposal
+            # is frontier-aligned and its ProposedRecord truncates
+            self._drain_pending = False
+            self.logger.infof(
+                "WindowedView %d: window drained at seq %d, proposing resumes "
+                "with a truncating append", self.number, slot.seq,
+            )
+        # Race the decide rendezvous against abort: the controller resolves
+        # the decision future from the SAME loop that processes abort events,
+        # so a view parked here while an abort is dequeued ahead of its
+        # decision would deadlock controller._abort_view (await view.abort()
+        # -> await task -> parked here forever).  On abort the decision stays
+        # queued — it is committed, and the controller loop (or its shutdown
+        # drain) completes the rendezvous after the abort finishes.
+        loop = asyncio.get_running_loop()
+        decide = loop.create_task(
+            self.decider.decide(slot.proposal, signatures, slot.requests)
+        )
+        abort_wait = loop.create_task(self._abort_event.wait())
+        try:
+            await asyncio.wait(
+                {decide, abort_wait}, return_when=asyncio.FIRST_COMPLETED
+            )
+        finally:
+            abort_wait.cancel()
+        if not decide.done():
+            decide.add_done_callback(
+                lambda t: t.cancelled() or t.exception()
+            )
+            raise ViewAborted()
+        decide.result()  # propagate decide failures like the plain await did
         if self._aborted:
             raise ViewAborted()
 
@@ -755,6 +860,23 @@ class WindowedView:
     def _write_state(self, msg, truncate: bool):
         """Write a SavedMessage now; return its durability future (None when
         the write was synchronously durable — blocking WAL or test double)."""
+        if truncate:
+            self._saves_since_truncate = 0
+        else:
+            self._saves_since_truncate += 1
+            if (
+                self._saves_since_truncate >= self._drain_after
+                and not self._drain_pending
+            ):
+                # bound WAL segment growth under saturation: stop admitting
+                # proposals until the window drains, so the next proposal
+                # lands frontier-aligned with the truncate mark
+                self._drain_pending = True
+                self.logger.infof(
+                    "WindowedView %d: %d saves since last WAL truncation, "
+                    "draining the window for a truncating append",
+                    self.number, self._saves_since_truncate,
+                )
         save_nowait = getattr(self.state, "save_nowait", None)
         if save_nowait is not None:
             return save_nowait(msg, truncate=truncate)
@@ -793,8 +915,8 @@ class WindowedView:
                 continue
             if info.view < self.number:
                 continue
-            if info.seq < self.proposal_sequence + 2 * self.window and info.view == self.number:
-                continue
+            if info.seq < self.proposal_sequence + 3 * self.window and info.view == self.number:
+                continue  # inside the intake span: not fell-behind evidence
             self.logger.warnf(
                 "Seen %d votes for digest %s in view %d, sequence %d but I am in view %d and seq %d",
                 count, info.digest, info.view, info.seq, self.number, self.proposal_sequence,

@@ -134,6 +134,16 @@ class Pool:
         self._size_bytes = 0
         self._closed = False
         self._stopped = False
+        # proposed-but-undelivered reservations (pipelined leader only; no
+        # reference counterpart).  The single-slot leader re-batches only
+        # after delivery REMOVED the previous batch, so the FIFO front is
+        # always fresh; a windowed leader batches again while k proposals
+        # are still in flight, and without this set it would re-slice the
+        # SAME front into every window slot — duplicate delivery of every
+        # request up to the window depth.  next_requests skips reserved
+        # items; delivery removal clears them; a view change releases them
+        # (an uncommitted in-flight batch must become proposable again).
+        self._in_flight: set[RequestInfo] = set()
         # recently-deleted dedup: one insertion-ordered dict doubles as
         # membership set and eviction queue (requestpool.go:418-437 keeps a
         # map + slice pair; popping oldest entries from one dict halves the
@@ -211,16 +221,26 @@ class Pool:
     def next_requests(
         self, max_count: int, max_size_bytes: int, check: bool
     ) -> tuple[list[bytes], bool]:
-        """Slice up to (max_count, max_size_bytes) from the FIFO front;
-        ``full`` means calling again cannot grow the batch
-        (requestpool.go:297-332)."""
-        if check and len(self._items) < max_count and self._size_bytes < max_size_bytes:
+        """Slice up to (max_count, max_size_bytes) from the FIFO front,
+        skipping in-flight reservations; ``full`` means calling again cannot
+        grow the batch (requestpool.go:297-332).  The check-mode fast path
+        counts only UNRESERVED items (the bytes bound stays the pool total:
+        a reservation-heavy pool may then return a sub-max batch early,
+        which the batcher treats like a timeout batch — harmless)."""
+        available = len(self._items) - len(self._in_flight)
+        if check and available < max_count and self._size_bytes < max_size_bytes:
             return [], False
         batch: list[bytes] = []
         total = 0
-        for item in self._items.values():
+        # the scan walks past reserved items at the FIFO front (O(k*batch)
+        # set probes per call at full window depth); a skip cursor would
+        # save that but must survive out-of-order removals and releases —
+        # not worth it while the probe is a dict hit per item
+        for info, item in self._items.items():
             if len(batch) >= max_count:
                 break
+            if info in self._in_flight:
+                continue
             req_len = len(item.request)
             if total + req_len > max_size_bytes:
                 return batch, True
@@ -228,6 +248,18 @@ class Pool:
             total += req_len
         full = total >= max_size_bytes or len(batch) == max_count
         return batch, full
+
+    def mark_in_flight(self, infos) -> None:
+        """Reserve proposed-but-undelivered requests: the pipelined leader
+        calls this after every propose so the next window slot batches
+        FRESH requests instead of re-proposing the in-flight front."""
+        self._in_flight.update(infos)
+
+    def release_in_flight(self) -> None:
+        """Drop every reservation (view change / view abort): proposals
+        that did not survive into a commit are proposable again; those that
+        did get removed by delivery anyway."""
+        self._in_flight.clear()
 
     def prune(self, predicate: Callable[[bytes], Optional[Exception]]) -> None:
         """Remove requests failing re-verification (requestpool.go:335-354)."""
@@ -260,6 +292,7 @@ class Pool:
         missing = 0
         removed = False
         for info in infos:
+            self._in_flight.discard(info)
             item = self._items.pop(info, None)
             if item is None:
                 self._move_to_del(info)
@@ -293,6 +326,7 @@ class Pool:
         return missing
 
     def remove_request(self, info: RequestInfo) -> None:
+        self._in_flight.discard(info)
         item = self._items.pop(info, None)
         if item is None:
             self._move_to_del(info)

@@ -133,8 +133,13 @@ class ProposalMaker:
         quorum_size: int,
     ) -> tuple[WindowedView, int]:
         """Pipelined mode: build a WindowedView (pipeline_depth sequences in
-        flight).  The same restore-exactly-once contract as the single-slot
-        path (util.go:305-311)."""
+        flight, up to 2x that under the launch shadow).  The same
+        restore-exactly-once contract as the single-slot path
+        (util.go:305-311).  The decider is the Controller; its
+        ``on_window_capacity`` re-arms the leader token when the view's
+        launch-shadow gate (or a WAL drain) re-opens propose capacity
+        without a delivery — without the seam the leader would idle until
+        the next delivery even though the window has room."""
         view = WindowedView(
             retrieve_checkpoint=self.checkpoint.get,
             n=self.n,
@@ -157,6 +162,7 @@ class ProposalMaker:
             window=self.pipeline_depth,
             in_flight=getattr(self.state, "in_flight", None),
             metrics_view=self.metrics_view,
+            capacity_cb=getattr(self.decider, "on_window_capacity", None),
         )
         self._restore_once_and_publish(view, proposal_sequence)
         self._publish_metrics(view)

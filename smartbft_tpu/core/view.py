@@ -276,8 +276,11 @@ class View:
                 # can never close (the bug showed as a 0%-CPU hang in
                 # asyncio.run's _cancel_all_tasks).
                 cur = asyncio.current_task()
+                # Task.cancelling is 3.11+; on 3.10 a finished view task
+                # means the cancellation was the view's own — swallow it
+                cancelling = getattr(cur, "cancelling", None)
                 if not self._task.done() or (
-                    cur is not None and cur.cancelling()
+                    cancelling is not None and cancelling()
                 ):
                     raise
 

@@ -73,12 +73,16 @@ def validate_in_flight_ladder(vd: ViewData, last_sequence: int) -> None:
     rung 0 sits at last_sequence+1 and every ``in_flight_more[i]`` must be
     the consecutive rung above it.  Raises if invalid."""
     validate_in_flight(vd.in_flight_proposal, last_sequence)
+    # wire invariant FIRST: flag count == rung count always, even when the
+    # rung list is empty — otherwise a ViewData with orphan prepared flags
+    # (empty in_flight_more, non-empty in_flight_more_prepared) passes
+    # validation and the invariant is only accidentally upheld downstream
+    if len(vd.in_flight_more_prepared) != len(vd.in_flight_more):
+        raise ValueError("in flight ladder prepared flags do not match rung count")
     if not vd.in_flight_more:
         return
     if vd.in_flight_proposal is None:
         raise ValueError("in flight ladder extension without a first rung")
-    if len(vd.in_flight_more_prepared) != len(vd.in_flight_more):
-        raise ValueError("in flight ladder prepared flags do not match rung count")
     for i, prop in enumerate(vd.in_flight_more):
         if not prop.metadata:
             raise ValueError("in flight proposal metadata is nil")

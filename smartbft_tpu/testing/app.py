@@ -137,12 +137,16 @@ class App(Application, Assembler, Comm, Signer, Verifier, RequestInspector,
         config: Optional[Configuration] = None,
         use_metrics: bool = False,
         crypto=None,
+        wal_file_size_bytes: Optional[int] = None,
     ):
         self.id = node_id
         self.network = network
         self.shared = shared
         self.scheduler = scheduler
         self.wal_dir = wal_dir
+        # tiny segments force frequent rotation — the WAL-growth soak tests
+        # use this to observe truncation-driven segment deletion quickly
+        self.wal_file_size_bytes = wal_file_size_bytes
         self.config = config or fast_config(node_id)
         self.logger = RecordingLogger(f"app-{node_id}")
         self.lock = threading.Lock()
@@ -329,7 +333,12 @@ class App(Application, Assembler, Comm, Signer, Verifier, RequestInspector,
 
             self._wal = _NopWAL()
             return []
-        self._wal, entries = walmod.initialize_and_read_all(self.wal_dir, self.logger)
+        kw = {}
+        if self.wal_file_size_bytes is not None:
+            kw["file_size_bytes"] = self.wal_file_size_bytes
+        self._wal, entries = walmod.initialize_and_read_all(
+            self.wal_dir, self.logger, **kw
+        )
         return entries
 
     def _latest_metadata(self) -> tuple[ViewMetadata, Proposal, list[Signature]]:

@@ -143,6 +143,12 @@ class FakePool:
     def restart_timers(self) -> None:
         self.timers_restarted += 1
 
+    def mark_in_flight(self, infos) -> None:
+        pass
+
+    def release_in_flight(self) -> None:
+        pass
+
 
 class FakeMonitor:
     def __init__(self):

@@ -17,6 +17,7 @@ from smartbft_tpu.messages import Commit, Prepare, PrePrepare, ViewChange, ViewM
 from smartbft_tpu.testing.app import fast_config, wait_for
 
 from tests.test_basic import make_nodes, start_all, stop_all
+from tests.test_scenarios import depth_fn
 from tests.test_viewchange import vc_config
 
 
@@ -111,13 +112,16 @@ def test_leader_mutates_preprepare_fields(tmp_path, field):
     asyncio.run(run())
 
 
-def test_view_change_cascade_two_dead_leaders(tmp_path):
+@pytest.mark.parametrize("depth", [1, 4], ids=["k1", "k4"])
+def test_view_change_cascade_two_dead_leaders(tmp_path, depth):
     """n=7 (f=2): leaders of views 0 and 1 are both dark, so the view change
     must cascade past view 1 to a live leader and commit with the remaining
-    quorum of 5."""
+    quorum of 5.  At k=4 every cascaded view is a WindowedView."""
 
     async def run():
-        apps, scheduler, network, shared = make_nodes(7, tmp_path, config_fn=vc_config)
+        apps, scheduler, network, shared = make_nodes(
+            7, tmp_path, config_fn=depth_fn(vc_config, depth)
+        )
         await start_all(apps)
         apps[0].disconnect()
         apps[1].disconnect()
@@ -170,13 +174,18 @@ def test_speedup_view_change_joins_at_f_plus_1(tmp_path):
     asyncio.run(run())
 
 
-def test_follower_catches_up_after_partition(tmp_path):
+@pytest.mark.parametrize("depth", [1, 4], ids=["k1", "k4"])
+def test_follower_catches_up_after_partition(tmp_path, depth):
     """A follower partitioned through several decisions reconnects and is
     brought level (heartbeat behind-detection -> sync, or commit-vote
-    evidence; heartbeatmonitor.go:216-257, view.go:758-818)."""
+    evidence; heartbeatmonitor.go:216-257, view.go:758-818).  At k=4 the
+    rejoiner catches up into a live window (pipeline-depth-aware lag
+    tolerance)."""
 
     async def run():
-        apps, scheduler, network, shared = make_nodes(4, tmp_path, config_fn=vc_config)
+        apps, scheduler, network, shared = make_nodes(
+            4, tmp_path, config_fn=depth_fn(vc_config, depth)
+        )
         await start_all(apps)
         await apps[0].submit("c", "r0")
         await wait_for(lambda: all(a.height() >= 1 for a in apps), scheduler)

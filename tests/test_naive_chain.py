@@ -93,8 +93,11 @@ def test_naive_chain_pipelined(tmp_path):
         for n in nodes:
             await n.start()
         try:
-            # burst-submit so the leader actually fills the window
-            for k in range(12):
+            # burst-submit so the leader actually fills the window: 30 txs
+            # at batch 10 = three full blocks even with exactly-once
+            # batching (12 txs used to produce 3+ blocks only because the
+            # un-reserved pool front was re-proposed into every slot)
+            for k in range(30):
                 await nodes[0].submit("bob", f"ptx{k}", payload=b"p")
             import time as _time
 
@@ -109,6 +112,13 @@ def test_naive_chain_pipelined(tmp_path):
                 )
             for node in nodes:
                 naive_chain.verify_chain(node)
+            # exactly-once: no tx appears in two blocks (regression for
+            # the windowed duplicate-proposing bug)
+            txs = [
+                raw for _, transactions, _ in nodes[0].blocks
+                for raw in transactions
+            ]
+            assert len(txs) == len(set(txs)), "duplicate tx across blocks"
         finally:
             for n in nodes:
                 await n.stop()

@@ -39,6 +39,16 @@ def ever_blacklisted(app) -> set[int]:
     return out
 
 
+def depth_fn(base_fn, depth):
+    """Parametrization helper: the same scenario config at pipeline_depth k
+    (k=1 is the reference-faithful single-slot View; k>1 swaps in the
+    WindowedView, exercising the pipelined machinery under the SAME
+    partition/view-change/restart choreography as the core matrix)."""
+    if depth == 1:
+        return base_fn
+    return lambda i: dataclasses.replace(base_fn(i), pipeline_depth=depth)
+
+
 def rotation_config(i):
     # heartbeat/view-change timers looser than vc_config: under host load a
     # rotation view's first heartbeat can slip past a 2s logical timeout,
@@ -96,12 +106,17 @@ def test_multi_view_change_with_no_requests(tmp_path):
     asyncio.run(run())
 
 
-def test_after_decision_leader_in_partition(tmp_path):
+@pytest.mark.parametrize("depth", [1, 4], ids=["k1", "k4"])
+def test_after_decision_leader_in_partition(tmp_path, depth):
     """Decisions are made, THEN the leader partitions; the next view keeps
-    the chain intact (basic_test.go:TestAfterDecisionLeaderInPartition)."""
+    the chain intact (basic_test.go:TestAfterDecisionLeaderInPartition).
+    At k=4 the deposed leader's WindowedView aborts with the window active
+    and the view change must still converge."""
 
     async def run():
-        apps, scheduler, *_ = make_nodes(4, tmp_path, config_fn=vc_config)
+        apps, scheduler, *_ = make_nodes(
+            4, tmp_path, config_fn=depth_fn(vc_config, depth)
+        )
         await start_all(apps)
         for k in range(3):
             await apps[0].submit("c", f"r{k}")
@@ -400,14 +415,19 @@ def test_blacklist_redemption_under_rotation(tmp_path):
     asyncio.run(run())
 
 
-def test_leader_restores_prepared_seq_and_recommits_after_restart(tmp_path):
+@pytest.mark.parametrize("depth", [1, 4], ids=["k1", "k4"])
+def test_leader_restores_prepared_seq_and_recommits_after_restart(tmp_path, depth):
     """The leader reaches PREPARED (Commit record in its WAL) but never
     commits; after a restart it restores the in-flight sequence, re-collects
     commits, delivers, and proposes the NEXT sequence — it never forks or
-    re-proposes seq 1 (basic_test.go:TestLeaderProposeAfterRestartWithoutSync)."""
+    re-proposes seq 1 (basic_test.go:TestLeaderProposeAfterRestartWithoutSync).
+    At k=4 the restart goes through restore_window instead of the tail
+    recovery."""
 
     async def run():
-        apps, scheduler, *_ = make_nodes(4, tmp_path, config_fn=vc_config)
+        apps, scheduler, *_ = make_nodes(
+            4, tmp_path, config_fn=depth_fn(vc_config, depth)
+        )
         await start_all(apps)
         # leader drops all inbound commits: it stays wedged at PREPARED
         apps[0].node.add_filter(lambda msg, src: not isinstance(msg, Commit))
