@@ -76,9 +76,23 @@ class Configuration:
     sync_on_start: bool = False
     speed_up_view_change: bool = False
 
-    # Leader rotation (config.go:77-80)
+    # Leader rotation (config.go:77-80).
+    # rotation_granularity selects the unit decisions_per_leader counts:
+    # - "decision" (reference-faithful): a leader term spans
+    #   decisions_per_leader decisions, and every pre-prepare chains to the
+    #   PREVIOUS decision's commit certificate (view.go:606-647).  Requires
+    #   pipeline_depth == 1 — a pipelined leader proposes s+1 before s's
+    #   certificate exists.
+    # - "window": a leader term spans decisions_per_leader WINDOWS of
+    #   pipeline_depth decisions each, and only the FIRST pre-prepare of
+    #   each window chains (to the last decision of the previous window —
+    #   the window anchor).  Within a window the full k-deep pipeline runs;
+    #   at window boundaries the pipeline drains so the anchor certificate
+    #   exists before the next window opens.  This is how rotation +
+    #   blacklisting co-host with pipeline_depth > 1.
     leader_rotation: bool = True
     decisions_per_leader: int = 3
+    rotation_granularity: str = "decision"
 
     # Request limits (config.go:82-87)
     request_max_bytes: int = 10 * 1024
@@ -154,12 +168,38 @@ class Configuration:
                 "launch shadow + intake skew) and the view-change ViewData "
                 "carries one in-flight rung per undelivered sequence"
             )
-        if self.pipeline_depth > 1 and self.leader_rotation:
+        if self.rotation_granularity not in ("decision", "window"):
             raise ConfigError(
-                "pipeline_depth > 1 requires leader_rotation off (the rotation "
-                "protocol chains pre-prepares to the previous decision's "
-                "commit certificate)"
+                "rotation_granularity should be 'decision' or 'window', "
+                f"got {self.rotation_granularity!r}"
             )
+        if (
+            self.pipeline_depth > 1
+            and self.leader_rotation
+            and self.rotation_granularity != "window"
+        ):
+            raise ConfigError(
+                "pipeline_depth > 1 with leader_rotation requires "
+                "rotation_granularity='window' (per-decision rotation chains "
+                "every pre-prepare to the previous decision's commit "
+                "certificate, which a pipelined leader does not yet hold; "
+                "window granularity chains only at window boundaries)"
+            )
+
+    @property
+    def effective_decisions_per_leader(self) -> int:
+        """decisions_per_leader expressed in DECISIONS regardless of
+        granularity: window granularity multiplies by the window depth so a
+        term spans decisions_per_leader whole windows.  This is the value
+        every get_leader_id / blacklist computation consumes — it must be
+        derived identically on every replica (it is pure config)."""
+        if (
+            self.leader_rotation
+            and self.rotation_granularity == "window"
+            and self.pipeline_depth > 1
+        ):
+            return self.decisions_per_leader * self.pipeline_depth
+        return self.decisions_per_leader
 
     def with_self_id(self, self_id: int) -> "Configuration":
         return replace(self, self_id=self_id)

@@ -33,6 +33,7 @@ from .messages import Message, ViewMetadata
 from .metrics import MetricsBundle
 from .types import Checkpoint, Proposal, Reconfig, Signature, SyncResponse
 from .utils.clock import Scheduler, Ticker, WallClockDriver
+from .utils.tasks import create_logged_task
 
 
 class Consensus:
@@ -172,8 +173,9 @@ class Consensus:
             self.metadata.decisions_in_view,
         )
 
-        self._run_task = self._loop.create_task(
-            self._run(), name=f"consensus-{self.config.self_id}"
+        self._run_task = create_logged_task(
+            self._run(), name=f"consensus-{self.config.self_id}",
+            logger=self.logger,
         )
 
         if self._own_scheduler:
@@ -321,7 +323,9 @@ class Consensus:
             n=self.num_nodes,
             nodes_list=self.nodes,
             leader_rotation=self.config.leader_rotation,
-            decisions_per_leader=self.config.decisions_per_leader,
+            # window granularity pre-multiplies by the window depth so every
+            # get_leader_id / blacklist computation stays reference-shaped
+            decisions_per_leader=self.config.effective_decisions_per_leader,
             speed_up_view_change=self.config.speed_up_view_change,
             logger=self.logger,
             signer=self.signer,
@@ -350,7 +354,7 @@ class Consensus:
             n=self.num_nodes,
             nodes_list=self.nodes,
             leader_rotation=self.config.leader_rotation,
-            decisions_per_leader=self.config.decisions_per_leader,
+            decisions_per_leader=self.config.effective_decisions_per_leader,
             request_pool=self.pool,  # set for real in _create_pool on first start
             batcher=None,
             leader_monitor=None,
@@ -386,7 +390,7 @@ class Consensus:
     def _proposal_maker(self, view_sequences: ViewSequencesHolder) -> ProposalMaker:
         """consensus.go:319-340."""
         return ProposalMaker(
-            decisions_per_leader=self.config.decisions_per_leader,
+            decisions_per_leader=self.config.effective_decisions_per_leader,
             checkpoint=self.checkpoint,
             state=self.state,
             comm=self.controller,

@@ -46,10 +46,19 @@ from ..messages import (
     ViewMetadata,
 )
 from ..metrics import ConsensusMetrics, ViewMetrics
-from ..types import Checkpoint, Proposal, Reconfig, RequestInfo, ViewAndSeq, cached_view_metadata
+from ..types import (
+    Checkpoint,
+    Proposal,
+    Reconfig,
+    RequestInfo,
+    ViewAndSeq,
+    blacklist_of,
+    cached_view_metadata,
+)
 from .pool import Pool, RequestTimeoutHandler, remove_delivered_requests
 from .state import ABORT, COMMITTED
 from .util import InFlightData, compute_quorum, get_leader_id
+from ..utils.tasks import create_logged_task
 from .view import (
     ViewSequence,
     ViewSequencesHolder,
@@ -177,9 +186,7 @@ class Controller(RequestTimeoutHandler):
 
     def blacklist(self) -> list[int]:
         prop, _ = self.checkpoint.get()
-        if not prop.metadata:
-            return []
-        return list(cached_view_metadata(prop.metadata).black_list)
+        return blacklist_of(prop)
 
     def latest_seq(self) -> int:
         prop, _ = self.checkpoint.get()
@@ -589,7 +596,15 @@ class Controller(RequestTimeoutHandler):
             self._acquire_leader_token()
 
     def _check_if_rotate(self, blacklist: list[int]) -> bool:
-        """controller.go:560-574 (called after increment)."""
+        """controller.go:560-574 (called after increment).
+
+        ``decisions_per_leader`` is the EFFECTIVE per-decision value
+        (window granularity pre-multiplies by pipeline_depth), so in
+        pipelined rotation mode this fires exactly at window boundaries —
+        the just-delivered decision is then the window anchor, the windowed
+        view has drained (its propose gate confines the window to the
+        delivery frontier's window), and no in-flight sequence above the
+        anchor can hold a commit quorum when the view is torn down."""
         view = self.curr_view_number
         dec = self.curr_decisions_in_view
         curr_leader = get_leader_id(
@@ -761,8 +776,8 @@ class Controller(RequestTimeoutHandler):
         self.curr_view_number = start_view_number
         self.curr_decisions_in_view = start_decisions_in_view
         self._start_view(start_proposal_sequence)
-        self._task = asyncio.get_running_loop().create_task(
-            self._run(), name=f"controller-{self.id}"
+        self._task = create_logged_task(
+            self._run(), name=f"controller-{self.id}", logger=self.logger
         )
 
     def close(self) -> None:

@@ -47,10 +47,27 @@ accepts sequences up to 3k ahead of the delivery frontier — one extra
 window of skew tolerance for replicas whose frontier trails the
 leader's — so slot memory is bounded by 3k slots.
 
-Rotation must be off (config.validate enforces it): the rotation protocol
-chains each pre-prepare to the previous decision's commit certificate
-(view.go:606-647,1022-1062), which a pipelined leader does not hold yet.
-With ``decisions_per_leader == 0`` the blacklist is empty by protocol and
+**Window-granular rotation** (``rotation_granularity='window'``): the
+reference rotation protocol chains each pre-prepare to the PREVIOUS
+decision's commit certificate (view.go:606-647,1022-1062), which a
+pipelined leader does not hold yet — so per-decision chaining and
+pipelining are mutually exclusive.  Instead of abandoning rotation, the
+windowed view anchors the chain on the LAST DECISION OF EACH WINDOW: only
+the first pre-prepare of a window carries prev-commit signatures (the
+previous window's anchor certificate, read from the checkpoint) plus the
+recomputed blacklist; every other proposal in the window carries the SAME
+blacklist and an empty certificate, which followers enforce.  Window
+boundaries are defined by the cluster-agreed per-view decision count
+(``decisions_in_view % k == 0``), so they are identical on every replica
+— including one that crash-restarts mid-window or joins by sync.  The
+cost: the pipeline drains at each window boundary (the anchor must
+DELIVER before the next window's first proposal can be built or
+verified), so the launch shadow does not cross boundaries in rotation
+mode.  ``decisions_per_leader`` is interpreted in windows — config
+pre-multiplies it into decisions (Configuration.
+effective_decisions_per_leader) so every get_leader_id/blacklist
+computation stays reference-shaped.  With rotation off
+(``decisions_per_leader == 0``) the blacklist is empty by protocol and
 pre-prepares carry no prev-commit signatures, which this class enforces.
 
 WAL truncation cadence: a ProposedRecord carries the truncate mark only
@@ -87,10 +104,12 @@ from ..messages import (
     Signature,
     ViewMetadata,
 )
-from ..metrics import ViewMetrics
-from ..types import proposal_digest
+from ..metrics import BlacklistMetrics, ViewMetrics
+from ..types import blacklist_of, cached_view_metadata, proposal_digest
+from .rotation import RotationState
 from .state import ABORT, COMMITTED, PREPARED, PROPOSED
 from .util import VoteSet, compute_quorum
+from ..utils.tasks import create_logged_task
 from .view import (
     ViewAborted,
     ViewSequence,
@@ -148,7 +167,8 @@ class _ProposalInfo:
 
 
 class WindowedView:
-    """Drop-in View replacement for ``pipeline_depth >= 2`` (rotation off).
+    """Drop-in View replacement for ``pipeline_depth >= 2`` (static leader
+    or window-granular rotation).
 
     Same interface the Controller and ViewChanger consume: handle_message /
     start / abort / stopped / propose / get_metadata / get_leader_id plus
@@ -186,6 +206,9 @@ class WindowedView:
         in_flight=None,
         metrics_view: Optional[ViewMetrics] = None,
         capacity_cb=None,
+        decisions_per_leader: int = 0,
+        membership_notifier=None,
+        metrics_blacklist: Optional[BlacklistMetrics] = None,
     ):
         self.self_id = self_id
         self.n = n
@@ -217,6 +240,39 @@ class WindowedView:
         # decisions_in_view of seq s is start_dec + (s - start_seq)
         self._start_seq = proposal_sequence
         self._start_dec = decisions_in_view
+
+        # window-granular rotation (decisions_per_leader is the EFFECTIVE
+        # per-decision value, i.e. config decisions_per_leader x window)
+        self.decisions_per_leader = decisions_per_leader
+        self.rotation = decisions_per_leader > 0
+        self._rotation = RotationState(
+            self_id=self_id,
+            n=n,
+            nodes_list=nodes_list,
+            leader_id=leader_id,
+            get_view_number=lambda: self.number,
+            decisions_per_leader=decisions_per_leader,
+            verifier=verifier,
+            retrieve_checkpoint=retrieve_checkpoint,
+            membership_notifier=membership_notifier,
+            logger=logger,
+            metrics_blacklist=metrics_blacklist,
+        )
+        # the blacklist established by the current window's FIRST proposal:
+        # followers require every later proposal in the window to match it
+        # (_staged_blacklist tracks the staging frontier) and the leader
+        # stamps it into mid-window metadata (_proposing_blacklist).  Both
+        # seed from the checkpoint — mid-window (re)starts land between two
+        # boundary recomputations, and every delivered proposal of a window
+        # carries that window's blacklist, so the checkpoint metadata IS the
+        # current window blacklist.
+        ckpt_bl: list[int] = []
+        if self.rotation:
+            ckpt_prop, _ = retrieve_checkpoint()
+            if ckpt_prop is not None:
+                ckpt_bl = blacklist_of(ckpt_prop)
+        self._staged_blacklist: list[int] = list(ckpt_bl)
+        self._proposing_blacklist: list[int] = list(ckpt_bl)
 
         #: exposed for the Controller's init-phase logic; tracks the lowest
         #: undelivered slot (COMMITTED when none)
@@ -261,8 +317,9 @@ class WindowedView:
     # ------------------------------------------------------------------ life
 
     def start(self) -> None:
-        self._task = asyncio.get_running_loop().create_task(
-            self._run(), name=f"wview-{self.self_id}-{self.number}"
+        self._task = create_logged_task(
+            self._run(), name=f"wview-{self.self_id}-{self.number}",
+            logger=self.logger,
         )
 
     def stopped(self) -> bool:
@@ -321,21 +378,61 @@ class WindowedView:
             self._stop()
         self._work.set()
 
+    # ------------------------------------------------------------------ windows
+
+    def _dec_of(self, seq: int) -> int:
+        """Cluster-agreed decisions_in_view of ``seq`` (verified in
+        _verify_proposal, so every replica derives the same value)."""
+        return self._start_dec + (seq - self._start_seq)
+
+    def _is_window_first(self, seq: int) -> bool:
+        """Rotation mode: is ``seq`` the first decision of a window?  The
+        grid is anchored on the per-view decision count, NOT on this view
+        object's construction point — a mid-window crash-restart or sync
+        constructs the view mid-grid and must agree with the cluster."""
+        return self._dec_of(seq) % self.window == 0
+
+    def _checkpoint_at(self, seq: int) -> bool:
+        """True iff the checkpoint holds exactly the decision below ``seq``
+        — the anchor a window-first pre-prepare chains to.  On the propose
+        hot path, so the metadata decode rides the bounded cache."""
+        prop, _ = self.retrieve_checkpoint()
+        latest = 0
+        if prop is not None and prop.metadata:
+            latest = cached_view_metadata(prop.metadata).latest_sequence
+        return latest == seq - 1
+
     # ------------------------------------------------------------------ leader
 
     def can_accept_more_proposals(self) -> bool:
         """Leader: may another proposal enter the window right now?
 
-        Base window [low, low+k) is always proposable.  The shadow region
-        [low+k, low+2k) opens only once every base-window slot has staged
-        its commit (commit frontier at the base edge): from that point the
-        base window is waiting purely on the device wave + in-order
-        delivery, so the next window's protocol plane runs in the shadow
-        of the in-flight launch instead of idling behind it."""
+        Rotation off — base window [low, low+k) is always proposable, and
+        the shadow region [low+k, low+2k) opens only once every base-window
+        slot has staged its commit (commit frontier at the base edge): from
+        that point the base window is waiting purely on the device wave +
+        in-order delivery, so the next window's protocol plane runs in the
+        shadow of the in-flight launch instead of idling behind it.
+
+        Rotation on (window granularity) — proposing is confined to the
+        delivery frontier's window: the next window's first pre-prepare
+        chains to THIS window's anchor certificate, which exists only once
+        the anchor has delivered (and the checkpoint advanced to it).  The
+        pipeline therefore drains at each boundary; no launch shadow
+        crosses it."""
         if self._aborted or self._drain_pending:
             return False
         nxt = self._next_propose_seq
         low = self.proposal_sequence
+        if self.rotation:
+            if self._dec_of(nxt) // self.window != self._dec_of(low) // self.window:
+                return False
+            if self._is_window_first(nxt) and not self._checkpoint_at(nxt):
+                # the delivery frontier can run ahead of the checkpoint by
+                # one decide rendezvous (proposal_sequence advances before
+                # the controller delivers); the chain needs the certificate
+                return False
+            return True
         if nxt < low + self.window:
             return True
         if nxt >= low + 2 * self.window:
@@ -343,27 +440,42 @@ class WindowedView:
         return self._commit_frontier >= low + self.window - 1
 
     def get_metadata(self) -> bytes:
-        """Metadata for the NEXT unproposed sequence (view.go:896-948; the
-        rotation-off path has an empty blacklist and no prev-commit digest,
-        so no blacklist recomputation happens here)."""
-        return encode(
-            ViewMetadata(
-                view_id=self.number,
-                latest_sequence=self._next_propose_seq,
-                decisions_in_view=self._start_dec
-                + (self._next_propose_seq - self._start_seq),
-            )
+        """Metadata for the NEXT unproposed sequence (view.go:896-948).
+
+        Rotation off: empty blacklist, no prev-commit digest.  Rotation on:
+        a window-first sequence recomputes the blacklist from the anchor
+        checkpoint and binds the anchor certificate digest (exactly the
+        single-slot per-decision flow, once per window); mid-window
+        sequences restate the window blacklist with no certificate."""
+        nxt = self._next_propose_seq
+        metadata = ViewMetadata(
+            view_id=self.number,
+            latest_sequence=nxt,
+            decisions_in_view=self._dec_of(nxt),
         )
+        if not self.rotation:
+            return encode(metadata)
+        if self._is_window_first(nxt):
+            metadata = self._rotation.build_leader_metadata(metadata)
+            self._proposing_blacklist = list(metadata.black_list)
+        else:
+            metadata = replace(metadata, black_list=list(self._proposing_blacklist))
+        return encode(metadata)
 
     def propose(self, proposal: Proposal) -> None:
         """Leader: wrap as pre-prepare for the next window sequence and
         self-deliver first (WAL-first, view.go:951-977).  The broadcast to
-        peers happens after the slot persists the ProposedRecord."""
+        peers happens after the slot persists the ProposedRecord.  In
+        rotation mode a window-first pre-prepare carries the previous
+        window's anchor certificate (the checkpoint signatures)."""
+        prev_sigs: list[Signature] = []
+        if self.rotation and self._is_window_first(self._next_propose_seq):
+            _, prev_sigs = self.retrieve_checkpoint()
         pp = PrePrepare(
             view=self.number,
             seq=self._next_propose_seq,
             proposal=proposal,
-            prev_commit_signatures=[],
+            prev_commit_signatures=list(prev_sigs),
         )
         self._next_propose_seq += 1
         if not self._aborted:
@@ -519,8 +631,17 @@ class WindowedView:
                 slot.phase == COMMITTED
                 and slot.pre_prepare is not None
                 and seq == self._prepare_frontier + 1
+                # rotation: a window-first pre-prepare chains to the previous
+                # window's anchor certificate — hold it until every lower
+                # sequence has DELIVERED locally (the checkpoint then sits
+                # exactly at the anchor, making the chain verifiable)
+                and (
+                    not self.rotation
+                    or not self._is_window_first(seq)
+                    or seq == self.proposal_sequence
+                )
             ):
-                staged.append(self._stage_proposal(slot))
+                staged.append(await self._stage_proposal(slot))
                 progressed = True
             if (
                 slot.phase == PROPOSED
@@ -570,14 +691,15 @@ class WindowedView:
 
     # -- phase 1: proposal --------------------------------------------------
 
-    def _stage_proposal(self, slot: _Slot):
+    async def _stage_proposal(self, slot: _Slot):
         """COMMITTED -> PROPOSED for one slot (view.go:351-427), split into
         stage (verify + WAL write now) and finalize (sends, after the shared
-        durability wave)."""
+        durability wave).  Async because a rotation-mode window-first slot
+        batch-verifies the anchor certificate it chains to."""
         pp = slot.pre_prepare
         proposal = pp.proposal
         try:
-            requests = self._verify_proposal(slot, pp)
+            requests = await self._verify_proposal(slot, pp)
         except Exception as e:
             self.logger.warnf(
                 "%d received bad proposal from %d at seq %d: %s",
@@ -617,8 +739,10 @@ class WindowedView:
 
         return fut, finalize
 
-    def _verify_proposal(self, slot: _Slot, pp: PrePrepare) -> list:
-        """view.go:553-607 for the rotation-off pipelined mode."""
+    async def _verify_proposal(self, slot: _Slot, pp: PrePrepare) -> list:
+        """view.go:553-607 adapted to the window: structural + metadata
+        checks for every slot; certificate-chain + blacklist verification at
+        window boundaries (rotation mode) or rotation-off invariants."""
         proposal = pp.proposal
         requests = self.verifier.verify_proposal(proposal)
         md = decode(ViewMetadata, proposal.metadata)
@@ -628,7 +752,7 @@ class WindowedView:
             raise ValueError(
                 f"invalid proposal sequence: expected {slot.seq} got {md.latest_sequence}"
             )
-        expected_dec = self._start_dec + (slot.seq - self._start_seq)
+        expected_dec = self._dec_of(slot.seq)
         if md.decisions_in_view != expected_dec:
             raise ValueError(
                 f"invalid decisions in view: expected {expected_dec} got {md.decisions_in_view}"
@@ -639,16 +763,50 @@ class WindowedView:
                 f"verification sequence mismatch: expected {expected_seq} "
                 f"got {proposal.verification_sequence}"
             )
-        # rotation-off invariants (config.validate pins decisions_per_leader
-        # to 0 when pipelining): no blacklist, no prev-commit chaining
-        if list(md.black_list):
-            raise ValueError(
-                f"rotation is inactive but blacklist is not empty: {list(md.black_list)}"
+        if not self.rotation:
+            # rotation-off invariants (config.validate pins
+            # decisions_per_leader to 0 then): no blacklist, no chaining
+            if list(md.black_list):
+                raise ValueError(
+                    f"rotation is inactive but blacklist is not empty: {list(md.black_list)}"
+                )
+            if pp.prev_commit_signatures:
+                raise ValueError(
+                    "pipelined mode forbids prev commit signatures in pre-prepares"
+                )
+            return requests
+
+        if self._is_window_first(slot.seq):
+            # window boundary: the staging gate held this slot until every
+            # lower sequence delivered, so the checkpoint is exactly the
+            # anchor this pre-prepare chains to — the single-slot
+            # per-decision verification applies verbatim
+            prev_commits = list(pp.prev_commit_signatures)
+            prepare_acks = await self._rotation.verify_prev_commit_signatures(
+                prev_commits, expected_seq
             )
-        if pp.prev_commit_signatures:
-            raise ValueError(
-                "pipelined mode forbids prev commit signatures in pre-prepares"
+            self._rotation.verify_blacklist(
+                prev_commits, expected_seq, list(md.black_list), prepare_acks
             )
+            self._rotation.verify_prev_commit_digest(prev_commits, md)
+            self._staged_blacklist = list(md.black_list)
+        else:
+            # mid-window: no certificate (it does not exist yet) and the
+            # blacklist must restate the one the window's first proposal
+            # established (staging is in-order, so it is already verified)
+            if pp.prev_commit_signatures:
+                raise ValueError(
+                    "mid-window pre-prepares must not carry prev commit signatures"
+                )
+            if md.prev_commit_signature_digest:
+                raise ValueError(
+                    "mid-window pre-prepares must not bind a prev commit digest"
+                )
+            if list(md.black_list) != self._staged_blacklist:
+                raise ValueError(
+                    f"mid-window blacklist {list(md.black_list)} differs from the "
+                    f"window blacklist {self._staged_blacklist}"
+                )
         return requests
 
     # -- phase 2: prepares --------------------------------------------------
@@ -737,8 +895,8 @@ class WindowedView:
                 self._verify_results.append((seq, pending, results))
                 self._work.set()
 
-        t = asyncio.get_running_loop().create_task(
-            run(), name=f"wview-verify-{self.self_id}-{seq}"
+        t = create_logged_task(
+            run(), name=f"wview-verify-{self.self_id}-{seq}", logger=self.logger
         )
         self._verify_tasks.add(t)
         t.add_done_callback(self._verify_tasks.discard)
@@ -835,11 +993,14 @@ class WindowedView:
         # -> await task -> parked here forever).  On abort the decision stays
         # queued — it is committed, and the controller loop (or its shutdown
         # drain) completes the rendezvous after the abort finishes.
-        loop = asyncio.get_running_loop()
-        decide = loop.create_task(
-            self.decider.decide(slot.proposal, signatures, slot.requests)
+        decide = create_logged_task(
+            self.decider.decide(slot.proposal, signatures, slot.requests),
+            name=f"wview-decide-{self.self_id}-{slot.seq}", logger=self.logger,
         )
-        abort_wait = loop.create_task(self._abort_event.wait())
+        abort_wait = create_logged_task(
+            self._abort_event.wait(),
+            name=f"wview-abortwait-{self.self_id}-{slot.seq}", logger=self.logger,
+        )
         try:
             await asyncio.wait(
                 {decide, abort_wait}, return_when=asyncio.FIRST_COMPLETED
@@ -847,9 +1008,8 @@ class WindowedView:
         finally:
             abort_wait.cancel()
         if not decide.done():
-            decide.add_done_callback(
-                lambda t: t.cancelled() or t.exception()
-            )
+            # abandoned rendezvous: create_logged_task's observer retrieves
+            # (and loudly logs) any eventual failure of the orphaned decide
             raise ViewAborted()
         decide.result()  # propagate decide failures like the plain await did
         if self._aborted:
@@ -1007,6 +1167,18 @@ class WindowedView:
             restored += 1
         self._next_propose_seq = max(self._next_propose_seq, self._prepare_frontier + 1)
         self.phase = self._lowest_phase()
+        if restored and self.rotation:
+            # the staging AND proposing frontiers resume mid-window: later
+            # slots must restate the blacklist of the last restored
+            # (already-verified) proposal, not the checkpoint's possibly
+            # older one — a restored LEADER stamps _proposing_blacklist
+            # into its next mid-window metadata, so both must advance
+            last_slot = self.slots[self._prepare_frontier]
+            if last_slot.proposal is not None and last_slot.proposal.metadata:
+                self._staged_blacklist = list(
+                    decode(ViewMetadata, last_slot.proposal.metadata).black_list
+                )
+                self._proposing_blacklist = list(self._staged_blacklist)
         if restored:
             self.logger.infof(
                 "Restored %d pipelined slot(s), window %d..%d",

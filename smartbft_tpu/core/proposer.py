@@ -133,8 +133,10 @@ class ProposalMaker:
         quorum_size: int,
     ) -> tuple[WindowedView, int]:
         """Pipelined mode: build a WindowedView (pipeline_depth sequences in
-        flight, up to 2x that under the launch shadow).  The same
-        restore-exactly-once contract as the single-slot path
+        flight, up to 2x that under the launch shadow; with window-granular
+        rotation, ``decisions_per_leader`` arrives pre-multiplied by the
+        window depth — Configuration.effective_decisions_per_leader).  The
+        same restore-exactly-once contract as the single-slot path
         (util.go:305-311).  The decider is the Controller; its
         ``on_window_capacity`` re-arms the leader token when the view's
         launch-shadow gate (or a WAL drain) re-opens propose capacity
@@ -142,6 +144,9 @@ class ProposalMaker:
         the next delivery even though the window has room."""
         view = WindowedView(
             retrieve_checkpoint=self.checkpoint.get,
+            decisions_per_leader=self.decisions_per_leader,
+            membership_notifier=self.membership_notifier,
+            metrics_blacklist=self.metrics_blacklist,
             n=self.n,
             nodes_list=self.nodes_list,
             leader_id=leader,

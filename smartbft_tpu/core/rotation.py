@@ -1,0 +1,278 @@
+"""Shared leader-rotation machinery: blacklist metadata + chain verification.
+
+Extracted from the single-slot View (view.go:553-716,896-1062 re-design) so
+the pipelined WindowedView can run the SAME deterministic blacklist update
+and prev-commit-certificate verification at window boundaries that the
+single-slot path runs per decision.  Both views hold one
+:class:`RotationState` per view instance; the state is pure protocol logic
+plus the f+1-aux-witness "blacklisting supported" latch (view.go:1064-1088).
+
+One deliberate robustness divergence: commit signatures minted by the
+view-change in-flight commit machinery carry EMPTY auxiliary data (the
+special PREPARED view signs with no prepare witnesses,
+viewchanger.go:1186-1306).  The reference's blacklist update would choke
+decoding PreparesFrom from them; here :func:`decode_prepares_from` maps
+empty/undecodable aux to an empty witness list — deterministically, on
+leader and follower alike, so metadata byte-equality is preserved.
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+from typing import Optional
+
+from ..api import Logger, MembershipNotifier, Verifier
+from ..codec import decode
+from ..messages import PreparesFrom, Signature, ViewMetadata
+from ..metrics import BlacklistMetrics
+from ..types import commit_signatures_digest
+from .util import compute_blacklist_update, compute_quorum
+
+
+def decode_prepares_from(aux: bytes) -> PreparesFrom:
+    """Tolerant PreparesFrom decode: empty/undecodable aux (in-flight-view
+    certificates) counts as zero witnesses instead of crashing the
+    deterministic blacklist recomputation."""
+    if not aux:
+        return PreparesFrom(ids=[])
+    try:
+        return decode(PreparesFrom, aux)
+    except Exception:
+        return PreparesFrom(ids=[])
+
+
+class RotationState:
+    """Rotation-mode proposal metadata construction (leader) and
+    re-verification (follower) for one view instance."""
+
+    def __init__(
+        self,
+        *,
+        self_id: int,
+        n: int,
+        nodes_list: list[int],
+        leader_id: int,
+        get_view_number,
+        decisions_per_leader: int,
+        verifier: Verifier,
+        retrieve_checkpoint,
+        membership_notifier: Optional[MembershipNotifier],
+        logger: Logger,
+        metrics_blacklist: Optional[BlacklistMetrics] = None,
+    ):
+        self.self_id = self_id
+        self.n = n
+        self.nodes_list = nodes_list
+        self.leader_id = leader_id
+        #: callable, NOT a frozen int: WAL restore can raise the owning
+        #: view's number after construction (state.py _recover_*, pipeline
+        #: restore_window adopt the records' view), and the deterministic
+        #: blacklist recomputation must use the LIVE number or a restored
+        #: follower diverges from the leader's metadata.view_id
+        self.get_view_number = get_view_number
+        self.decisions_per_leader = decisions_per_leader
+        self.verifier = verifier
+        self.retrieve_checkpoint = retrieve_checkpoint
+        self.membership_notifier = membership_notifier
+        self.logger = logger
+        self.metrics_blacklist = metrics_blacklist
+        self._blacklist_supported = False
+
+    # ------------------------------------------------------------------ follower
+
+    async def verify_prev_commit_signatures(
+        self, prev_commit_signatures: list[Signature], curr_verification_seq: int
+    ) -> Optional[dict[int, PreparesFrom]]:
+        """view.go:609-647 — batched (one quorum-sized batch)."""
+        from .view import verify_sigs_batch  # local import: avoid cycle
+
+        prev_prop_raw, _ = self.retrieve_checkpoint()
+        if prev_prop_raw.verification_sequence != curr_verification_seq:
+            self.logger.infof(
+                "Skipping verifying prev commit signatures due to verification "
+                "sequence advancing from %d to %d",
+                prev_prop_raw.verification_sequence, curr_verification_seq,
+            )
+            return None
+
+        if not prev_commit_signatures:
+            return {}
+
+        results = await verify_sigs_batch(
+            self.verifier, prev_commit_signatures, prev_prop_raw, self.logger
+        )
+        prepare_acks: dict[int, PreparesFrom] = {}
+        for sig, aux in zip(prev_commit_signatures, results):
+            if aux is None:
+                raise ValueError(f"failed verifying consenter signature of {sig.signer}")
+            prepare_acks[sig.signer] = decode_prepares_from(aux)
+        return prepare_acks
+
+    def verify_blacklist(
+        self,
+        prev_commit_signatures: list[Signature],
+        curr_verification_seq: int,
+        pending_blacklist: list[int],
+        prepare_acks: Optional[dict[int, PreparesFrom]],
+    ) -> None:
+        """view.go:649-716 — recompute the deterministic blacklist update and
+        require equality with the leader's."""
+        if self.decisions_per_leader == 0:
+            if pending_blacklist:
+                raise ValueError(
+                    f"rotation is inactive but blacklist is not empty: {pending_blacklist}"
+                )
+            return
+
+        prev_prop_raw, my_last_commit_sigs = self.retrieve_checkpoint()
+        prev_md = (
+            decode(ViewMetadata, prev_prop_raw.metadata)
+            if prev_prop_raw.metadata
+            else ViewMetadata()
+        )
+
+        if prev_prop_raw.verification_sequence != curr_verification_seq:
+            if list(prev_md.black_list) != pending_blacklist:
+                raise ValueError(
+                    f"blacklist changed ({prev_md.black_list} --> {pending_blacklist}) "
+                    "during reconfiguration"
+                )
+            self.logger.infof(
+                "Skipping verifying prev commits due to verification sequence advancing"
+            )
+            return
+
+        if self.membership_notifier is not None and self.membership_notifier.membership_change():
+            if list(prev_md.black_list) != pending_blacklist:
+                raise ValueError(
+                    f"blacklist changed ({prev_md.black_list} --> {pending_blacklist}) "
+                    "during membership change"
+                )
+            self.logger.infof("Skipping verifying prev commits due to membership change")
+            return
+
+        _, f = compute_quorum(self.n)
+
+        if self.blacklisting_supported(f, my_last_commit_sigs) and len(
+            prev_commit_signatures
+        ) < len(my_last_commit_sigs):
+            raise ValueError(
+                f"only {len(prev_commit_signatures)} out of {len(my_last_commit_sigs)} "
+                "required previous commits is included in pre-prepare"
+            )
+
+        expected = compute_blacklist_update(
+            current_leader=self.leader_id,
+            leader_rotation=self.decisions_per_leader > 0,
+            prev_md=prev_md,
+            n=self.n,
+            nodes=self.nodes_list,
+            curr_view=self.get_view_number(),
+            prepares_from=prepare_acks or {},
+            f=f,
+            decisions_per_leader=self.decisions_per_leader,
+            logger=self.logger,
+            metrics=self.metrics_blacklist,
+        )
+        if pending_blacklist != expected:
+            raise ValueError(
+                f"proposed blacklist {pending_blacklist} differs from expected "
+                f"{expected} blacklist"
+            )
+
+    def verify_prev_commit_digest(
+        self, prev_commit_signatures: list[Signature], md: ViewMetadata
+    ) -> None:
+        """view.go:694-698 — the metadata must bind the carried certificate."""
+        prev_commit_digest = commit_signatures_digest(prev_commit_signatures)
+        if prev_commit_digest != md.prev_commit_signature_digest and self.decisions_per_leader > 0:
+            raise ValueError(
+                "prev commit signatures received from leader mismatches the metadata digest"
+            )
+
+    def blacklisting_supported(self, f: int, my_last_commit_sigs: list[Signature]) -> bool:
+        """view.go:1064-1088 — f+1 witnesses of aux data activate blacklisting."""
+        if self._blacklist_supported:
+            return True
+        count = 0
+        for sig in my_last_commit_sigs:
+            aux = self.verifier.auxiliary_data(sig.msg)
+            if aux:
+                count += 1
+        supported = count > f
+        self._blacklist_supported = self._blacklist_supported or supported
+        return supported
+
+    # ------------------------------------------------------------------ leader
+
+    def build_leader_metadata(self, metadata: ViewMetadata) -> ViewMetadata:
+        """The full rotation-leader metadata flow (view.go:896-948): seed
+        the previous blacklist from the checkpoint, apply the deterministic
+        update, bind the certificate digest.  Shared by the single-slot
+        View (every decision) and the WindowedView (window-first only)."""
+        verification_seq = self.verifier.verification_sequence()
+        prev_prop, prev_sigs = self.retrieve_checkpoint()
+        prev_md = (
+            decode(ViewMetadata, prev_prop.metadata)
+            if prev_prop.metadata
+            else ViewMetadata()
+        )
+        metadata = replace(metadata, black_list=list(prev_md.black_list))
+        metadata = self.metadata_with_updated_blacklist(
+            metadata, verification_seq, prev_prop, prev_sigs
+        )
+        return self.bind_commit_signatures(metadata, prev_sigs)
+
+    def metadata_with_updated_blacklist(
+        self, metadata: ViewMetadata, verification_seq: int, prev_prop, prev_sigs
+    ) -> ViewMetadata:
+        membership_change = (
+            self.membership_notifier.membership_change()
+            if self.membership_notifier is not None
+            else False
+        )
+        if verification_seq == prev_prop.verification_sequence and not membership_change:
+            return self._update_blacklist_metadata(metadata, prev_sigs, prev_prop.metadata)
+        if verification_seq != prev_prop.verification_sequence:
+            self.logger.infof(
+                "Skipping updating blacklist due to verification sequence changing from %d to %d",
+                prev_prop.verification_sequence, verification_seq,
+            )
+        if membership_change:
+            self.logger.infof("Skipping updating blacklist due to membership change")
+        return metadata
+
+    def _update_blacklist_metadata(
+        self, metadata: ViewMetadata, prev_sigs, prev_metadata: bytes
+    ) -> ViewMetadata:
+        """view.go:1022-1062."""
+        if self.decisions_per_leader == 0:
+            return replace(metadata, black_list=[])
+        prepares_from: dict[int, PreparesFrom] = {}
+        for sig in prev_sigs:
+            aux = self.verifier.auxiliary_data(sig.msg)
+            prepares_from[sig.signer] = decode_prepares_from(aux)
+        prev_md = decode(ViewMetadata, prev_metadata) if prev_metadata else ViewMetadata()
+        _, f = compute_quorum(self.n)
+        black_list = compute_blacklist_update(
+            current_leader=self.leader_id,
+            leader_rotation=self.decisions_per_leader > 0,
+            prev_md=prev_md,
+            n=self.n,
+            nodes=self.nodes_list,
+            curr_view=metadata.view_id,
+            prepares_from=prepares_from,
+            f=f,
+            decisions_per_leader=self.decisions_per_leader,
+            logger=self.logger,
+            metrics=self.metrics_blacklist,
+        )
+        return replace(metadata, black_list=black_list)
+
+    def bind_commit_signatures(self, metadata: ViewMetadata, prev_sigs) -> ViewMetadata:
+        """view.go:979-998."""
+        if self.decisions_per_leader == 0:
+            return metadata
+        return replace(
+            metadata, prev_commit_signature_digest=commit_signatures_digest(prev_sigs)
+        )

@@ -41,6 +41,13 @@ def get_leader_id(
 
     Static mode: nodes[view % n].  Rotation: offset the view by completed
     leader terms and skip blacklisted nodes.
+
+    ``decisions_per_leader`` is always in DECISIONS here.  Window-granular
+    rotation (pipelined mode) pre-multiplies the configured per-window
+    count by the window depth (Configuration.effective_decisions_per_leader)
+    before it reaches any caller of this function, so a term spans whole
+    windows and every replica — controller, view changer, blacklist
+    recomputation — derives the same election from the same arithmetic.
     """
     if not leader_rotation:
         return nodes[view % n]

@@ -44,9 +44,11 @@ from ..messages import (
     ViewMetadata,
 )
 from ..metrics import BlacklistMetrics, ViewMetrics
-from ..types import commit_signatures_digest, proposal_digest
+from ..types import proposal_digest
+from .rotation import RotationState
 from .state import ABORT, COMMITTED, PREPARED, PROPOSED
-from .util import VoteSet, compute_blacklist_update, compute_quorum
+from .util import VoteSet, compute_quorum
+from ..utils.tasks import create_logged_task
 
 _MAX_U64 = 2**64 - 1
 
@@ -210,7 +212,21 @@ class View:
         self._prev_prepare_sent: Optional[Prepare] = None
         self._prev_commit_sent: Optional[Commit] = None
         self._last_voted_proposal_by_id: dict[int, Commit] = {}
-        self._blacklist_supported = False
+        # shared rotation machinery (blacklist metadata + chain checks);
+        # also used by the pipelined WindowedView at window boundaries
+        self._rotation = RotationState(
+            self_id=self_id,
+            n=n,
+            nodes_list=nodes_list,
+            leader_id=leader_id,
+            get_view_number=lambda: self.number,
+            decisions_per_leader=decisions_per_leader,
+            verifier=verifier,
+            retrieve_checkpoint=retrieve_checkpoint,
+            membership_notifier=membership_notifier,
+            logger=logger,
+            metrics_blacklist=metrics_blacklist,
+        )
 
         self.backpressure = backpressure
         # backpressure mode uses the queue's own bound so senders can block
@@ -245,8 +261,9 @@ class View:
     # ------------------------------------------------------------------ life
 
     def start(self) -> None:
-        self._task = asyncio.get_running_loop().create_task(
-            self._run(), name=f"view-{self.self_id}-{self.number}"
+        self._task = create_logged_task(
+            self._run(), name=f"view-{self.self_id}-{self.number}",
+            logger=self.logger,
         )
 
     def stopped(self) -> bool:
@@ -748,113 +765,15 @@ class View:
                 f"verification sequence mismatch: expected {expected_seq} got {proposal.verification_sequence}"
             )
 
-        prepare_acks = await self._verify_prev_commit_signatures(prev_commits, expected_seq)
-        self._verify_blacklist(prev_commits, expected_seq, list(md.black_list), prepare_acks)
-
-        prev_commit_digest = commit_signatures_digest(prev_commits)
-        if prev_commit_digest != md.prev_commit_signature_digest and self.decisions_per_leader > 0:
-            raise ValueError("prev commit signatures received from leader mismatches the metadata digest")
+        prepare_acks = await self._rotation.verify_prev_commit_signatures(
+            prev_commits, expected_seq
+        )
+        self._rotation.verify_blacklist(
+            prev_commits, expected_seq, list(md.black_list), prepare_acks
+        )
+        self._rotation.verify_prev_commit_digest(prev_commits, md)
 
         return requests
-
-    async def _verify_prev_commit_signatures(
-        self, prev_commit_signatures: list[Signature], curr_verification_seq: int
-    ) -> Optional[dict[int, PreparesFrom]]:
-        """view.go:609-647 — batched here (one quorum-sized batch)."""
-        prev_prop_raw, _ = self.retrieve_checkpoint()
-        if prev_prop_raw.verification_sequence != curr_verification_seq:
-            self.logger.infof(
-                "Skipping verifying prev commit signatures due to verification sequence advancing from %d to %d",
-                prev_prop_raw.verification_sequence, curr_verification_seq,
-            )
-            return None
-
-        if not prev_commit_signatures:
-            return {}
-
-        results = await self._verify_consenter_sigs_batch(prev_commit_signatures, prev_prop_raw)
-        prepare_acks: dict[int, PreparesFrom] = {}
-        for sig, aux in zip(prev_commit_signatures, results):
-            if aux is None:
-                raise ValueError(f"failed verifying consenter signature of {sig.signer}")
-            prepare_acks[sig.signer] = decode(PreparesFrom, aux)
-        return prepare_acks
-
-    def _verify_blacklist(
-        self,
-        prev_commit_signatures: list[Signature],
-        curr_verification_seq: int,
-        pending_blacklist: list[int],
-        prepare_acks: Optional[dict[int, PreparesFrom]],
-    ) -> None:
-        """view.go:649-716 — recompute the deterministic blacklist update and
-        require byte-equality with the leader's."""
-        if self.decisions_per_leader == 0:
-            if pending_blacklist:
-                raise ValueError(
-                    f"rotation is inactive but blacklist is not empty: {pending_blacklist}"
-                )
-            return
-
-        prev_prop_raw, my_last_commit_sigs = self.retrieve_checkpoint()
-        prev_md = decode(ViewMetadata, prev_prop_raw.metadata) if prev_prop_raw.metadata else ViewMetadata()
-
-        if prev_prop_raw.verification_sequence != curr_verification_seq:
-            if list(prev_md.black_list) != pending_blacklist:
-                raise ValueError(
-                    f"blacklist changed ({prev_md.black_list} --> {pending_blacklist}) during reconfiguration"
-                )
-            self.logger.infof("Skipping verifying prev commits due to verification sequence advancing")
-            return
-
-        if self.membership_notifier is not None and self.membership_notifier.membership_change():
-            if list(prev_md.black_list) != pending_blacklist:
-                raise ValueError(
-                    f"blacklist changed ({prev_md.black_list} --> {pending_blacklist}) during membership change"
-                )
-            self.logger.infof("Skipping verifying prev commits due to membership change")
-            return
-
-        _, f = compute_quorum(self.n)
-
-        if self._blacklisting_supported(f, my_last_commit_sigs) and len(
-            prev_commit_signatures
-        ) < len(my_last_commit_sigs):
-            raise ValueError(
-                f"only {len(prev_commit_signatures)} out of {len(my_last_commit_sigs)} "
-                "required previous commits is included in pre-prepare"
-            )
-
-        expected = compute_blacklist_update(
-            current_leader=self.leader_id,
-            leader_rotation=self.decisions_per_leader > 0,
-            prev_md=prev_md,
-            n=self.n,
-            nodes=self.nodes_list,
-            curr_view=self.number,
-            prepares_from=prepare_acks or {},
-            f=f,
-            decisions_per_leader=self.decisions_per_leader,
-            logger=self.logger,
-            metrics=self.metrics_blacklist,
-        )
-        if pending_blacklist != expected:
-            raise ValueError(
-                f"proposed blacklist {pending_blacklist} differs from expected {expected} blacklist"
-            )
-
-    def _blacklisting_supported(self, f: int, my_last_commit_sigs: list[Signature]) -> bool:
-        """view.go:1064-1088 — f+1 witnesses of aux data activate blacklisting."""
-        if self._blacklist_supported:
-            return True
-        count = 0
-        for sig in my_last_commit_sigs:
-            aux = self.verifier.auxiliary_data(sig.msg)
-            if aux:
-                count += 1
-        supported = count > f
-        self._blacklist_supported = self._blacklist_supported or supported
-        return supported
 
     # ------------------------------------------------------------------ assists
 
@@ -916,69 +835,7 @@ class View:
             latest_sequence=self.proposal_sequence,
             decisions_in_view=self.decisions_in_view,
         )
-        verification_seq = self.verifier.verification_sequence()
-        prev_prop, prev_sigs = self.retrieve_checkpoint()
-        prev_md = decode(ViewMetadata, prev_prop.metadata) if prev_prop.metadata else ViewMetadata()
-        metadata = replace(metadata, black_list=list(prev_md.black_list))
-        metadata = self._metadata_with_updated_blacklist(
-            metadata, verification_seq, prev_prop, prev_sigs
-        )
-        metadata = self._bind_commit_signatures(metadata, prev_sigs)
-        return encode(metadata)
-
-    def _metadata_with_updated_blacklist(
-        self, metadata: ViewMetadata, verification_seq: int, prev_prop, prev_sigs
-    ) -> ViewMetadata:
-        membership_change = (
-            self.membership_notifier.membership_change()
-            if self.membership_notifier is not None
-            else False
-        )
-        if verification_seq == prev_prop.verification_sequence and not membership_change:
-            return self._update_blacklist_metadata(metadata, prev_sigs, prev_prop.metadata)
-        if verification_seq != prev_prop.verification_sequence:
-            self.logger.infof(
-                "Skipping updating blacklist due to verification sequence changing from %d to %d",
-                prev_prop.verification_sequence, verification_seq,
-            )
-        if membership_change:
-            self.logger.infof("Skipping updating blacklist due to membership change")
-        return metadata
-
-    def _update_blacklist_metadata(
-        self, metadata: ViewMetadata, prev_sigs, prev_metadata: bytes
-    ) -> ViewMetadata:
-        """view.go:1022-1062."""
-        if self.decisions_per_leader == 0:
-            return replace(metadata, black_list=[])
-        prepares_from: dict[int, PreparesFrom] = {}
-        for sig in prev_sigs:
-            aux = self.verifier.auxiliary_data(sig.msg)
-            prepares_from[sig.signer] = decode(PreparesFrom, aux)
-        prev_md = decode(ViewMetadata, prev_metadata) if prev_metadata else ViewMetadata()
-        _, f = compute_quorum(self.n)
-        black_list = compute_blacklist_update(
-            current_leader=self.leader_id,
-            leader_rotation=self.decisions_per_leader > 0,
-            prev_md=prev_md,
-            n=self.n,
-            nodes=self.nodes_list,
-            curr_view=metadata.view_id,
-            prepares_from=prepares_from,
-            f=f,
-            decisions_per_leader=self.decisions_per_leader,
-            logger=self.logger,
-            metrics=self.metrics_blacklist,
-        )
-        return replace(metadata, black_list=black_list)
-
-    def _bind_commit_signatures(self, metadata: ViewMetadata, prev_sigs) -> ViewMetadata:
-        """view.go:979-998."""
-        if self.decisions_per_leader == 0:
-            return metadata
-        return replace(
-            metadata, prev_commit_signature_digest=commit_signatures_digest(prev_sigs)
-        )
+        return encode(self._rotation.build_leader_metadata(metadata))
 
     def propose(self, proposal: Proposal) -> None:
         """Leader: wrap as pre-prepare and self-deliver first so the WAL

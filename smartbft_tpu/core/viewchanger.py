@@ -47,11 +47,12 @@ from ..messages import (
     ViewMetadata,
 )
 from ..metrics import BlacklistMetrics, ViewChangeMetrics, ViewMetrics
-from ..types import Checkpoint, proposal_digest
+from ..types import Checkpoint, blacklist_of, proposal_digest
 from .pool import remove_delivered_requests
 from .state import PREPARED
 from .util import InFlightData, NextViews, VoteSet, compute_quorum, get_leader_id
 from .view import View, ViewSequencesHolder, verify_sigs_batch
+from ..utils.tasks import create_logged_task
 
 
 def validate_in_flight(in_flight_proposal: Optional[Proposal], last_sequence: int) -> None:
@@ -292,6 +293,11 @@ class _InFlightDecider:
 
 
 class ViewChanger:
+    #: how long a fresh run loop waits for a cancelled prior loop to
+    #: actually finish before escalating (clear vote state + force sync);
+    #: tests tighten it
+    STRAGGLER_WAIT: float = 5.0
+
     def __init__(
         self,
         *,
@@ -408,8 +414,9 @@ class ViewChanger:
             self._events.get_nowait()
         self._queued_msgs = 0
         self._pending_changes = 0
-        self._task = asyncio.get_running_loop().create_task(
-            self._run(frozenset(self._prior_tasks)), name=f"viewchanger-{self.self_id}"
+        self._task = create_logged_task(
+            self._run(frozenset(self._prior_tasks)),
+            name=f"viewchanger-{self.self_id}", logger=self.logger,
         )
 
     def _set_view_metrics(self) -> None:
@@ -511,15 +518,26 @@ class ViewChanger:
             # this loop touches shared ViewChanger state, so two loops never
             # interleave.  asyncio.wait never propagates the tasks' outcomes.
             # Bounded: an embedder callback that swallows cancellation must
-            # not brick the ViewChanger forever — after the timeout, proceed
-            # loudly (the pre-round-5 behavior, but observable).
-            _, stragglers = await asyncio.wait(prior_tasks, timeout=5.0)
+            # not brick the ViewChanger forever — after the timeout, escalate
+            # SAFELY: discard the shared view-change bookkeeping a straggler
+            # may still be mutating (vote sets rebuild from peer resends —
+            # the resend timer re-broadcasts every resend_timeout) and force
+            # a sync so this node re-derives its position from the cluster
+            # instead of from potentially interleaved state.
+            _, stragglers = await asyncio.wait(prior_tasks, timeout=self.STRAGGLER_WAIT)
             if stragglers:
-                self.logger.warnf(
+                self.logger.errorf(
                     "ViewChanger %d: %d prior run loop(s) ignored cancellation "
-                    "for 5s; proceeding — shared state may briefly interleave",
-                    self.self_id, len(stragglers),
+                    "for %.1fs; clearing view-change vote state and forcing a "
+                    "sync instead of sharing it with a live straggler",
+                    self.self_id, len(stragglers), self.STRAGGLER_WAIT,
                 )
+                self.view_change_msgs.clear()
+                self.view_data_msgs.clear()
+                self.nvs.clear()
+                self._check_timeout = False
+                if self.synchronizer is not None:
+                    self.synchronizer.sync()
         if self.controller_started_event is not None:
             await self.controller_started_event.wait()  # viewchanger.go:156
         while True:
@@ -560,9 +578,7 @@ class ViewChanger:
 
     def _blacklist(self) -> list[int]:
         prop, _ = self.checkpoint.get()
-        if not prop.metadata:
-            return []
-        return list(decode(ViewMetadata, prop.metadata).black_list)
+        return blacklist_of(prop)
 
     def _check_if_resend_view_change(self, now: float) -> None:
         """viewchanger.go:232-252."""

@@ -24,6 +24,49 @@ from ..crypto import p256
 from ..crypto.provider import JaxVerifyEngine
 
 
+def resolve_shard_map(required: bool = False):
+    """The usable shard_map entry point of this jax build, or None.
+
+    jax graduated ``jax.experimental.shard_map.shard_map`` (replication
+    check spelled ``check_rep``) to top-level ``jax.shard_map``
+    (``check_vma``); container images pin various points of that timeline.
+    Returns a uniform ``call(f, mesh=, in_specs=, out_specs=)`` wrapper
+    with the replication/varying-manual-axes check disabled (the bignum
+    carry-chain scans initialize carries from unvarying constants, which
+    the checker rejects).  When neither API exists: returns None, or with
+    ``required=True`` raises the capability error — callers either gate on
+    :func:`shard_map_available` or demand it outright.
+    """
+    import jax
+
+    sm = getattr(jax, "shard_map", None)
+    if sm is None:
+        try:
+            from jax.experimental.shard_map import shard_map as sm
+        except Exception:
+            if required:
+                raise RuntimeError(
+                    "no usable shard_map API in this jax build (neither "
+                    "jax.shard_map nor jax.experimental.shard_map)"
+                )
+            return None
+
+    def call(f, *, mesh, in_specs, out_specs):
+        try:
+            return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_vma=False)
+        except TypeError:  # older spelling
+            return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_rep=False)
+
+    return call
+
+
+def shard_map_available() -> bool:
+    """Capability probe for the mesh quorum step (tests skip-gate on it)."""
+    return resolve_shard_map() is not None
+
+
 def build_mesh(shape: Optional[tuple[int, ...]] = None,
                axis_names: tuple[str, ...] = ("lane",),
                devices=None):
@@ -139,12 +182,9 @@ class QuorumMeshVerifyEngine(JaxVerifyEngine):
         in_specs = (P("seq", "vote"),) + tuple(
             P("seq", "vote", None) for _ in range(nargs)
         )
-        kw = {"mesh": self.mesh, "in_specs": in_specs,
-              "out_specs": (P("seq", "vote"), P("seq"))}
-        try:
-            sharded = jax.shard_map(step, check_vma=False, **kw)
-        except TypeError:  # older jax spells it check_rep
-            sharded = jax.shard_map(step, check_rep=False, **kw)
+        shard_map = resolve_shard_map(required=True)
+        sharded = shard_map(step, mesh=self.mesh, in_specs=in_specs,
+                            out_specs=(P("seq", "vote"), P("seq")))
         return jax.jit(sharded)
 
     def _probe_item(self):
@@ -263,15 +303,8 @@ def quorum_decide(mesh, quorum: int, scheme=p256):
         specs = tuple(
             P("seq", "vote", None) if r == 3 else P("seq", "vote") for r in ranks
         )
-        # check_vma=False: the bignum carry-chain scans initialize carries
-        # from unvarying constants, which the varying-manual-axes checker
-        # rejects; the computation is elementwise over lanes + one psum.
-        try:
-            sharded = jax.shard_map(step, mesh=mesh, in_specs=specs,
-                                    out_specs=P("seq"), check_vma=False)
-        except TypeError:  # older jax spells it check_rep
-            sharded = jax.shard_map(step, mesh=mesh, in_specs=specs,
-                                    out_specs=P("seq"), check_rep=False)
+        shard_map = resolve_shard_map(required=True)
+        sharded = shard_map(step, mesh=mesh, in_specs=specs, out_specs=P("seq"))
         return jax.jit(sharded)
 
     def decide(*arrays):

@@ -1,0 +1,584 @@
+"""Scripted fault-schedule chaos harness over the in-process network.
+
+Layered on :mod:`smartbft_tpu.testing.network`'s fault primitives, this
+module turns ad-hoc fault tests into DECLARATIVE timelines: a schedule is a
+list of :class:`ChaosEvent` (leader-mute, crash, restart, partition, heal,
+message-corruption, ...) pinned to logical-clock offsets, executed by
+:class:`ChaosCluster` while a request pump keeps the protocol under load.
+After the run, :class:`Invariants` checks the four properties every
+schedule must preserve:
+
+* **fork-free** — pairwise identical ledger prefixes;
+* **exactly-once** — no request delivered twice on any ledger, sequences
+  gapless from 1;
+* **eventual blacklist** — a deposed faulty leader appears in the
+  blacklist carried by committed checkpoint metadata (rotation mode);
+* **bounded liveness** — once the last fault heals, draining the
+  outstanding requests takes at most the batch-count they need plus a
+  small fixed slack, measured in WINDOWS (decisions / pipeline_depth).
+
+The harness is mode-agnostic: the same schedule runs single-slot
+(pipeline_depth=1, per-decision rotation) and pipelined
+(pipeline_depth>1, window-granular rotation) clusters, which is exactly
+the parametrization the scenario tests sweep.
+
+Soak entry point (CI, behind ``-m slow``)::
+
+    python -m smartbft_tpu.testing.chaos --soak [--rounds N] [--depth K]
+
+runs randomized schedules against a rotation-on pipelined cluster and
+fails loudly on any invariant violation.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import math
+import random
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from ..codec import decode
+from ..config import Configuration
+from ..messages import Commit, Prepare, ViewMetadata
+from ..utils.clock import Scheduler
+from .app import App, SharedLedgers, fast_config, wait_for
+from .network import Network
+
+
+def chaos_config(
+    i: int,
+    *,
+    depth: int = 1,
+    rotation: bool = True,
+    decisions_per_leader: int = 1,
+    **overrides,
+) -> Configuration:
+    """Tight-timeout configuration for fault scenarios, pipelined or not.
+
+    ``decisions_per_leader`` is in the configured granularity's units:
+    windows when ``depth > 1`` (rotation_granularity='window'), decisions
+    otherwise."""
+    base = dict(
+        leader_rotation=rotation,
+        decisions_per_leader=decisions_per_leader if rotation else 0,
+        rotation_granularity="window" if depth > 1 else "decision",
+        pipeline_depth=depth,
+        request_batch_max_count=2,
+        request_batch_max_interval=0.05,
+        leader_heartbeat_timeout=2.0,
+        leader_heartbeat_count=10,
+        view_change_timeout=8.0,
+        view_change_resend_interval=2.0,
+    )
+    base.update(overrides)
+    return dataclasses.replace(fast_config(i), **base)
+
+
+# ---------------------------------------------------------------------- events
+
+@dataclass(frozen=True)
+class ChaosEvent:
+    """One timeline entry: ``action`` applied at logical offset ``at``.
+
+    ``node`` (and ``groups`` members) may be a concrete node id or one of
+    two dynamic targets, resolved when the event FIRES:
+
+    - ``"leader"``: whatever node the live cluster currently follows —
+      under rotation the leader at schedule-authoring time is meaningless;
+    - ``"faulty"``: the node the run's first ``"leader"`` resolution
+      picked, so multi-event schedules (mute -> crash -> restart) stay
+      aimed at one victim while the cluster rotates around it.
+
+    Actions:
+
+    - ``mute`` / ``unmute``: outbound-only silence (alive but not sending)
+    - ``disconnect`` / ``reconnect``: full isolation both ways
+    - ``crash`` / ``restart``: stop the consensus process / start it again
+      with WAL recovery (a crash-restart pair with downtime in between)
+    - ``partition`` / ``heal``: split the mesh into ``groups`` / undo it
+    - ``corrupt`` / ``uncorrupt``: mutate a ``fraction`` of the node's
+      outbound prepare/commit digests (message corruption)
+    """
+
+    at: float
+    action: str
+    node: Optional[object] = None  # int | "leader" | "faulty"
+    groups: tuple = ()
+    fraction: float = 1.0
+
+
+def mute_leader_schedule(*, mute_at=2.0, heal_at=14.0) -> list[ChaosEvent]:
+    """The canonical faulty-leader schedule: the CURRENT leader goes mute
+    (alive, receiving, silent), the cluster deposes it, then it heals."""
+    return [
+        ChaosEvent(at=mute_at, action="mute", node="leader"),
+        ChaosEvent(at=heal_at, action="unmute", node="faulty"),
+    ]
+
+
+def faulty_leader_full_schedule(
+    *, mute_at=2.0, crash_at=12.0, restart_at=20.0
+) -> list[ChaosEvent]:
+    """The acceptance schedule: mute -> crash-restart -> rejoin.  The
+    current leader first goes mute (deposed + blacklisted by the remaining
+    quorum), then crashes outright, then restarts from its WAL and
+    rejoins as a follower."""
+    return [
+        ChaosEvent(at=mute_at, action="mute", node="leader"),
+        ChaosEvent(at=crash_at, action="crash", node="faulty"),
+        ChaosEvent(at=restart_at, action="restart", node="faulty"),
+        ChaosEvent(at=restart_at, action="unmute", node="faulty"),
+    ]
+
+
+# ---------------------------------------------------------------------- report
+
+@dataclass
+class ChaosReport:
+    submitted: int = 0
+    committed_at_heal: int = 0
+    decisions_at_heal: int = 0
+    final_committed: int = 0
+    final_decisions: int = 0
+    heal_at: float = 0.0
+    leaders_seen: set = field(default_factory=set)
+    events_fired: list = field(default_factory=list)
+
+    @property
+    def decisions_after_heal(self) -> int:
+        return self.final_decisions - self.decisions_at_heal
+
+
+# ---------------------------------------------------------------------- cluster
+
+class ChaosCluster:
+    """n apps over one logical clock + fault-injection network, driven by a
+    declarative fault schedule under continuous request load."""
+
+    def __init__(
+        self,
+        wal_root,
+        *,
+        n: int = 4,
+        depth: int = 1,
+        rotation: bool = True,
+        seed: int = 101,
+        config_fn: Optional[Callable[[int], Configuration]] = None,
+    ):
+        self.wal_root = str(wal_root)
+        self.n = n
+        self.depth = depth
+        self.rotation = rotation
+        self.scheduler = Scheduler()
+        self.network = Network(seed=seed)
+        self.shared = SharedLedgers()
+        self.rng = random.Random(seed)
+        cfg = config_fn or (lambda i: chaos_config(i, depth=depth, rotation=rotation))
+        self.apps = [
+            App(i, self.network, self.shared, self.scheduler,
+                wal_dir=f"{self.wal_root}/wal-{i}", config=cfg(i))
+            for i in range(1, n + 1)
+        ]
+        self.down: set[int] = set()
+        #: nodes under an active injected fault (mute/corrupt/disconnect):
+        #: the request pump skips them, like a client avoiding a dead peer
+        self.faulted: set[int] = set()
+        #: members of partition groups below quorum size (pump skips too)
+        self.partition_minority: set[int] = set()
+        #: the node the run's first dynamic "leader" target resolved to
+        self.faulty_node: Optional[int] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> None:
+        for a in self.apps:
+            await a.start()
+
+    async def stop(self) -> None:
+        for a in self.apps:
+            if a.id not in self.down:
+                await a.stop()
+
+    def app(self, node_id: int) -> App:
+        return self.apps[node_id - 1]
+
+    # -- queries -----------------------------------------------------------
+
+    def committed(self, app: App) -> int:
+        return sum(len(app.requests_from_proposal(d.proposal)) for d in app.ledger())
+
+    def live_apps(self) -> list[App]:
+        return [a for a in self.apps if a.id not in self.down]
+
+    def leader_of(self) -> int:
+        for a in self.live_apps():
+            if a.consensus is not None:
+                lead = a.consensus.get_leader_id()
+                if lead:
+                    return lead
+        return 0
+
+    def healthy_apps(self) -> list[App]:
+        """Live apps with no active injected fault — pump targets."""
+        bad = self.down | self.faulted | self.partition_minority
+        return [a for a in self.apps if a.id not in bad]
+
+    # -- event execution ---------------------------------------------------
+
+    def _resolve(self, spec) -> Optional[int]:
+        """Resolve a dynamic target ("leader" / "faulty") to a node id."""
+        if spec == "leader":
+            node = self.leader_of()
+            if not node:
+                raise RuntimeError("no live leader to resolve a dynamic target")
+            if self.faulty_node is None:
+                self.faulty_node = node
+            return node
+        if spec == "faulty":
+            if self.faulty_node is None:
+                raise RuntimeError('"faulty" target used before any "leader" resolution')
+            return self.faulty_node
+        return spec
+
+    async def _fire(self, evt: ChaosEvent) -> ChaosEvent:
+        target = self._resolve(evt.node) if evt.node is not None else None
+        groups = tuple(
+            tuple(self._resolve(m) for m in g) for g in evt.groups
+        )
+        evt = dataclasses.replace(evt, node=target, groups=groups)
+        node = self.network.nodes.get(evt.node) if evt.node else None
+        if evt.action == "mute":
+            node.mute()
+            self.faulted.add(evt.node)
+        elif evt.action == "unmute":
+            node.unmute()
+            self.faulted.discard(evt.node)
+        elif evt.action == "disconnect":
+            node.disconnect()
+            self.faulted.add(evt.node)
+        elif evt.action == "reconnect":
+            node.connect()
+            self.faulted.discard(evt.node)
+        elif evt.action == "crash":
+            self.down.add(evt.node)
+            self.faulted.add(evt.node)
+            await self.app(evt.node).stop()
+        elif evt.action == "restart":
+            await self.app(evt.node).start()
+            self.down.discard(evt.node)
+            self.faulted.discard(evt.node)
+        elif evt.action == "partition":
+            from ..core.util import compute_quorum
+
+            self.network.partition(*[list(g) for g in evt.groups])
+            named = {m for g in evt.groups for m in g}
+            rest = [i for i in range(1, self.n + 1) if i not in named]
+            q, _ = compute_quorum(self.n)
+            for g in [list(g) for g in evt.groups] + ([rest] if rest else []):
+                if len(g) < q:
+                    self.partition_minority.update(g)
+        elif evt.action == "heal":
+            self.network.heal()
+            self.partition_minority.clear()
+        elif evt.action == "corrupt":
+            node.mutate_send = self._corruptor(evt.fraction)
+            self.faulted.add(evt.node)
+        elif evt.action == "uncorrupt":
+            node.mutate_send = None
+            self.faulted.discard(evt.node)
+        else:
+            raise ValueError(f"unknown chaos action: {evt.action}")
+        return evt
+
+    def _corruptor(self, fraction: float):
+        rng = self.rng
+
+        def mutate(_target, msg):
+            if isinstance(msg, (Prepare, Commit)) and rng.random() < fraction:
+                return dataclasses.replace(msg, digest="corrupted-" + msg.digest[:8])
+            return msg
+
+        return mutate
+
+    # -- the run loop ------------------------------------------------------
+
+    async def run_schedule(
+        self,
+        schedule: list[ChaosEvent],
+        *,
+        requests: int = 20,
+        submit_via: int = 0,
+        submit_every: float = 0.3,
+        settle_timeout: float = 300.0,
+        step: float = 0.05,
+    ) -> ChaosReport:
+        """Execute the schedule under load and drain to quiescence.
+
+        Requests ``chaos-0..requests-1`` are submitted one per
+        ``submit_every`` logical seconds through the ``submit_via`` node
+        (0 = rotate over live non-faulted nodes), interleaved with the
+        timeline's events.  After the last event AND last submission, the
+        run continues until every live node committed every request (or
+        ``settle_timeout`` logical seconds pass, which raises)."""
+        report = ChaosReport()
+        pending = sorted(schedule, key=lambda e: e.at)
+        now = 0.0
+        submitted = 0
+        next_submit = 0.0
+        heal_seen = False
+
+        def target_app() -> Optional[App]:
+            if submit_via:
+                return self.app(submit_via) if submit_via not in self.down else None
+            healthy = self.healthy_apps()
+            return healthy[submitted % len(healthy)] if healthy else None
+
+        def all_drained() -> bool:
+            live = self.live_apps()
+            return bool(live) and all(
+                self.committed(a) >= requests for a in live
+            )
+
+        deadline = None
+        while True:
+            # 1. fire due events
+            while pending and pending[0].at <= now:
+                evt = pending.pop(0)
+                report.events_fired.append(await self._fire(evt))
+            # 2. pump load
+            if submitted < requests and now >= next_submit:
+                app = target_app()
+                if app is not None and app.consensus is not None:
+                    try:
+                        await app.submit("chaos", f"chaos-{submitted}")
+                        submitted += 1
+                        next_submit = now + submit_every
+                    except Exception:
+                        next_submit = now + submit_every  # pool full / no leader: retry later
+                else:
+                    next_submit = now + submit_every
+            report.submitted = submitted
+            # 3. bookkeeping
+            lead = self.leader_of()
+            if lead:
+                report.leaders_seen.add(lead)
+            if not heal_seen and not pending and submitted >= requests:
+                heal_seen = True
+                report.heal_at = now
+                live = self.live_apps()
+                probe = live[0] if live else self.apps[0]
+                report.committed_at_heal = self.committed(probe)
+                report.decisions_at_heal = len(probe.ledger())
+                deadline = now + settle_timeout
+            # 4. exit condition
+            if heal_seen and all_drained():
+                break
+            if deadline is not None and now > deadline:
+                live = self.live_apps()
+                raise TimeoutError(
+                    f"chaos run did not drain within {settle_timeout}s of the "
+                    f"last event: committed="
+                    f"{[self.committed(a) for a in live]} of {requests}"
+                )
+            if now > 3600.0:
+                raise TimeoutError("chaos run exceeded the hard 1h logical cap")
+            # 5. advance logical time in lockstep with the loop
+            await asyncio.sleep(0)
+            self.scheduler.advance_by(step)
+            await asyncio.sleep(0.001)
+            now += step
+
+        probe = self.live_apps()[0]
+        report.final_committed = self.committed(probe)
+        report.final_decisions = len(probe.ledger())
+        return report
+
+
+# ---------------------------------------------------------------------- invariants
+
+class Invariants:
+    """Post-run safety/liveness checks; every method raises AssertionError
+    with a diagnostic on violation."""
+
+    @staticmethod
+    def fork_free(cluster: ChaosCluster) -> None:
+        apps = cluster.live_apps()
+        ref = [(d.proposal.payload, d.proposal.metadata) for d in apps[0].ledger()]
+        for a in apps[1:]:
+            other = [(d.proposal.payload, d.proposal.metadata) for d in a.ledger()]
+            m = min(len(ref), len(other))
+            assert ref[:m] == other[:m], (
+                f"ledger fork between node {apps[0].id} and node {a.id}"
+            )
+
+    @staticmethod
+    def exactly_once(cluster: ChaosCluster, expected: Optional[int] = None) -> None:
+        for a in cluster.live_apps():
+            infos = [
+                str(i)
+                for d in a.ledger()
+                for i in a.requests_from_proposal(d.proposal)
+            ]
+            dupes = {i for i in infos if infos.count(i) > 1}
+            assert not dupes, f"node {a.id} delivered duplicates: {sorted(dupes)}"
+            if expected is not None:
+                assert len(infos) >= expected, (
+                    f"node {a.id} delivered {len(infos)} of {expected} requests"
+                )
+            seqs = [
+                decode(ViewMetadata, d.proposal.metadata).latest_sequence
+                for d in a.ledger()
+                if d.proposal.metadata
+            ]
+            assert seqs == list(range(1, len(seqs) + 1)), (
+                f"node {a.id} has a sequence gap: {seqs}"
+            )
+
+    @staticmethod
+    def ever_blacklisted(cluster: ChaosCluster, node_id: int) -> None:
+        """The faulty node must appear in the blacklist of SOME committed
+        decision's metadata (it may later be redeemed once it rejoins and
+        is witnessed alive — util.go:502-541 — so 'currently blacklisted'
+        is deliberately not the assertion)."""
+        app = cluster.live_apps()[0]
+        seen = [
+            list(decode(ViewMetadata, d.proposal.metadata).black_list)
+            for d in app.ledger()
+            if d.proposal.metadata
+        ]
+        assert any(node_id in bl for bl in seen), (
+            f"node {node_id} never entered the committed blacklist; "
+            f"blacklists seen: {seen}"
+        )
+
+    @staticmethod
+    def liveness_within_windows(
+        cluster: ChaosCluster, report: ChaosReport, slack_windows: int = 4
+    ) -> None:
+        """Bounded post-heal liveness: draining the requests outstanding at
+        heal time must take at most the decisions they need (batches) plus
+        ``slack_windows`` windows of protocol slack (view changes,
+        redeliveries)."""
+        batch = cluster.apps[0].config.request_batch_max_count
+        outstanding = report.submitted - report.committed_at_heal
+        need = math.ceil(outstanding / max(batch, 1))
+        depth = max(cluster.depth, 1)
+        bound = need + slack_windows * depth
+        assert report.decisions_after_heal <= bound, (
+            f"liveness took {report.decisions_after_heal} decisions "
+            f"(~{math.ceil(report.decisions_after_heal / depth)} windows) to "
+            f"drain {outstanding} requests; bound was {bound} decisions "
+            f"(~{math.ceil(bound / depth)} windows)"
+        )
+
+    @classmethod
+    def check_all(
+        cls,
+        cluster: ChaosCluster,
+        report: ChaosReport,
+        *,
+        expected: Optional[int] = None,
+        blacklisted: Optional[int] = None,
+        slack_windows: int = 4,
+    ) -> None:
+        cls.fork_free(cluster)
+        cls.exactly_once(cluster, expected)
+        if blacklisted is not None:
+            cls.ever_blacklisted(cluster, blacklisted)
+        cls.liveness_within_windows(cluster, report, slack_windows)
+
+
+# ---------------------------------------------------------------------- soak
+
+def random_schedule(rng: random.Random, n: int) -> list[ChaosEvent]:
+    """A randomized but always-heal-by-the-end schedule for soak runs.
+    Leader-shaped faults use dynamic targets so they hit the node actually
+    leading when the fault fires."""
+    events: list[ChaosEvent] = []
+    t = rng.uniform(1.0, 3.0)
+    shape = rng.choice(["mute", "crash", "partition", "corrupt"])
+    if shape == "mute":
+        events.append(ChaosEvent(at=t, action="mute", node="leader"))
+        events.append(ChaosEvent(at=t + rng.uniform(8.0, 14.0), action="unmute", node="faulty"))
+    elif shape == "crash":
+        events.append(ChaosEvent(at=t, action="crash", node="leader"))
+        events.append(ChaosEvent(at=t + rng.uniform(6.0, 12.0), action="restart", node="faulty"))
+    elif shape == "partition":
+        events.append(ChaosEvent(at=t, action="partition", groups=(("leader",),)))
+        events.append(ChaosEvent(at=t + rng.uniform(6.0, 12.0), action="heal"))
+    else:
+        victim = rng.randrange(1, n + 1)
+        events.append(
+            ChaosEvent(at=t, action="corrupt", node=victim, fraction=rng.uniform(0.2, 0.8))
+        )
+        events.append(
+            ChaosEvent(at=t + rng.uniform(6.0, 12.0), action="uncorrupt", node=victim)
+        )
+    return events
+
+
+async def soak(
+    *, rounds: int = 5, depth: int = 16, rotation: bool = True, seed: int = 1,
+    n: int = 4, requests: int = 24, verbose: bool = True,
+) -> None:
+    """Run ``rounds`` randomized schedules, checking every invariant."""
+    import tempfile
+
+    rng = random.Random(seed)
+    for r in range(rounds):
+        with tempfile.TemporaryDirectory(prefix="chaos-soak-") as wal_root:
+            cluster = ChaosCluster(
+                wal_root, n=n, depth=depth, rotation=rotation, seed=seed + r,
+            )
+            schedule = random_schedule(rng, n)
+            await cluster.start()
+            try:
+                report = await cluster.run_schedule(
+                    schedule, requests=requests, settle_timeout=600.0
+                )
+                Invariants.fork_free(cluster)
+                Invariants.exactly_once(cluster, expected=requests)
+                Invariants.liveness_within_windows(cluster, report, slack_windows=8)
+            finally:
+                await cluster.stop()
+            if verbose:
+                kinds = [e.action for e in report.events_fired]
+                print(
+                    f"round {r}: events={kinds} decisions={report.final_decisions} "
+                    f"committed={report.final_committed} leaders={sorted(report.leaders_seen)} "
+                    f"post-heal decisions={report.decisions_after_heal} — OK"
+                )
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="SmartBFT chaos harness (scripted fault schedules)"
+    )
+    ap.add_argument("--soak", action="store_true", help="run randomized soak rounds")
+    ap.add_argument("--rounds", type=int, default=5)
+    ap.add_argument("--depth", type=int, default=16, help="pipeline_depth")
+    ap.add_argument("--no-rotation", action="store_true")
+    ap.add_argument("--seed", type=int, default=1)
+    ap.add_argument("--requests", type=int, default=24)
+    args = ap.parse_args(argv)
+    if not args.soak:
+        ap.error("nothing to do: pass --soak")
+    asyncio.run(
+        soak(
+            rounds=args.rounds,
+            depth=args.depth,
+            rotation=not args.no_rotation,
+            seed=args.seed,
+            requests=args.requests,
+        )
+    )
+    print("chaos soak: all rounds passed")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -13,6 +13,7 @@ import random
 from typing import Callable, Optional
 
 from ..messages import Message
+from ..utils.tasks import create_logged_task
 
 INCOMING_BUFFER = 1000  # network.go:18-20
 
@@ -28,6 +29,7 @@ class Node:
         self.consensus = None  # set by the harness (an App or Consensus)
         self.running = False
         self.lossy = False
+        self.muted = False  # outbound-only silence (chaos leader-mute)
         self.loss_probability = 0.0
         self.peer_loss_probability: dict[int, float] = {}
         self.mutate_send: Optional[Callable[[int, Message], Optional[Message]]] = None
@@ -42,7 +44,7 @@ class Node:
         if self.running:
             return
         self.running = True
-        self._task = asyncio.get_running_loop().create_task(
+        self._task = create_logged_task(
             self._serve(), name=f"netnode-{self.id}"
         )
 
@@ -111,6 +113,16 @@ class Node:
         self.lossy = probability > 0
         self.loss_probability = probability
 
+    def mute(self) -> None:
+        """Outbound-only silence: the node still RECEIVES everything but
+        none of its sends leave — the classic mute-leader fault (a process
+        that is alive and ingesting but whose egress is wedged).  Distinct
+        from disconnect(), which severs both directions."""
+        self.muted = True
+
+    def unmute(self) -> None:
+        self.muted = False
+
     def add_filter(self, f: Callable[[Message, int], bool]) -> None:
         """Keep a message iff every filter returns True (network.go:232-234)."""
         self.filters.append(f)
@@ -143,6 +155,11 @@ class Network:
     def __init__(self, seed: int = 0):
         self.nodes: dict[int, Node] = {}
         self.rng = random.Random(seed)
+        #: (node, peer) -> loss probability the link had BEFORE partition()
+        #: cut it.  heal() restores exactly these links to their prior
+        #: state (0.0 entries are removed), leaving independently injected
+        #: disconnect_from() cuts and fractional losses intact.
+        self._partition_cuts: dict[tuple[int, int], float] = {}
 
     def add_node(self, node_id: int) -> Node:
         node = Node(node_id, self, self.rng)
@@ -168,7 +185,7 @@ class Network:
         if src is None or dst is None:
             return
         # sender-side faults
-        if src._drops(target):
+        if src.muted or src._drops(target):
             return
         if src.mutate_send is not None:
             msg = src.mutate_send(target, msg)
@@ -187,6 +204,42 @@ class Network:
         dst = self.nodes.get(target)
         if src is None or dst is None:
             return
-        if src._drops(target) or dst._drops_inbound(source):
+        if src.muted or src._drops(target) or dst._drops_inbound(source):
             return
         dst._offer("request", source, request)
+
+    # -- partitions (chaos harness) ----------------------------------------
+
+    def partition(self, *groups: list[int]) -> None:
+        """Split the mesh into disjoint groups: messages cross group
+        boundaries in neither direction until :meth:`heal`.  Nodes not
+        named in any group form an implicit final group."""
+        named = {n for g in groups for n in g}
+        rest = [n for n in self.nodes if n not in named]
+        all_groups = [list(g) for g in groups] + ([rest] if rest else [])
+        group_of = {n: i for i, g in enumerate(all_groups) for n in g}
+        for nid, node in self.nodes.items():
+            for peer in self.nodes:
+                if peer != nid and group_of.get(peer) != group_of.get(nid):
+                    # a link some other fault already cut stays its fault's
+                    # responsibility — heal() must not reconnect it; a
+                    # fractional pre-existing loss is remembered so heal()
+                    # restores it instead of clearing the link
+                    prior = node.peer_loss_probability.get(peer, 0.0)
+                    if prior < 1.0 and (nid, peer) not in self._partition_cuts:
+                        self._partition_cuts[(nid, peer)] = prior
+                    node.disconnect_from(peer)
+
+    def heal(self) -> None:
+        """Undo :meth:`partition` — exactly the link cuts it installed,
+        restoring any pre-partition fractional loss; independently injected
+        per-peer cuts (disconnect_from) and node-level faults
+        (mute/disconnect/loss) are left as-is."""
+        for (nid, peer), prior in self._partition_cuts.items():
+            node = self.nodes.get(nid)
+            if node is not None:
+                if prior > 0.0:
+                    node.peer_loss_probability[peer] = prior
+                else:
+                    node.peer_loss_probability.pop(peer, None)
+        self._partition_cuts.clear()

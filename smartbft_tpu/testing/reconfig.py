@@ -45,6 +45,11 @@ _INT_FIELDS = (
     "num_of_ticks_behind_before_syncing",
     "decisions_per_leader",
     "request_max_bytes",
+    "pipeline_depth",
+)
+
+_STR_FIELDS = (
+    "rotation_granularity",
 )
 
 _BOOL_FIELDS = (
@@ -67,6 +72,8 @@ class ConfigMirror:
     num_of_ticks_behind_before_syncing: int = 0
     decisions_per_leader: int = 0
     request_max_bytes: int = 0
+    pipeline_depth: int = 1
+    rotation_granularity: str = "decision"
     request_batch_max_interval_ms: int = 0
     request_forward_timeout_ms: int = 0
     request_complain_timeout_ms: int = 0
@@ -94,6 +101,7 @@ class ReconfigPayload:
 
 def mirror_config(config: Configuration) -> ConfigMirror:
     kwargs = {f: getattr(config, f) for f in _INT_FIELDS}
+    kwargs.update({f: getattr(config, f) for f in _STR_FIELDS})
     kwargs.update({f: getattr(config, f) for f in _BOOL_FIELDS})
     kwargs.update({f + "_ms": round(getattr(config, f) * 1000) for f in _MS_FIELDS})
     return ConfigMirror(**kwargs)
@@ -101,6 +109,7 @@ def mirror_config(config: Configuration) -> ConfigMirror:
 
 def unmirror_config(m: ConfigMirror) -> Configuration:
     kwargs = {f: getattr(m, f) for f in _INT_FIELDS}
+    kwargs.update({f: getattr(m, f) for f in _STR_FIELDS})
     kwargs.update({f: getattr(m, f) for f in _BOOL_FIELDS})
     kwargs.update({f: getattr(m, f + "_ms") / 1000.0 for f in _MS_FIELDS})
     return Configuration(**kwargs)

@@ -134,6 +134,19 @@ def view_metadata_of(p: Proposal) -> ViewMetadata:
     return decode(ViewMetadata, p.metadata)
 
 
+def blacklist_of(proposal: Proposal) -> list[int]:
+    """The blacklist carried in a checkpoint proposal's metadata (empty at
+    genesis).  The single accessor every consumer shares — controller
+    routing, view-changer leader election, and the windowed view's
+    window-blacklist seed — so the blacklist the ladder view change
+    preserves in checkpoint metadata is read identically everywhere.
+    Returns a fresh list (callers may mutate); decodes via the bounded
+    cache."""
+    if not proposal.metadata:
+        return []
+    return list(cached_view_metadata(proposal.metadata).black_list)
+
+
 @functools.lru_cache(maxsize=1024)
 def cached_view_metadata(metadata: bytes) -> ViewMetadata:
     """Decode ViewMetadata with a bounded cache.

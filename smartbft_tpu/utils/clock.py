@@ -20,6 +20,8 @@ import itertools
 import time
 from typing import Awaitable, Callable, Optional
 
+from .tasks import create_logged_task
+
 
 class TaskHandle:
     """Cancelable handle for a scheduled callback (sched.go's Task)."""
@@ -148,7 +150,7 @@ class WallClockDriver:
 
     def start(self) -> None:
         self._stop = asyncio.Event()
-        self._task = asyncio.get_running_loop().create_task(self._run())
+        self._task = create_logged_task(self._run(), name="wallclock-driver")
 
     async def stop(self) -> None:
         self._stop.set()

@@ -33,6 +33,8 @@ import logging
 import weakref
 from typing import Protocol
 
+from ..utils.tasks import create_logged_task
+
 
 class _GroupSyncable(Protocol):
     def _group_sync(self) -> None: ...
@@ -70,7 +72,7 @@ class GroupCommitScheduler:
         self._pending.setdefault(wal, []).append(fut)
         self.syncs_requested += 1
         if self._task is None or self._task.done():
-            self._task = loop.create_task(self._drain(), name="wal-group-commit")
+            self._task = create_logged_task(self._drain(), name="wal-group-commit")
         return fut
 
     async def _drain(self) -> None:

@@ -19,6 +19,29 @@ from smartbft_tpu.utils.jaxenv import force_cpu  # noqa: E402
 force_cpu(virtual_devices=8)
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running soaks excluded from tier-1 (-m 'not slow'); "
+        "run explicitly or via python -m smartbft_tpu.testing.chaos --soak",
+    )
+
+
+def require_shard_map() -> None:
+    """Capability gate for mesh quorum-step tests: skip when this jax
+    build exposes NEITHER jax.shard_map nor jax.experimental.shard_map
+    (engine.resolve_shard_map handles the API drift between them)."""
+    import pytest
+
+    from smartbft_tpu.parallel.engine import shard_map_available
+
+    if not shard_map_available():
+        pytest.skip(
+            "no usable shard_map API in this jax build (neither "
+            "jax.shard_map nor jax.experimental.shard_map)"
+        )
+
+
 def require_native(available: bool, what: str) -> None:
     """Gate a test on a native backend — loudly.
 

@@ -198,7 +198,11 @@ def test_pallas_ops_plumbing_interpret():
         acc = jax.lax.fori_loop(
             0, n_rows, body, jnp.zeros((nb,), jnp.uint32)
         )
-        out_ref[0, :] = acc + ops.dig_at(0)
+        # INV_DIGITS is int32 and dig_at is an SMEM scalar read; the
+        # uint32 + int32 sum promotes to int32, which interpret mode's
+        # strict ref-dtype check rejects on store (the fused kernel only
+        # ever COMPARES digits, so production never hits the promotion)
+        out_ref[0, :] = acc + ops.dig_at(0).astype(jnp.uint32)
 
     digs = jnp.asarray(pe.INV_DIGITS).reshape(1, -1)
     a = jnp.arange(nb, dtype=jnp.uint32).reshape(1, nb)
@@ -323,7 +327,10 @@ def test_guarded_kernel_transient_then_permanent(monkeypatch):
     monkeypatch.setattr(p256, "verify_kernel", fake_verify_kernel, raising=False)
     import jax as real_jax
 
-    monkeypatch.setattr(real_jax, "jit", lambda fn: fn)  # count real calls
+    # count real calls; must accept decorator kwargs (static_argnames) —
+    # modules lazily imported under this patch (pallas_comb via _kernel)
+    # apply jax.jit with them at import time
+    monkeypatch.setattr(real_jax, "jit", lambda fn=None, **kw: fn if fn is not None else (lambda f: f))
     eng = JaxVerifyEngine(pad_sizes=(8,), scheme=p256)
 
     for i in range(4):

@@ -57,7 +57,7 @@ def test_naive_chain_per_block_ordering_all_nodes(tmp_path):
             for seq in range(1, 6):
                 await nodes[0].submit("alice", f"tx{seq}", payload=b"")
                 for node, q in zip(nodes, listeners):
-                    header, txns = await asyncio.wait_for(q.get(), timeout=30)
+                    header, txns = await asyncio.wait_for(q.get(), timeout=90)
                     assert header.sequence == seq, (node.id, header)
                     assert [decode(naive_chain.Transaction, t).tx_id
                             for t in txns] == [f"tx{seq}"], node.id
@@ -156,7 +156,7 @@ def test_naive_chain_restart_mid_stream(tmp_path):
         try:
             async def order(k: int) -> None:
                 await nodes[0].submit("alice", f"tx{k}", payload=b"")
-                header, _ = await asyncio.wait_for(listener.get(), timeout=30)
+                header, _ = await asyncio.wait_for(listener.get(), timeout=90)
                 assert header.sequence == k
 
             for k in (1, 2, 3):

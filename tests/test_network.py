@@ -178,3 +178,37 @@ def test_unknown_endpoints_ignored():
         assert sinks[1].msgs == []
 
     asyncio.run(run())
+
+
+def test_heal_undoes_only_partition_cuts():
+    """heal() removes exactly the link cuts partition() installed;
+    independently injected disconnect_from() cuts survive."""
+    from smartbft_tpu.testing.network import Network
+
+    net = Network(seed=1)
+    for i in (1, 2, 3, 4):
+        net.add_node(i)
+    net.nodes[1].disconnect_from(2)  # an unrelated fault, pre-partition
+    net.partition([1], [2, 3, 4])
+    assert net.nodes[3].peer_loss_probability.get(1) == 1.0
+    net.heal()
+    # the partition's cuts are gone...
+    assert 1 not in net.nodes[3].peer_loss_probability
+    assert 3 not in net.nodes[1].peer_loss_probability
+    # ...but the independent 1->2 cut is untouched
+    assert net.nodes[1].peer_loss_probability.get(2) == 1.0
+
+
+def test_heal_restores_pre_partition_fractional_loss():
+    """A fractional per-peer loss that partition() overwrote comes back on
+    heal() instead of being cleared."""
+    from smartbft_tpu.testing.network import Network
+
+    net = Network(seed=1)
+    for i in (1, 2, 3, 4):
+        net.add_node(i)
+    net.nodes[2].peer_loss_probability[1] = 0.5  # pre-existing lossy link
+    net.partition([1], [2, 3, 4])
+    assert net.nodes[2].peer_loss_probability.get(1) == 1.0
+    net.heal()
+    assert net.nodes[2].peer_loss_probability.get(1) == 0.5

@@ -26,7 +26,13 @@ from smartbft_tpu.core.viewchanger import (
     check_in_flight_ladder,
     validate_in_flight_ladder,
 )
-from smartbft_tpu.messages import Proposal, ViewData, ViewMetadata
+from smartbft_tpu.messages import (
+    PreparesFrom,
+    PrePrepare,
+    Proposal,
+    ViewData,
+    ViewMetadata,
+)
 from smartbft_tpu.testing.app import App, SharedLedgers, fast_config, wait_for
 from smartbft_tpu.testing.network import Network
 from smartbft_tpu.utils.clock import Scheduler
@@ -540,6 +546,85 @@ def test_restart_mid_window_restores_slot_ladder(tmp_path, depth):
     asyncio.run(run())
 
 
+@pytest.mark.parametrize("corruption", ["torn-tail", "crc-flip"])
+def test_restart_mid_window_with_wal_corruption_repairs(tmp_path, corruption):
+    """Round-6 satellite: a pipelined mid-window crash leaves undelivered
+    P/C records in the WAL suffix; the crash additionally TEARS the tail
+    (partial frame) or flips a byte (CRC-chain break).  Restart must route
+    through RepairableWALError -> repair() (initialize_and_read_all),
+    rebuild the surviving slot ladder, and the cluster must finish every
+    sequence with exactly-once delivery — the repaired node included."""
+
+    import glob
+
+    from smartbft_tpu.messages import Commit as CommitMsg
+
+    async def run():
+        apps, scheduler, network, shared = make_cluster(
+            tmp_path,
+            config_fn=lambda i: pipe_config(i, depth=4, request_batch_max_interval=0.05),
+        )
+        for a in apps:
+            await a.start()
+        await apps[0].submit("c", "warm")
+        await wait_for(lambda: all(committed(a) >= 1 for a in apps), scheduler, 60.0)
+
+        # freeze commits so follower WALs accumulate undelivered P/C records
+        for i in (1, 2, 3, 4):
+            network.nodes[i].add_filter(lambda m, s: not isinstance(m, CommitMsg))
+        for k in range(6):
+            await apps[0].submit("c", f"wal-{k}")
+        await wait_for(
+            lambda: len(apps[2].consensus.in_flight.ladder()) >= 2, scheduler, 120.0
+        )
+
+        # crash node 3, then corrupt its WAL tail while it is down
+        await apps[2].stop()
+        wal_files = sorted(glob.glob(os.path.join(str(tmp_path), "wal-3", "*.wal")))
+        assert wal_files, "node 3 has no WAL files"
+        last = wal_files[-1]
+        size = os.path.getsize(last)
+        if corruption == "torn-tail":
+            with open(last, "r+b") as f:
+                f.truncate(size - 5)  # mid-frame: a torn last record
+        else:
+            with open(last, "r+b") as f:
+                f.seek(size - 9)  # inside the last frame's payload
+                b = f.read(1)
+                f.seek(size - 9)
+                f.write(bytes([b[0] ^ 0xFF]))
+
+        await apps[2].start()
+        # the auto-repair path must have engaged, not a silent fresh start
+        assert any(
+            "attempting repair" in line for line in apps[2].logger.lines
+        ), "initialize_and_read_all never attempted repair"
+        assert os.path.exists(last + ".copy"), "repair must keep a .copy"
+        view = apps[2].consensus.controller.curr_view
+        assert hasattr(view, "slots"), "restarted node must run a WindowedView"
+
+        # heal; every frozen sequence must commit everywhere, exactly once
+        for i in (1, 2, 3, 4):
+            network.nodes[i].clear_filters()
+        await wait_for(lambda: all(committed(a) >= 7 for a in apps), scheduler, 600.0)
+        l0 = [d.proposal.payload for d in apps[0].ledger()]
+        for a in apps[1:]:
+            la = [d.proposal.payload for d in a.ledger()]
+            m = min(len(l0), len(la))
+            assert l0[:m] == la[:m]
+        for a in apps:
+            infos = [
+                str(i)
+                for d in a.ledger()
+                for i in a.requests_from_proposal(d.proposal)
+            ]
+            assert len(infos) == len(set(infos)), f"node {a.id} duplicate delivery"
+        for a in apps:
+            await a.stop()
+
+    asyncio.run(run())
+
+
 def test_pipelined_reconfig_add_node(tmp_path):
     """Dynamic reconfiguration mid-stream with the window active: a
     reconfig decision (grow 4 -> 5) lands among pipelined traffic; every
@@ -680,10 +765,50 @@ def test_pipelined_soak_with_faults(tmp_path):
     asyncio.run(run())
 
 
+def test_rotation_state_reads_live_view_number():
+    """WAL restore can raise the view's number after construction
+    (restore_window adopts the records' view); the deterministic blacklist
+    recomputation must see the LIVE number or a restored follower diverges
+    from the leader's metadata.view_id."""
+    v = make_wview(window=4, decisions_per_leader=8, retrieve_checkpoint=ckpt(0))
+    assert v._rotation.get_view_number() == 0
+    v.number = 3  # what restore_window's view adoption does
+    assert v._rotation.get_view_number() == 3
+
+
+def test_rotation_restore_updates_both_blacklist_frontiers():
+    """A leader restarting mid-window must stamp the WINDOW blacklist (from
+    the last restored, already-verified proposal) into its next mid-window
+    metadata — not the checkpoint's possibly-older one."""
+    from smartbft_tpu.messages import ProposedRecord, Prepare as Prep
+
+    # checkpoint at seq 4 carries blacklist [2]; the window-first proposal
+    # at seq 5 recomputed it to [3] before the crash
+    v = make_wview(window=4, proposal_sequence=5, decisions_in_view=4,
+                   decisions_per_leader=8,
+                   retrieve_checkpoint=ckpt(4, black_list=[2]))
+    assert v._staged_blacklist == [2] and v._proposing_blacklist == [2]
+    pp = PrePrepare(view=0, seq=5, proposal=Proposal(
+        payload=b"b", metadata=encode(ViewMetadata(
+            view_id=0, latest_sequence=5, decisions_in_view=4, black_list=[3],
+        ))))
+    v.restore_window([ProposedRecord(
+        pre_prepare=pp, prepare=Prep(view=0, seq=5, digest="d"),
+    )])
+    assert v._staged_blacklist == [3]
+    assert v._proposing_blacklist == [3]
+    # and the next mid-window metadata restates the restored window blacklist
+    import smartbft_tpu.codec as codec
+    v._next_propose_seq = 6
+    md = codec.decode(ViewMetadata, v.get_metadata())
+    assert list(md.black_list) == [3]
+
+
 # -- launch-shadow overlap ----------------------------------------------------
 
 def make_wview(*, self_id=2, leader_id=1, proposal_sequence=1, window=4,
-               decider=None, capacity_cb=None):
+               decider=None, capacity_cb=None, decisions_per_leader=0,
+               decisions_in_view=0, retrieve_checkpoint=None):
     """A WindowedView over hand-rolled fakes (no network, no controller)."""
     from smartbft_tpu.core.pipeline import WindowedView
     from smartbft_tpu.core.view import ViewSequencesHolder
@@ -719,6 +844,9 @@ def make_wview(*, self_id=2, leader_id=1, proposal_sequence=1, window=4,
         def verify_consenter_sigs_batch(self, sigs, prop):
             return [s.msg for s in sigs]
 
+        def auxiliary_data(self, msg):
+            return msg
+
     class WSigner:
         def sign_proposal(self, p, aux):
             return Signature(signer=2, value=b"v", msg=aux)
@@ -728,11 +856,163 @@ def make_wview(*, self_id=2, leader_id=1, proposal_sequence=1, window=4,
         quorum=3, number=0, decider=decider, failure_detector=WFd(),
         synchronizer=WSync(), logger=RecordingLogger("wview"), comm=WComm(),
         verifier=WVerifier(), signer=WSigner(),
-        proposal_sequence=proposal_sequence, decisions_in_view=0,
-        state=WState(), retrieve_checkpoint=lambda: (Proposal(), []),
+        proposal_sequence=proposal_sequence, decisions_in_view=decisions_in_view,
+        state=WState(),
+        retrieve_checkpoint=retrieve_checkpoint or (lambda: (Proposal(), [])),
         view_sequences=ViewSequencesHolder(), window=window,
-        capacity_cb=capacity_cb,
+        capacity_cb=capacity_cb, decisions_per_leader=decisions_per_leader,
     )
+
+
+# -- window-granular rotation -------------------------------------------------
+
+def ckpt(seq: int, black_list=(), sigs=()):
+    """A checkpoint closure returning a proposal whose metadata sits at
+    ``seq`` (the window anchor) with the given blacklist."""
+    prop = Proposal(
+        payload=b"anchor",
+        metadata=encode(ViewMetadata(
+            view_id=0, latest_sequence=seq, decisions_in_view=seq,
+            black_list=list(black_list),
+        )),
+    )
+    return lambda: (prop, list(sigs))
+
+
+def test_rotation_window_grid_is_cluster_agreed():
+    """Window-first is derived from the per-view decision count, so a view
+    constructed MID-window (crash-restart, sync join) agrees with the
+    cluster's grid instead of starting a fresh one."""
+    v = make_wview(window=4, proposal_sequence=1, decisions_per_leader=8,
+                   retrieve_checkpoint=ckpt(0))
+    assert [s for s in range(1, 10) if v._is_window_first(s)] == [1, 5, 9]
+    # a restarted node whose view starts at seq 7 (dec 6) must agree
+    r = make_wview(window=4, proposal_sequence=7, decisions_in_view=6,
+                   decisions_per_leader=8, retrieve_checkpoint=ckpt(6))
+    assert [s for s in range(7, 12) if r._is_window_first(s)] == [9]
+
+
+def test_rotation_propose_gate_confines_to_frontier_window():
+    """With rotation on, the leader may not propose past the delivery
+    frontier's window — the next window's first pre-prepare chains to an
+    anchor certificate that does not exist yet."""
+    v = make_wview(self_id=1, leader_id=1, window=4, proposal_sequence=1,
+                   decisions_per_leader=8, retrieve_checkpoint=ckpt(0))
+    for nxt in (1, 2, 3, 4):
+        v._next_propose_seq = nxt
+        assert v.can_accept_more_proposals(), nxt
+    # window [1,5) not yet delivered: seq 5 (window-first) is blocked even
+    # though the rotation-off shadow would have admitted it
+    v._next_propose_seq = 5
+    v._commit_frontier = 4
+    assert not v.can_accept_more_proposals()
+    # frontier delivered the whole window AND the checkpoint reached the
+    # anchor: the next window opens
+    v.proposal_sequence = 5
+    v.retrieve_checkpoint = ckpt(4)
+    v._rotation.retrieve_checkpoint = v.retrieve_checkpoint
+    assert v.can_accept_more_proposals()
+
+
+def test_rotation_propose_gate_waits_for_checkpoint():
+    """proposal_sequence can lead the checkpoint by one decide rendezvous;
+    a window-first proposal must wait for the certificate, not just the
+    frontier."""
+    v = make_wview(self_id=1, leader_id=1, window=4, proposal_sequence=5,
+                   decisions_in_view=4, decisions_per_leader=8,
+                   retrieve_checkpoint=ckpt(3))  # checkpoint NOT at anchor 4
+    v._next_propose_seq = 5
+    assert not v.can_accept_more_proposals()
+    v.retrieve_checkpoint = ckpt(4)
+    assert v.can_accept_more_proposals()
+
+
+def test_rotation_metadata_boundary_vs_midwindow():
+    """Window-first metadata carries the recomputed blacklist + anchor
+    certificate digest; mid-window metadata restates the window blacklist
+    with no digest."""
+    import smartbft_tpu.codec as codec
+    from smartbft_tpu.types import commit_signatures_digest
+    from smartbft_tpu.messages import Signature as Sig
+
+    sigs = [Sig(signer=s, value=b"v", msg=encode(PreparesFrom(ids=[1, 2, 3])))
+            for s in (2, 3, 4)]
+    v = make_wview(self_id=1, leader_id=1, window=4, proposal_sequence=5,
+                   decisions_in_view=4, decisions_per_leader=8,
+                   retrieve_checkpoint=ckpt(4, black_list=[3], sigs=sigs))
+    v._next_propose_seq = 5  # window-first (dec 4 % 4 == 0)
+    md = codec.decode(ViewMetadata, v.get_metadata())
+    assert md.latest_sequence == 5 and md.decisions_in_view == 4
+    assert md.prev_commit_signature_digest == commit_signatures_digest(sigs)
+    # the blacklist was recomputed (node 3 attested alive by 3 witnesses ->
+    # pruned per util.go:502-541)
+    assert list(md.black_list) == []
+    # mid-window: same blacklist restated, no digest
+    v._next_propose_seq = 6
+    md6 = codec.decode(ViewMetadata, v.get_metadata())
+    assert list(md6.black_list) == list(md.black_list)
+    assert md6.prev_commit_signature_digest == b""
+
+
+def test_rotation_midwindow_verify_rejects_blacklist_drift():
+    """A follower must reject a mid-window proposal whose blacklist differs
+    from the one the window's first proposal established, and any
+    mid-window certificate."""
+
+    async def run():
+        from smartbft_tpu.messages import Signature as Sig
+
+        v = make_wview(window=4, proposal_sequence=5, decisions_in_view=4,
+                       decisions_per_leader=8, retrieve_checkpoint=ckpt(4))
+        v._staged_blacklist = [3]
+        slot = type("S", (), {"seq": 6})()
+        good = PrePrepare(view=0, seq=6, proposal=Proposal(
+            payload=b"b", metadata=encode(ViewMetadata(
+                view_id=0, latest_sequence=6, decisions_in_view=5, black_list=[3],
+            ))))
+        await v._verify_proposal(slot, good)  # blacklist restated: accepted
+        drift = PrePrepare(view=0, seq=6, proposal=Proposal(
+            payload=b"b", metadata=encode(ViewMetadata(
+                view_id=0, latest_sequence=6, decisions_in_view=5, black_list=[],
+            ))))
+        with pytest.raises(ValueError, match="window blacklist"):
+            await v._verify_proposal(slot, drift)
+        cert = PrePrepare(
+            view=0, seq=6,
+            prev_commit_signatures=[Sig(signer=2, value=b"v", msg=b"m")],
+            proposal=Proposal(payload=b"b", metadata=encode(ViewMetadata(
+                view_id=0, latest_sequence=6, decisions_in_view=5, black_list=[3],
+            ))))
+        with pytest.raises(ValueError, match="mid-window"):
+            await v._verify_proposal(slot, cert)
+
+    asyncio.run(run())
+
+
+def test_rotation_window_first_staging_waits_for_delivery():
+    """A window-first slot must not stage (send its prepare) until every
+    lower sequence has delivered — the chain target is the anchor."""
+
+    async def run():
+        v = make_wview(window=2, proposal_sequence=1, decisions_per_leader=4,
+                       retrieve_checkpoint=ckpt(0))
+        # seqs 1,2 form window 0; seq 3 is window-first of window 1
+        from smartbft_tpu.core.pipeline import _Slot
+
+        for seq in (1, 2, 3):
+            v.slots[seq] = _Slot(seq=seq)
+            v.slots[seq].pre_prepare = PrePrepare(
+                view=0, seq=seq, proposal=Proposal(
+                    payload=b"b", metadata=encode(ViewMetadata(
+                        view_id=0, latest_sequence=seq, decisions_in_view=seq - 1,
+                    ))))
+        await v._advance()
+        phases = {s: v.slots[s].phase for s in sorted(v.slots)}
+        from smartbft_tpu.core.state import COMMITTED, PROPOSED
+        assert phases[1] == PROPOSED and phases[2] == PROPOSED
+        assert phases[3] == COMMITTED, "window-first staged before anchor delivered"
+
+    asyncio.run(run())
 
 
 def test_shadow_gate_opens_when_base_window_commits():

@@ -306,3 +306,25 @@ def test_reconfig_under_traffic(tmp_path):
         await stop_all(apps)
 
     asyncio.run(run())
+
+
+def test_config_mirror_round_trips_pipelined_rotation_fields():
+    """A config-bearing reconfig must carry the pipelined-rotation mode:
+    dropping pipeline_depth/rotation_granularity on the wire would silently
+    reset a windowed-rotation cluster to single-slot defaults mid-run."""
+    import dataclasses
+
+    from smartbft_tpu.testing.app import fast_config
+    from smartbft_tpu.testing.reconfig import mirror_config, unmirror_config
+
+    cfg = dataclasses.replace(
+        fast_config(1), pipeline_depth=16, leader_rotation=True,
+        decisions_per_leader=2, rotation_granularity="window",
+    )
+    rt = unmirror_config(mirror_config(cfg))
+    assert rt.pipeline_depth == 16
+    assert rt.rotation_granularity == "window"
+    assert rt.leader_rotation and rt.decisions_per_leader == 2
+    # self_id is per-node and deliberately not mirrored (consensus applies
+    # with_self_id on receipt)
+    rt.with_self_id(1).validate()

@@ -1,0 +1,70 @@
+"""Audit: no silent background tasks anywhere in smartbft_tpu.
+
+Every ``create_task`` call site must go through
+``smartbft_tpu.utils.tasks.create_logged_task``, whose done-callback
+retrieves and logs terminal exceptions — a consensus component whose run
+loop died silently is the one failure mode the chaos harness cannot
+observe from outside.  Plus behavioral pins for the helper itself.
+"""
+
+import asyncio
+import pathlib
+import re
+
+import pytest
+
+PKG = pathlib.Path(__file__).resolve().parent.parent / "smartbft_tpu"
+ALLOWED = {PKG / "utils" / "tasks.py"}  # the helper's own create_task
+
+
+def test_every_create_task_site_is_logged():
+    raw = re.compile(r"\bcreate_task\(")
+    offenders = []
+    for path in sorted(PKG.rglob("*.py")):
+        if path in ALLOWED:
+            continue
+        for lineno, line in enumerate(path.read_text().splitlines(), 1):
+            if raw.search(line) and "create_logged_task(" not in line:
+                offenders.append(f"{path.relative_to(PKG.parent)}:{lineno}: {line.strip()}")
+    assert not offenders, (
+        "raw asyncio create_task call sites (use utils.tasks."
+        "create_logged_task so background failure is never silent):\n"
+        + "\n".join(offenders)
+    )
+
+
+def test_create_logged_task_logs_background_death():
+    from smartbft_tpu.utils.tasks import create_logged_task
+
+    class Log:
+        def __init__(self):
+            self.lines = []
+
+        def errorf(self, fmt, *a):
+            self.lines.append(fmt % a)
+
+    async def run():
+        log = Log()
+
+        async def boom():
+            raise RuntimeError("kaput")
+
+        t = create_logged_task(boom(), name="doomed", logger=log)
+        with pytest.raises(RuntimeError):
+            await t  # awaiting still re-raises to the awaiter
+        await asyncio.sleep(0)
+        assert any("doomed" in l and "kaput" in l for l in log.lines), log.lines
+
+        # cancellation is NOT logged as a death
+        async def forever():
+            await asyncio.Event().wait()
+
+        t2 = create_logged_task(forever(), name="reaped", logger=log)
+        await asyncio.sleep(0)
+        t2.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await t2
+        await asyncio.sleep(0)
+        assert not any("reaped" in l for l in log.lines), log.lines
+
+    asyncio.run(run())

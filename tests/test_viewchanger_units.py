@@ -337,3 +337,53 @@ def test_restart_after_close_drains_stale_stop_sentinel():
         await vc._task
 
     asyncio.run(run())
+
+
+def test_straggler_timeout_clears_vote_state_and_forces_sync(monkeypatch):
+    """ADVICE round-5 escalation: when a cancelled prior run loop ignores
+    cancellation past the straggler wait, the fresh loop must NOT proceed
+    into shared mutable vote-set state — it clears the view-change
+    bookkeeping (peer resends rebuild it) and forces a sync."""
+
+    async def run():
+        from smartbft_tpu.core.viewchanger import ViewChanger
+        from smartbft_tpu.messages import ViewChange
+
+        monkeypatch.setattr(ViewChanger, "STRAGGLER_WAIT", 0.05)
+        vc = _bare_viewchanger()
+        synced = []
+
+        class Sync:
+            def sync(self):
+                synced.append(1)
+
+        vc.synchronizer = Sync()
+
+        release = asyncio.Event()
+
+        async def stubborn_prior():
+            # swallows its cancellation (a misbehaving embedder callback)
+            # and keeps mutating shared vote state afterwards
+            while True:
+                try:
+                    await release.wait()
+                    return
+                except asyncio.CancelledError:
+                    vc.view_change_msgs.register_vote(3, ViewChange(next_view=1))
+                    continue
+
+        vc._task = asyncio.get_running_loop().create_task(stubborn_prior())
+        await asyncio.sleep(0)
+        vc.start(0)  # cancels the prior, waits STRAGGLER_WAIT, escalates
+        await asyncio.sleep(0.3)
+        assert synced == [1], "escalation must force a sync"
+        assert len(vc.view_change_msgs.voted) == 0, (
+            "straggler-written vote state must be discarded"
+        )
+        assert not vc._check_timeout
+        assert not vc._task.done(), "the fresh run loop must keep serving"
+        release.set()
+        vc.close()
+        await vc._task
+
+    asyncio.run(run())
