@@ -255,6 +255,9 @@ def e2e_bench(cpu_mode: bool) -> None:
         "batch_fill_pct": dev_row.get("batch_fill_pct"),
         "launch_probe_ms": dev_row.get("launch_probe_ms"),
         "baseline_launch_probe_ms": cpu_row.get("launch_probe_ms"),
+        # breaker accounting rides along so a degraded (host-fallback)
+        # device row is never mistaken for a healthy device run
+        "breaker": dev_row.get("breaker"),
         "tx_per_sec_probe_normalized": norm_tx,
         "vs_baseline_probe_normalized": round(
             norm_tx / cpu_row["tx_per_sec"], 3)

@@ -230,11 +230,14 @@ async def run_cluster(engine_kind: str, n: int, requests: int, batch: int,
     network = Network(seed=13)
     shared = SharedLedgers()
     tmp = tempfile.mkdtemp(prefix=f"bench-tput-{engine_kind}-")
+    providers = {
+        i: provider_cls(rings[i], engine=engines[i], coalescer=coalescers[i])
+        for i in node_ids
+    }
     apps = [
         App(i, network, shared, scheduler,
             wal_dir=os.path.join(tmp, f"wal-{i}"), config=cfg(i),
-            crypto=provider_cls(rings[i], engine=engines[i],
-                                coalescer=coalescers[i]))
+            crypto=providers[i])
         for i in node_ids
     ]
     try:
@@ -282,6 +285,21 @@ async def run_cluster(engine_kind: str, n: int, requests: int, batch: int,
         window_launches = [
             b - a for a, b in zip([0] + marks[:-1], marks)
         ]
+        # verify-plane fault accounting: breaker state + fallback counts in
+        # EVERY row, so a degraded (host-fallback) run is never silently
+        # reported as a device run.  Shared mode has one coalescer; in
+        # per-replica mode ANY node degrading must show, so snapshots are
+        # aggregated (counters summed, flags OR-ed) across all nodes.
+        snaps = [
+            co.fault_snapshot()
+            for co in {id(providers[i].coalescer): providers[i].coalescer
+                       for i in node_ids}.values()
+        ]
+        breaker_row = {
+            k: (any(s[k] for s in snaps) if isinstance(snaps[0][k], bool)
+                else sum(s[k] for s in snaps))
+            for k in snaps[0]
+        }
         return {
             "engine": engine_kind,
             "scheme": scheme_name,
@@ -301,6 +319,7 @@ async def run_cluster(engine_kind: str, n: int, requests: int, batch: int,
             "launch_probe_ms": round(launch_probe_ms, 2),
             "sigs_verified": stats.sigs_verified,
             "elapsed_s": round(elapsed, 2),
+            "breaker": breaker_row,
         }
     finally:
         for a in apps:

@@ -120,6 +120,31 @@ class Configuration:
     # leader does not yet hold.  k = 1 is the reference-faithful default.
     pipeline_depth: int = 1
 
+    # Verify-plane fault tolerance (no reference counterpart — the
+    # reference verifies each signature on its own goroutine, view.go:537-
+    # 541, which cannot hang or fail as a unit; routing the quorum-verify
+    # hot path through one shared device engine makes the device a single
+    # point of failure).  Consumed when the Consensus facade wires a
+    # CryptoProvider's coalescer (crypto/provider.VerifyFaultPolicy.
+    # from_config).  These three durations are WALL-CLOCK seconds even
+    # under the logical test clock: the engine runs on worker threads the
+    # tick scheduler cannot observe.
+    # - verify_launch_timeout: deadline per coalescer flush; on expiry the
+    #   in-flight launch is abandoned (its late result discarded) and the
+    #   wave enters the retry path.  Default is generous against the
+    #   measured 0.11-1.5 s launch-weather range (PERF.md).
+    # - verify_launch_retries: re-submissions (exponential backoff with
+    #   jitter) of a failed/timed-out wave before it falls back to host.
+    # - verify_breaker_threshold: consecutive launch failures that trip
+    #   the host-fallback circuit breaker open (a permanent kernel error
+    #   trips it immediately).
+    # - verify_probe_interval: cadence of the background canary probe that
+    #   re-tries the device while the breaker is open.
+    verify_launch_timeout: float = 30.0
+    verify_launch_retries: int = 2
+    verify_breaker_threshold: int = 3
+    verify_probe_interval: float = 2.0
+
     def validate(self) -> None:
         def positive(name: str) -> None:
             v = getattr(self, name)
@@ -145,8 +170,13 @@ class Configuration:
             "collect_timeout",
             "request_max_bytes",
             "request_pool_submit_timeout",
+            "verify_launch_timeout",
+            "verify_breaker_threshold",
+            "verify_probe_interval",
         ):
             positive(field)
+        if self.verify_launch_retries < 0:
+            raise ConfigError("verify_launch_retries should not be negative")
         if self.request_batch_max_count > self.request_batch_max_bytes:
             raise ConfigError("request_batch_max_count is bigger than request_batch_max_bytes")
         if self.request_forward_timeout > self.request_complain_timeout:

@@ -150,10 +150,31 @@ class Consensus:
             return 0
         return self.controller.get_leader_id()
 
+    def _wire_verify_plane(self) -> None:
+        """Arm the verifier's verify-plane fault machinery from this node's
+        Configuration (launch deadline, retry budget, breaker threshold,
+        probe cadence) and attach the TPU metrics bundle, so breaker
+        transitions are counted where the embedder can see them.  The
+        coalescer fills only unset pieces (a shared cross-replica coalescer
+        keeps its explicit settings); verifiers without the seam no-op."""
+        configure = getattr(self.verifier, "configure_fault_policy", None)
+        if configure is None:
+            return
+        from .crypto.provider import VerifyFaultPolicy
+
+        try:
+            configure(
+                policy=VerifyFaultPolicy.from_config(self.config),
+                metrics=self.metrics.tpu,
+            )
+        except Exception as e:  # noqa: BLE001 — wiring must not kill start
+            self.logger.warnf("verify-plane fault wiring failed: %r", e)
+
     async def start(self) -> None:
         """consensus.go:108-165."""
         self._loop = asyncio.get_running_loop()
         self.validate_configuration(self.comm.nodes())
+        self._wire_verify_plane()
 
         self._set_nodes(self.comm.nodes())
         self.in_flight = InFlightData()
@@ -223,6 +244,7 @@ class Consensus:
             raise
 
         self._set_nodes(list(reconfig.current_nodes))
+        self._wire_verify_plane()  # the reconfig may carry new verify knobs
         self._create_components()
         self.pool.change_options(
             self.controller,

@@ -105,7 +105,12 @@ from ..messages import (
     ViewMetadata,
 )
 from ..metrics import BlacklistMetrics, ViewMetrics
-from ..types import blacklist_of, cached_view_metadata, proposal_digest
+from ..types import (
+    VerifyPlaneDown,
+    blacklist_of,
+    cached_view_metadata,
+    proposal_digest,
+)
 from .rotation import RotationState
 from .state import ABORT, COMMITTED, PREPARED, PROPOSED
 from .util import VoteSet, compute_quorum
@@ -700,6 +705,16 @@ class WindowedView:
         proposal = pp.proposal
         try:
             requests = await self._verify_proposal(slot, pp)
+        except VerifyPlaneDown as e:
+            # the verify PLANE is down, not the proposal: don't blame the
+            # leader — escalate to sync and re-validate after recovery
+            self.logger.errorf(
+                "Verify plane down validating seq %d: %s; aborting view "
+                "and syncing", slot.seq, e,
+            )
+            self.synchronizer.sync()
+            self._stop()
+            raise ViewAborted() from e
         except Exception as e:
             self.logger.warnf(
                 "%d received bad proposal from %d at seq %d: %s",
@@ -908,17 +923,20 @@ class WindowedView:
         slot.verify_inflight = False
         if isinstance(results, Exception):
             slot.verify_failures += 1
+            plane_down = isinstance(results, VerifyPlaneDown)
             self.logger.warnf(
                 "Batched commit verification failed for seq %d (attempt %d): %r",
                 seq, slot.verify_failures, results,
             )
-            if slot.verify_failures >= 3:
-                # a persistently failing engine must not spin retries
-                # forever; escalate the way a bad proposal does (the
-                # single-slot View lets the exception kill the view task)
+            if plane_down or slot.verify_failures >= 3:
+                # VerifyPlaneDown means the coalescer already exhausted its
+                # deadline+retry budget AND the host fallback — escalate at
+                # once; other engine failures get a few view-level retries
+                # first.  Either way: sync instead of killing the view task.
                 self.logger.errorf(
-                    "Verification engine failing persistently at seq %d; "
-                    "aborting view and syncing", seq,
+                    "Verify plane %s at seq %d; aborting view and syncing",
+                    "down (retries + host fallback exhausted)" if plane_down
+                    else "failing persistently", seq,
                 )
                 self._stop()
                 self.synchronizer.sync()

@@ -44,7 +44,7 @@ from ..messages import (
     ViewMetadata,
 )
 from ..metrics import BlacklistMetrics, ViewMetrics
-from ..types import proposal_digest
+from ..types import VerifyPlaneDown, proposal_digest
 from .rotation import RotationState
 from .state import ABORT, COMMITTED, PREPARED, PROPOSED
 from .util import VoteSet, compute_quorum
@@ -490,6 +490,17 @@ class View:
 
         try:
             requests = await self._verify_proposal(proposal, prev_commits)
+        except VerifyPlaneDown as e:
+            # the verify PLANE is down (retries + fallback exhausted), not
+            # the proposal: don't blame the leader — escalate to sync and
+            # let restore/catch-up re-validate once the plane recovers
+            self.logger.errorf(
+                "Verify plane down validating proposal at seq %d: %s; "
+                "aborting view and syncing", self.proposal_sequence, e,
+            )
+            self.synchronizer.sync()
+            self._stop()
+            raise ViewAborted() from e
         except Exception as e:
             self.logger.warnf(
                 "%d received bad proposal from %d: %s", self.self_id, self.leader_id, e
@@ -641,7 +652,24 @@ class View:
                     continue
                 pending.append(sig)
             if pending and len(valid) + len(pending) >= self.quorum - 1:
-                results = await self._verify_consenter_sigs_batch(pending, proposal)
+                try:
+                    results = await self._verify_consenter_sigs_batch(
+                        pending, proposal
+                    )
+                except VerifyPlaneDown as e:
+                    # the device plane exhausted its deadline+retry budget
+                    # AND the host fallback: escalate to sync instead of
+                    # letting the exception kill the view task (which would
+                    # stall this replica permanently).  No complaint — the
+                    # engine being down is not the leader's fault.
+                    self.logger.errorf(
+                        "Verify plane down collecting commits for seq %d: "
+                        "%s; aborting view and syncing",
+                        self.proposal_sequence, e,
+                    )
+                    self.synchronizer.sync()
+                    self._stop()
+                    raise ViewAborted() from e
                 for sig, aux in zip(pending, results):
                     if aux is None:
                         self.logger.warnf("Couldn't verify %d's signature", sig.signer)

@@ -47,7 +47,7 @@ from ..messages import (
     ViewMetadata,
 )
 from ..metrics import BlacklistMetrics, ViewChangeMetrics, ViewMetrics
-from ..types import Checkpoint, blacklist_of, proposal_digest
+from ..types import Checkpoint, VerifyPlaneDown, blacklist_of, proposal_digest
 from .pool import remove_delivered_requests
 from .state import PREPARED
 from .util import InFlightData, NextViews, VoteSet, compute_quorum, get_leader_id
@@ -902,7 +902,9 @@ class ViewChanger:
         # We are one behind: validate the decision and deliver it.
         try:
             await validate_last_decision(vd, self.quorum, self.n, self.verifier)
-        except ValueError as e:
+        except (ValueError, VerifyPlaneDown) as e:
+            # VerifyPlaneDown: the verify plane is down, not the message —
+            # drop it as unvalidatable; the sender's resend timer retries
             self.logger.warnf(
                 "Node %d got viewData from %d, but the last decision is invalid: %s",
                 self.self_id, sender, e,
@@ -1031,7 +1033,7 @@ class ViewChanger:
             # one behind — validate, deliver, then verify message sig
             try:
                 await validate_last_decision(vd, self.quorum, self.n, self.verifier)
-            except ValueError as e:
+            except (ValueError, VerifyPlaneDown) as e:
                 self.logger.warnf("newView last decision invalid: %s", e)
                 return False, False, False
             await self._deliver_decision(

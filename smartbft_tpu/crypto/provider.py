@@ -26,7 +26,9 @@ Wire format of a consenter signature (Signature.msg): canonical encoding of
 from __future__ import annotations
 
 import asyncio
+import logging
 import os
+import random
 import threading
 import time
 from dataclasses import dataclass
@@ -36,8 +38,9 @@ import numpy as np
 
 from ..codec import decode, encode, wiremsg
 from ..messages import Proposal, Signature
-from ..types import proposal_digest
+from ..types import VerifyPlaneDown, proposal_digest
 from ..utils.memo import BoundedMemo
+from ..utils.tasks import create_logged_task
 from . import bls12381, ed25519, p256
 
 
@@ -116,6 +119,71 @@ class VerifyStats:
         if not self.sigs_verified:
             return 0.0
         return 1e6 * self.total_kernel_seconds / self.sigs_verified
+
+
+class LaunchTimeout(Exception):
+    """A coalescer flush exceeded its launch deadline.  The wave was
+    abandoned: the worker thread keeps running, but its late result is
+    discarded on arrival (counted in VerifyFaultStats)."""
+
+
+class VerifyResultMismatch(RuntimeError):
+    """An engine returned a different number of results than it was given
+    items.  Silently slicing such a batch would mis-assign verdicts across
+    every coalesced submitter, so the wave fails loudly instead and the
+    mismatch counts as a launch failure."""
+
+
+@dataclass(frozen=True)
+class VerifyFaultPolicy:
+    """Fault-tolerance knobs for the verify plane.
+
+    All durations are WALL-CLOCK seconds (the engine runs on worker
+    threads, outside any logical test clock).  ``launch_timeout`` is the
+    per-flush deadline (None disables deadlines); ``launch_retries`` is
+    how many times a failed/timed-out wave is re-submitted with
+    exponential backoff (+ jitter) before falling back to the host engine;
+    ``breaker_threshold`` consecutive launch failures trip the
+    host-fallback circuit breaker open (a permanent kernel error trips it
+    immediately); while open, a background canary probe re-tries the
+    device every ``probe_interval`` seconds (backing off to
+    ``probe_backoff_max``) and flips the breaker closed on success.
+    """
+
+    launch_timeout: Optional[float] = 30.0
+    launch_retries: int = 2
+    backoff_base: float = 0.05
+    backoff_max: float = 2.0
+    backoff_jitter: float = 0.5
+    breaker_threshold: int = 3
+    probe_interval: float = 2.0
+    probe_backoff_max: float = 30.0
+
+    @classmethod
+    def from_config(cls, config) -> "VerifyFaultPolicy":
+        """Map the Configuration.verify_* knobs onto a policy."""
+        return cls(
+            launch_timeout=config.verify_launch_timeout,
+            launch_retries=config.verify_launch_retries,
+            breaker_threshold=config.verify_breaker_threshold,
+            probe_interval=config.verify_probe_interval,
+        )
+
+
+@dataclass
+class VerifyFaultStats:
+    """Plain counters for the fault machinery — introspectable without a
+    metrics provider; benches export them in every JSON row."""
+
+    launch_failures: int = 0
+    launch_timeouts: int = 0
+    retries: int = 0
+    breaker_opens: int = 0
+    breaker_closes: int = 0
+    host_fallback_batches: int = 0
+    probe_attempts: int = 0
+    probe_successes: int = 0
+    abandoned_late_arrivals: int = 0
 
 
 class HostVerifyEngine:
@@ -379,7 +447,9 @@ class AsyncBatchCoalescer:
     """
 
     def __init__(self, engine, window: float = 0.002, max_batch: int = 2048,
-                 dedupe: bool = False):
+                 dedupe: bool = False,
+                 policy: Optional[VerifyFaultPolicy] = None,
+                 fallback_engine=None, metrics=None):
         """``dedupe``: verify each DISTINCT item once per flush and fan the
         verdict out to every submitter.  Verification is a pure function of
         (message, signature, key), so this is sound; it pays off when many
@@ -389,16 +459,88 @@ class AsyncBatchCoalescer:
         n distinct lanes.  The reference never shares a verifier across
         replicas, so it has no analogous seam (view.go:537-541 is
         per-replica fan-out).  Off by default: single-replica engines see
-        no repeats, and the dict pass would be pure overhead."""
+        no repeats, and the dict pass would be pure overhead.
+
+        ``policy``: a :class:`VerifyFaultPolicy` arming launch deadlines,
+        retry/backoff, and the host-fallback circuit breaker.  None keeps
+        the legacy contract: one attempt, failures surface to submitters as
+        plain RuntimeError.  With a policy, transient failures are retried,
+        exhausted waves route to ``fallback_engine`` (consensus keeps
+        committing at CPU speed), and only a wave that exhausts retries AND
+        the fallback raises :class:`~smartbft_tpu.types.VerifyPlaneDown`.
+        ``metrics``: an optional TPUCryptoMetrics bundle counting launch
+        failures/timeouts/retries and breaker transitions."""
         self.engine = engine
         self.window = window
         self.max_batch = max_batch
         self.dedupe = dedupe
+        self.policy = policy
+        #: a constructor-supplied policy is EXPLICIT and never overridden;
+        #: defaulted/config-wired policies stay re-wirable (configure())
+        self._policy_explicit = policy is not None
+        self.fallback_engine = fallback_engine
+        self.metrics = metrics
+        if metrics is not None:
+            metrics.breaker_state.set(0.0)  # healthy until proven otherwise
+        self.fault_stats = VerifyFaultStats()
         self._pending: list[tuple] = []
         self._futures: list[tuple[asyncio.Future, int, int]] = []
         self._flush_scheduled = False
         self._launch_inflight = False
         self._lock = asyncio.Lock()
+        self._log = logging.getLogger("smartbft_tpu.crypto")
+        self._breaker_is_open = False
+        self._consecutive_failures = 0
+        self._probe_task: Optional[asyncio.Task] = None
+        #: a known-well-formed item from the last wave, re-verified by the
+        #: breaker probe as the device-health canary
+        self._canary: Optional[tuple] = None
+
+    # -- late wiring ---------------------------------------------------------
+
+    def configure(self, policy: Optional[VerifyFaultPolicy] = None,
+                  fallback_engine=None, metrics=None,
+                  explicit: bool = False) -> None:
+        """Late fault-plane wiring (the Consensus facade calls this at
+        start AND on every reconfig with Configuration-derived values).
+
+        A policy supplied at construction is explicit and is never
+        overridden; a defaulted or previously config-wired policy IS
+        replaced, so Configuration.verify_* knobs (and reconfigs carrying
+        new ones) actually reach the plane.  Fallback engine and metrics
+        fill only when unset — the coalescer may be shared across
+        colocated replicas and churning instances would be pointless."""
+        if policy is not None and (explicit or not self._policy_explicit):
+            self.policy = policy
+            self._policy_explicit = self._policy_explicit or explicit
+        if fallback_engine is not None and self.fallback_engine is None:
+            self.fallback_engine = fallback_engine
+        if metrics is not None and self.metrics is None:
+            self.metrics = metrics
+            self.metrics.breaker_state.set(1.0 if self._breaker_is_open else 0.0)
+
+    @property
+    def breaker_open(self) -> bool:
+        return self._breaker_is_open
+
+    def fault_snapshot(self) -> dict:
+        """One JSON-able dict for bench rows: breaker state + fault counts,
+        so a degraded run is never silently reported as a device run."""
+        s = self.fault_stats
+        return {
+            "policy_configured": self.policy is not None,
+            "open": self._breaker_is_open,
+            "degraded": self._breaker_is_open or s.host_fallback_batches > 0,
+            "opens": s.breaker_opens,
+            "closes": s.breaker_closes,
+            "launch_failures": s.launch_failures,
+            "launch_timeouts": s.launch_timeouts,
+            "retries": s.retries,
+            "host_fallback_batches": s.host_fallback_batches,
+            "probe_attempts": s.probe_attempts,
+            "probe_successes": s.probe_successes,
+            "abandoned_late_arrivals": s.abandoned_late_arrivals,
+        }
 
     async def submit(self, items) -> list[bool]:
         if not items:
@@ -420,11 +562,15 @@ class AsyncBatchCoalescer:
             if self._launch_inflight:
                 pass
             elif len(self._pending) >= self.max_batch:
-                asyncio.ensure_future(self._flush_after(0.0))
+                create_logged_task(
+                    self._flush_after(0.0), name="coalescer-flush-full"
+                )
                 self._flush_scheduled = True
             elif not self._flush_scheduled:
                 self._flush_scheduled = True
-                asyncio.ensure_future(self._flush_after(self.window))
+                create_logged_task(
+                    self._flush_after(self.window), name="coalescer-flush"
+                )
         return await fut
 
     async def _flush_after(self, delay: float) -> None:
@@ -445,13 +591,14 @@ class AsyncBatchCoalescer:
         if not pending:
             return
         try:
-            results = await asyncio.to_thread(self._verify_batch, pending)
+            results = await self._launch_wave(pending)
         except Exception as exc:
+            err = exc if isinstance(exc, VerifyPlaneDown) else RuntimeError(
+                f"batch verify failed: {exc!r}"
+            )
             for fut, _, _ in futures:
                 if not fut.done():
-                    fut.set_exception(
-                        RuntimeError(f"batch verify failed: {exc!r}")
-                    )
+                    fut.set_exception(err)
             await self._launch_done()
             return
         for fut, start, count in futures:
@@ -465,23 +612,282 @@ class AsyncBatchCoalescer:
             self._launch_inflight = False
             if self._pending and not self._flush_scheduled:
                 self._flush_scheduled = True
-                asyncio.ensure_future(self._flush_after(0.0))
+                create_logged_task(
+                    self._flush_after(0.0), name="coalescer-flush-drain"
+                )
 
-    def _verify_batch(self, pending: list) -> list[bool]:
+    # -- the fault machinery -------------------------------------------------
+
+    async def _launch_wave(self, pending: list) -> list[bool]:
+        """One coalesced wave through the fault machinery: deadline ->
+        retry/backoff -> host fallback.  Raises VerifyPlaneDown only when
+        every stage is exhausted; transient device errors never surface to
+        the protocol plane."""
+        pol = self.policy
+        if pol is None:  # legacy contract: one attempt, no deadline
+            return await asyncio.to_thread(self._verify_batch, pending)
+        self._canary = pending[0]
+        attempts = 1 + max(0, pol.launch_retries)
+        delay = pol.backoff_base
+        last_exc: Optional[Exception] = None
+        for attempt in range(attempts):
+            if self._breaker_is_open:
+                break  # degraded mode: don't queue waves behind a dead device
+            try:
+                results = await self._call_engine_with_deadline(
+                    self.engine, pending, pol.launch_timeout
+                )
+            except Exception as exc:  # noqa: BLE001 — classified below
+                last_exc = exc
+                self._note_launch_failure(exc)
+                if self._breaker_is_open or attempt + 1 >= attempts:
+                    continue
+                self.fault_stats.retries += 1
+                if self.metrics is not None:
+                    self.metrics.count_launch_retries.add(1)
+                await asyncio.sleep(
+                    delay * (1.0 + pol.backoff_jitter * random.random())
+                )
+                delay = min(delay * 2.0, pol.backoff_max)
+                continue
+            self._consecutive_failures = 0
+            return results
+        if self.fallback_engine is not None:
+            try:
+                results = await asyncio.to_thread(
+                    self._verify_batch, pending, self.fallback_engine
+                )
+            except Exception as exc:  # noqa: BLE001 — terminal either way
+                raise VerifyPlaneDown(
+                    f"batch verify failed: device path exhausted "
+                    f"({last_exc!r}) and the host fallback failed too: "
+                    f"{exc!r}"
+                ) from exc
+            self.fault_stats.host_fallback_batches += 1
+            if self.metrics is not None:
+                self.metrics.count_host_fallback_batches.add(1)
+            return results
+        if last_exc is None:
+            # breaker already open on entry: no device attempt was made
+            raise VerifyPlaneDown(
+                "batch verify failed: circuit breaker open (failing fast) "
+                "and no fallback engine is configured"
+            )
+        raise VerifyPlaneDown(
+            f"batch verify failed after {attempts} launch attempt(s) and "
+            f"no fallback engine is configured: {last_exc!r}"
+        ) from last_exc
+
+    def _spawn_engine_call(self, engine, pending: list) -> asyncio.Future:
+        """Run one engine call on a dedicated DAEMON thread; the returned
+        future resolves with the result/exception whenever the thread
+        finishes — possibly long after every awaiter gave up."""
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+
+        def resolve(setter, payload) -> None:
+            if not fut.done():
+                setter(payload)
+
+        def run() -> None:
+            try:
+                res = self._verify_batch(pending, engine)
+            except BaseException as exc:  # noqa: BLE001 — ferried to the loop
+                setter, payload = fut.set_exception, exc
+            else:
+                setter, payload = fut.set_result, res
+            try:
+                loop.call_soon_threadsafe(resolve, setter, payload)
+            except RuntimeError:
+                pass  # loop closed while the launch was in flight
+
+        threading.Thread(
+            target=run, name="smartbft-verify-launch", daemon=True
+        ).start()
+        return fut
+
+    def _discard_late(self, fut: asyncio.Future) -> None:
+        """Mark an abandoned launch: count + log its late arrival and
+        retrieve any exception so asyncio never warns at GC time."""
+
+        def discard(f: asyncio.Future) -> None:
+            self.fault_stats.abandoned_late_arrivals += 1
+            exc = f.exception()
+            self._log.warning(
+                "abandoned verify launch completed late (%s)",
+                "successfully" if exc is None else f"with {exc!r}",
+            )
+
+        fut.add_done_callback(discard)
+
+    async def _call_engine_with_deadline(self, engine, pending: list,
+                                         timeout: Optional[float]):
+        """Run one engine call on a worker thread under the launch
+        deadline.  On expiry the launch is ABANDONED: the (daemon) thread
+        keeps running, its late result is discarded on arrival, and the
+        caller gets LaunchTimeout — a stuck tunnel can no longer wedge the
+        flush pipeline."""
+        if timeout is None:
+            return await asyncio.to_thread(self._verify_batch, pending, engine)
+        fut = self._spawn_engine_call(engine, pending)
+        try:
+            return await asyncio.wait_for(asyncio.shield(fut), timeout)
+        except asyncio.TimeoutError:
+            self._discard_late(fut)
+            raise LaunchTimeout(
+                f"verify launch exceeded its {timeout:.3f}s deadline; "
+                "wave abandoned"
+            ) from None
+
+    def _note_launch_failure(self, exc: Exception) -> None:
+        self._consecutive_failures += 1
+        self.fault_stats.launch_failures += 1
+        timed_out = isinstance(exc, LaunchTimeout)
+        if timed_out:
+            self.fault_stats.launch_timeouts += 1
+        if self.metrics is not None:
+            self.metrics.count_launch_failures.add(1)
+            if timed_out:
+                self.metrics.count_launch_timeouts.add(1)
+        permanent = (not timed_out
+                     and JaxVerifyEngine._is_permanent_kernel_error(exc))
+        self._log.warning(
+            "verify launch failure (consecutive %d): %s: %s",
+            self._consecutive_failures, type(exc).__name__, exc,
+        )
+        if permanent or (
+            self._consecutive_failures >= max(1, self.policy.breaker_threshold)
+        ):
+            self._open_breaker(
+                "permanent kernel error" if permanent
+                else f"{self._consecutive_failures} consecutive launch failures"
+            )
+
+    def _open_breaker(self, reason: str) -> None:
+        if self._breaker_is_open:
+            return
+        self._breaker_is_open = True
+        self.fault_stats.breaker_opens += 1
+        if self.metrics is not None:
+            self.metrics.count_breaker_open.add(1)
+            self.metrics.breaker_state.set(1.0)
+        self._log.warning(
+            "verify-plane circuit breaker OPEN (%s); %s",
+            reason,
+            "waves degrade to the host fallback engine"
+            if self.fallback_engine is not None else
+            "NO fallback engine configured — waves fail fast until the "
+            "device recovers",
+        )
+        if self._probe_task is None or self._probe_task.done():
+            self._probe_task = create_logged_task(
+                self._probe_loop(), name="verify-breaker-probe"
+            )
+
+    def _close_breaker(self) -> None:
+        self._breaker_is_open = False
+        self._consecutive_failures = 0
+        self.fault_stats.breaker_closes += 1
+        if self.metrics is not None:
+            self.metrics.count_breaker_close.add(1)
+            self.metrics.breaker_state.set(0.0)
+        self._log.warning(
+            "verify-plane circuit breaker CLOSED: device engine recovered"
+        )
+
+    async def _probe_loop(self) -> None:
+        """Background canary: while the breaker is open, periodically
+        re-verify ONE item on the device — off the hot path, live waves
+        stay on the fallback — and flip the breaker closed on the first
+        call that completes.
+
+        A probe whose thread is still PARKED in a hung device is re-awaited
+        on the next round instead of spawning a fresh thread, so a
+        long-lived outage holds at most one outstanding probe thread (plus
+        the abandoned wave that tripped the breaker), not one per probe."""
+        pol = self.policy
+        delay = pol.probe_interval
+        fut: Optional[asyncio.Future] = None
+        try:
+            while self._breaker_is_open:
+                await asyncio.sleep(delay)
+                item = self._canary
+                if item is None:
+                    continue
+                self.fault_stats.probe_attempts += 1
+                if fut is not None and fut.done():
+                    # the parked probe concluded during the sleep: consume
+                    # it — a late success still proves the device healthy,
+                    # and a late failure must be retrieved (else asyncio
+                    # warns at GC) before a fresh probe spawns
+                    exc = fut.exception()
+                    fut = None
+                    if exc is None:
+                        self.fault_stats.probe_successes += 1
+                        self._close_breaker()
+                        return
+                    self._log.info(
+                        "verify-plane probe completed late with %r", exc
+                    )
+                if fut is None:
+                    fut = self._spawn_engine_call(self.engine, [item])
+                try:
+                    await asyncio.wait_for(
+                        asyncio.shield(fut), pol.launch_timeout
+                    )
+                except asyncio.TimeoutError:
+                    self._log.info(
+                        "verify-plane probe still pending after %.2fs; "
+                        "re-checking in %.2fs", pol.launch_timeout, delay,
+                    )
+                    delay = min(delay * 2.0, pol.probe_backoff_max)
+                    continue
+                except Exception as exc:  # noqa: BLE001 — device still down
+                    fut = None  # concluded (handled here), not parked
+                    self._log.info(
+                        "verify-plane probe failed (%r); next probe in %.2fs",
+                        exc, delay,
+                    )
+                    delay = min(delay * 2.0, pol.probe_backoff_max)
+                    continue
+                self.fault_stats.probe_successes += 1
+                self._close_breaker()
+                return
+        finally:
+            if fut is not None and not fut.done():
+                self._discard_late(fut)  # loop torn down mid-probe
+
+    # -- the engine call -----------------------------------------------------
+
+    def _verify_batch(self, pending: list, engine=None) -> list[bool]:
         """One engine call for the flushed batch, optionally deduplicated."""
+        engine = self.engine if engine is None else engine
         if not self.dedupe:
-            return self.engine.verify(pending)
+            return self._engine_call(engine, pending)
         try:
             first: dict = {}
             for it in pending:
                 first.setdefault(it, len(first))
         except TypeError:
             # unhashable scheme items — dedupe silently degrades to 1:1
-            return self.engine.verify(pending)
+            return self._engine_call(engine, pending)
         if len(first) == len(pending):
-            return self.engine.verify(pending)
-        distinct = self.engine.verify(list(first))
+            return self._engine_call(engine, pending)
+        distinct = self._engine_call(engine, list(first))
         return [distinct[first[it]] for it in pending]
+
+    @staticmethod
+    def _engine_call(engine, items: list) -> list[bool]:
+        """engine.verify + the result-length guard: a short/long result
+        would silently mis-slice every submitter's future."""
+        results = engine.verify(items)
+        if len(results) != len(items):
+            raise VerifyResultMismatch(
+                f"engine {type(engine).__name__} returned {len(results)} "
+                f"results for {len(items)} items — refusing to mis-slice "
+                "the coalesced wave"
+            )
+        return results
 
 
 # ---------------------------------------------------------------------------
@@ -503,11 +909,21 @@ class CryptoProvider:
 
     def __init__(self, keyring: Keyring, engine=None,
                  coalesce_window: Optional[float] = None,
-                 coalescer: Optional[AsyncBatchCoalescer] = None):
+                 coalescer: Optional[AsyncBatchCoalescer] = None,
+                 fault_policy: Optional[VerifyFaultPolicy] = None,
+                 fallback_engine=None):
         """``coalescer``: share one AsyncBatchCoalescer across providers —
         the cross-REPLICA batching axis of BASELINE configs[2]: when many
         replicas run against one chip, their concurrent quorum checks merge
-        into shared kernel launches instead of queueing per-replica ones."""
+        into shared kernel launches instead of queueing per-replica ones.
+
+        ``fault_policy`` / ``fallback_engine``: verify-plane fault
+        tolerance (see AsyncBatchCoalescer).  Device-shaped engines (those
+        with a pad ladder) default to the full stack — launch deadlines,
+        retry/backoff, and a host-fallback breaker built from the same
+        scheme — so a hung or failing device can never wedge consensus;
+        host engines keep the legacy single-attempt contract unless a
+        policy is supplied (or wired later by the Consensus facade)."""
         self.keyring = keyring
         self._sig_msg_memo: BoundedMemo[bytes, "ConsenterSigMsg"] = BoundedMemo(8192)
         if coalescer is not None and engine is not None \
@@ -551,6 +967,10 @@ class CryptoProvider:
                 )
         if coalescer is not None:
             self._coalescer = coalescer
+            coalescer.configure(
+                policy=fault_policy, fallback_engine=fallback_engine,
+                explicit=fault_policy is not None,
+            )
             return
         if coalesce_window is None:
             coalesce_window = getattr(
@@ -560,8 +980,42 @@ class CryptoProvider:
         # smaller max_batch would split big quorum waves into multiple
         # launches and multiply the fixed per-launch overhead
         max_batch = getattr(self.engine, "pad_sizes", (2048,))[-1]
+        default_policy = None
+        if getattr(self.engine, "pad_sizes", None) is not None:
+            # device-shaped engine: arm the fault stack by default — the
+            # device is otherwise a single point of failure the reference's
+            # per-goroutine host verify never had.  The default policy is
+            # wired as NON-explicit so Configuration.verify_* knobs (via
+            # Consensus._wire_verify_plane) still take effect.
+            if fault_policy is None:
+                default_policy = VerifyFaultPolicy()
+            if fallback_engine is None:
+                fallback_engine = HostVerifyEngine(scheme=self.scheme)
         self._coalescer = AsyncBatchCoalescer(
-            self.engine, window=coalesce_window, max_batch=max_batch
+            self.engine, window=coalesce_window, max_batch=max_batch,
+            policy=fault_policy, fallback_engine=fallback_engine,
+        )
+        if default_policy is not None:
+            self._coalescer.configure(policy=default_policy)
+
+    @property
+    def coalescer(self) -> AsyncBatchCoalescer:
+        return self._coalescer
+
+    def configure_fault_policy(self, policy: Optional[VerifyFaultPolicy] = None,
+                               metrics=None, fallback_engine=None) -> None:
+        """Late verify-plane wiring (Consensus.start calls this with
+        Configuration-derived values + the metrics bundle).  Fills only
+        unset pieces, so explicit construction and shared-coalescer setups
+        win.  A device-shaped engine without a fallback gets a host engine
+        of the same scheme, realizing the degrade-to-CPU breaker path."""
+        if (fallback_engine is None and policy is not None
+                and self._coalescer.fallback_engine is None
+                and getattr(self._coalescer.engine, "pad_sizes", None)
+                is not None):
+            fallback_engine = HostVerifyEngine(scheme=self.scheme)
+        self._coalescer.configure(
+            policy=policy, fallback_engine=fallback_engine, metrics=metrics
         )
 
     # -- Signer -------------------------------------------------------------

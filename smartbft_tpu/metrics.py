@@ -475,6 +475,21 @@ class TPUCryptoMetrics:
         self.verify_latency_per_sig_us = _h(p, "tpu", "verify_latency_per_sig_us")
         self.count_sigs_verified = _c(p, "tpu", "count_sigs_verified")
         self.count_batches = _c(p, "tpu", "count_batches")
+        # verify-plane fault tolerance (launch deadlines / retry / breaker):
+        # transitions are counted here AND mirrored into every bench JSON
+        # row, so a degraded (host-fallback) run is never silently reported
+        # as a device run
+        self.count_launch_failures = _c(p, "tpu", "count_launch_failures")
+        self.count_launch_timeouts = _c(p, "tpu", "count_launch_timeouts")
+        self.count_launch_retries = _c(p, "tpu", "count_launch_retries")
+        self.count_breaker_open = _c(p, "tpu", "count_breaker_open")
+        self.count_breaker_close = _c(p, "tpu", "count_breaker_close")
+        self.count_host_fallback_batches = _c(
+            p, "tpu", "count_host_fallback_batches"
+        )
+        #: 1.0 while the host-fallback circuit breaker is open (degraded
+        #: mode: waves verify on CPU), 0.0 when the device engine serves
+        self.breaker_state = _g(p, "tpu", "verify_breaker_open")
 
 
 class MetricsBundle:

@@ -170,6 +170,10 @@ class App(Application, Assembler, Comm, Signer, Verifier, RequestInspector,
             self.verify_consenter_sigs_batch_async = (
                 crypto.verify_consenter_sigs_batch_async
             )
+        if crypto is not None and hasattr(crypto, "configure_fault_policy"):
+            # expose the verify-plane wiring seam so Consensus.start can
+            # arm launch deadlines / retry / breaker from the Configuration
+            self.configure_fault_policy = crypto.configure_fault_policy
 
     # ------------------------------------------------------------------ app
 

@@ -100,6 +100,16 @@ class ChaosEvent:
     - ``partition`` / ``heal``: split the mesh into ``groups`` / undo it
     - ``corrupt`` / ``uncorrupt``: mutate a ``fraction`` of the node's
       outbound prepare/commit digests (message corruption)
+
+    Device-plane actions (require ``ChaosCluster(engine_faults=True)``;
+    ``node`` is ignored — the engine is shared by every replica, which is
+    exactly the blast radius under test):
+
+    - ``engine_hang``: verify launches block until released (the coalescer
+      deadline abandons them); ``engine_fail`` (× ``count``): transient
+      tunnel-class errors; ``engine_slow`` (``fraction`` seconds of added
+      latency); ``engine_permanent``: compile-class error, trips the
+      breaker immediately; ``engine_heal``: clear all device faults.
     """
 
     at: float
@@ -107,6 +117,7 @@ class ChaosEvent:
     node: Optional[object] = None  # int | "leader" | "faulty"
     groups: tuple = ()
     fraction: float = 1.0
+    count: int = 1  # engine_fail: how many consecutive calls fail
 
 
 def mute_leader_schedule(*, mute_at=2.0, heal_at=14.0) -> list[ChaosEvent]:
@@ -130,6 +141,29 @@ def faulty_leader_full_schedule(
         ChaosEvent(at=crash_at, action="crash", node="faulty"),
         ChaosEvent(at=restart_at, action="restart", node="faulty"),
         ChaosEvent(at=restart_at, action="unmute", node="faulty"),
+    ]
+
+
+def engine_fault_schedule(
+    *, hang_at=2.0, fail_at=60.0, fail_every=20.0, fail_count=20,
+    heal_at=120.0,
+) -> list[ChaosEvent]:
+    """The verify-plane acceptance schedule: the device engine HANGS (the
+    launch deadline abandons waves, retries, and the breaker degrades to
+    host verify), then un-hangs into three bursts of transient failures
+    (the recovery probe keeps failing, so the breaker stays open and
+    consensus keeps committing on the host engine), then HEALS — the next
+    probe succeeds, the breaker closes, and waves return to the device.
+
+    ``fail_count`` per burst is sized so the probe cannot drain a burst
+    before the next one lands (probes are wall-clock; the schedule is
+    logical) — recovery is therefore strictly tied to ``engine_heal``."""
+    return [
+        ChaosEvent(at=hang_at, action="engine_hang"),
+        ChaosEvent(at=fail_at, action="engine_fail", count=fail_count),
+        ChaosEvent(at=fail_at + fail_every, action="engine_fail", count=fail_count),
+        ChaosEvent(at=fail_at + 2 * fail_every, action="engine_fail", count=fail_count),
+        ChaosEvent(at=heal_at, action="engine_heal"),
     ]
 
 
@@ -166,6 +200,7 @@ class ChaosCluster:
         rotation: bool = True,
         seed: int = 101,
         config_fn: Optional[Callable[[int], Configuration]] = None,
+        engine_faults: bool = False,
     ):
         self.wal_root = str(wal_root)
         self.n = n
@@ -175,10 +210,62 @@ class ChaosCluster:
         self.network = Network(seed=seed)
         self.shared = SharedLedgers()
         self.rng = random.Random(seed)
+        #: engine_faults=True: every replica routes quorum verification
+        #: through ONE shared FaultyEngine-wrapped coalescer (the
+        #: single-chip deployment shape) so engine_* timeline actions can
+        #: hang/fail the device plane under a full fault policy — launch
+        #: deadline, retry/backoff, host-fallback breaker, canary probe
+        self.engine: Optional[object] = None
+        self.coalescer = None
+        self.verify_metrics = None  # InMemoryProvider backing the breaker counters
+        crypto_fn: Callable[[int], Optional[object]] = lambda i: None
+        if engine_faults:
+            from ..crypto.provider import AsyncBatchCoalescer, VerifyFaultPolicy
+            from ..metrics import InMemoryProvider, TPUCryptoMetrics
+            from .engine_faults import (
+                CoalescedTrivialCrypto,
+                FaultyEngine,
+                always_valid_engine,
+            )
+
+            self.engine = FaultyEngine(always_valid_engine())
+            self.verify_metrics = InMemoryProvider()
+            # the fault knobs are WALL-CLOCK: tight values keep the
+            # deadline→retry→breaker cycle well inside the real seconds a
+            # logical-clock schedule takes to play out
+            self.coalescer = AsyncBatchCoalescer(
+                self.engine, window=0.001, max_batch=4096,
+                policy=VerifyFaultPolicy(
+                    launch_timeout=0.15, launch_retries=2,
+                    backoff_base=0.02, backoff_max=0.08, backoff_jitter=0.25,
+                    breaker_threshold=3, probe_interval=0.05,
+                    probe_backoff_max=0.2,
+                ),
+                fallback_engine=always_valid_engine(),
+                metrics=TPUCryptoMetrics(self.verify_metrics),
+            )
+            crypto_fn = lambda i: CoalescedTrivialCrypto(i, self.coalescer)
+            if config_fn is None:
+                # device-plane outages stall verification for wall-clock
+                # spans the logical clock races past — keep request
+                # complaints and heartbeat escalation out of the picture so
+                # the scenario exercises the DEVICE plane, not deposition
+                config_fn = lambda i: chaos_config(
+                    i, depth=depth, rotation=rotation,
+                    request_forward_timeout=120.0,
+                    request_complain_timeout=240.0,
+                    request_auto_remove_timeout=480.0,
+                    leader_heartbeat_timeout=30.0,
+                    view_change_resend_interval=15.0,
+                    view_change_timeout=60.0,
+                    verify_launch_timeout=0.15, verify_launch_retries=2,
+                    verify_breaker_threshold=3, verify_probe_interval=0.05,
+                )
         cfg = config_fn or (lambda i: chaos_config(i, depth=depth, rotation=rotation))
         self.apps = [
             App(i, self.network, self.shared, self.scheduler,
-                wal_dir=f"{self.wal_root}/wal-{i}", config=cfg(i))
+                wal_dir=f"{self.wal_root}/wal-{i}", config=cfg(i),
+                crypto=crypto_fn(i))
             for i in range(1, n + 1)
         ]
         self.down: set[int] = set()
@@ -197,6 +284,8 @@ class ChaosCluster:
             await a.start()
 
     async def stop(self) -> None:
+        if self.engine is not None:
+            self.engine.heal()  # release any verify calls parked in a hang
         for a in self.apps:
             if a.id not in self.down:
                 await a.stop()
@@ -288,9 +377,29 @@ class ChaosCluster:
         elif evt.action == "uncorrupt":
             node.mutate_send = None
             self.faulted.discard(evt.node)
+        # device-plane actions: the engine is shared, so no node is marked
+        # faulted — the pump keeps submitting everywhere, which is the
+        # point (consensus must keep committing through the outage)
+        elif evt.action == "engine_hang":
+            self._require_engine().hang()
+        elif evt.action == "engine_fail":
+            self._require_engine().fail_next(max(1, int(evt.count)))
+        elif evt.action == "engine_slow":
+            self._require_engine().slow(evt.fraction)
+        elif evt.action == "engine_permanent":
+            self._require_engine().permanent_error()
+        elif evt.action == "engine_heal":
+            self._require_engine().heal()
         else:
             raise ValueError(f"unknown chaos action: {evt.action}")
         return evt
+
+    def _require_engine(self):
+        if self.engine is None:
+            raise RuntimeError(
+                "engine_* chaos actions need ChaosCluster(engine_faults=True)"
+            )
+        return self.engine
 
     def _corruptor(self, fraction: float):
         rng = self.rng
@@ -473,6 +582,28 @@ class Invariants:
             f"(~{math.ceil(bound / depth)} windows)"
         )
 
+    @staticmethod
+    async def breaker_recovered(cluster: ChaosCluster, timeout: float = 8.0) -> None:
+        """Engine-fault runs: after the schedule's final heal, the
+        host-fallback breaker must return to CLOSED (the canary probe runs
+        on wall-clock time and may lag the logical drain — poll briefly),
+        with every open matched by a close."""
+        co = cluster.coalescer
+        if co is None:
+            return
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
+        while co.breaker_open and _time.monotonic() < deadline:
+            await asyncio.sleep(0.02)
+        snap = co.fault_snapshot()
+        assert not co.breaker_open, (
+            f"verify breaker still open after heal: {snap}"
+        )
+        assert snap["opens"] == snap["closes"], (
+            f"unbalanced breaker transitions after heal: {snap}"
+        )
+
     @classmethod
     def check_all(
         cls,
@@ -492,11 +623,36 @@ class Invariants:
 
 # ---------------------------------------------------------------------- soak
 
-def random_schedule(rng: random.Random, n: int) -> list[ChaosEvent]:
+def random_schedule(
+    rng: random.Random, n: int, *, engine_faults: bool = False
+) -> list[ChaosEvent]:
     """A randomized but always-heal-by-the-end schedule for soak runs.
     Leader-shaped faults use dynamic targets so they hit the node actually
-    leading when the fault fires."""
+    leading when the fault fires.  With ``engine_faults`` a device-plane
+    fault shape is always present, with a 50% chance of ALSO running a
+    protocol fault — device and protocol faults composing is exactly what
+    production would see."""
     events: list[ChaosEvent] = []
+    if engine_faults:
+        t = rng.uniform(1.0, 4.0)
+        shape = rng.choice(["hang", "fail", "slow", "permanent"])
+        if shape == "hang":
+            events.append(ChaosEvent(at=t, action="engine_hang"))
+        elif shape == "fail":
+            events.append(ChaosEvent(
+                at=t, action="engine_fail", count=rng.randrange(1, 8)
+            ))
+        elif shape == "slow":
+            events.append(ChaosEvent(
+                at=t, action="engine_slow", fraction=rng.uniform(0.02, 0.1)
+            ))
+        else:
+            events.append(ChaosEvent(at=t, action="engine_permanent"))
+        events.append(ChaosEvent(
+            at=t + rng.uniform(6.0, 14.0), action="engine_heal"
+        ))
+        if rng.random() < 0.5:
+            return events
     t = rng.uniform(1.0, 3.0)
     shape = rng.choice(["mute", "crash", "partition", "corrupt"])
     if shape == "mute":
@@ -522,8 +678,12 @@ def random_schedule(rng: random.Random, n: int) -> list[ChaosEvent]:
 async def soak(
     *, rounds: int = 5, depth: int = 16, rotation: bool = True, seed: int = 1,
     n: int = 4, requests: int = 24, verbose: bool = True,
+    engine_faults: bool = False,
 ) -> None:
-    """Run ``rounds`` randomized schedules, checking every invariant."""
+    """Run ``rounds`` randomized schedules, checking every invariant.
+    ``engine_faults`` adds randomized device-plane faults (hang / transient
+    fail / slow / permanent) against a cluster whose verify plane runs
+    through a shared FaultyEngine + fault-policy coalescer."""
     import tempfile
 
     rng = random.Random(seed)
@@ -531,8 +691,9 @@ async def soak(
         with tempfile.TemporaryDirectory(prefix="chaos-soak-") as wal_root:
             cluster = ChaosCluster(
                 wal_root, n=n, depth=depth, rotation=rotation, seed=seed + r,
+                engine_faults=engine_faults,
             )
-            schedule = random_schedule(rng, n)
+            schedule = random_schedule(rng, n, engine_faults=engine_faults)
             await cluster.start()
             try:
                 report = await cluster.run_schedule(
@@ -541,14 +702,23 @@ async def soak(
                 Invariants.fork_free(cluster)
                 Invariants.exactly_once(cluster, expected=requests)
                 Invariants.liveness_within_windows(cluster, report, slack_windows=8)
+                if engine_faults:
+                    await Invariants.breaker_recovered(cluster)
             finally:
                 await cluster.stop()
             if verbose:
                 kinds = [e.action for e in report.events_fired]
+                extra = ""
+                if engine_faults and cluster.coalescer is not None:
+                    snap = cluster.coalescer.fault_snapshot()
+                    extra = (
+                        f" breaker opens={snap['opens']}"
+                        f" fallback_batches={snap['host_fallback_batches']}"
+                    )
                 print(
                     f"round {r}: events={kinds} decisions={report.final_decisions} "
                     f"committed={report.final_committed} leaders={sorted(report.leaders_seen)} "
-                    f"post-heal decisions={report.decisions_after_heal} — OK"
+                    f"post-heal decisions={report.decisions_after_heal}{extra} — OK"
                 )
 
 
@@ -564,6 +734,11 @@ def main(argv: Optional[list[str]] = None) -> int:
     ap.add_argument("--no-rotation", action="store_true")
     ap.add_argument("--seed", type=int, default=1)
     ap.add_argument("--requests", type=int, default=24)
+    ap.add_argument(
+        "--engine-faults", action="store_true",
+        help="add randomized device-plane faults (hang / transient fail / "
+             "slow / permanent) against the shared verify engine",
+    )
     args = ap.parse_args(argv)
     if not args.soak:
         ap.error("nothing to do: pass --soak")
@@ -574,6 +749,7 @@ def main(argv: Optional[list[str]] = None) -> int:
             rotation=not args.no_rotation,
             seed=args.seed,
             requests=args.requests,
+            engine_faults=args.engine_faults,
         )
     )
     print("chaos soak: all rounds passed")

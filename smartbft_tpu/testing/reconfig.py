@@ -34,6 +34,8 @@ _MS_FIELDS = (
     "leader_heartbeat_timeout",
     "collect_timeout",
     "request_pool_submit_timeout",
+    "verify_launch_timeout",
+    "verify_probe_interval",
 )
 
 _INT_FIELDS = (
@@ -46,6 +48,8 @@ _INT_FIELDS = (
     "decisions_per_leader",
     "request_max_bytes",
     "pipeline_depth",
+    "verify_launch_retries",
+    "verify_breaker_threshold",
 )
 
 _STR_FIELDS = (
@@ -73,6 +77,8 @@ class ConfigMirror:
     decisions_per_leader: int = 0
     request_max_bytes: int = 0
     pipeline_depth: int = 1
+    verify_launch_retries: int = 2
+    verify_breaker_threshold: int = 3
     rotation_granularity: str = "decision"
     request_batch_max_interval_ms: int = 0
     request_forward_timeout_ms: int = 0
@@ -83,6 +89,8 @@ class ConfigMirror:
     leader_heartbeat_timeout_ms: int = 0
     collect_timeout_ms: int = 0
     request_pool_submit_timeout_ms: int = 0
+    verify_launch_timeout_ms: int = 30000
+    verify_probe_interval_ms: int = 2000
     sync_on_start: bool = False
     speed_up_view_change: bool = False
     leader_rotation: bool = False

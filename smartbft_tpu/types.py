@@ -20,6 +20,17 @@ from .config import Configuration
 from .messages import Proposal, Signature, ViewMetadata
 
 
+class VerifyPlaneDown(RuntimeError):
+    """The batched verify plane is unavailable: a coalesced launch failed
+    past its deadline+retry budget AND the host fallback (if configured)
+    failed or is absent.  Raised only by fault-policy-configured coalescers
+    (:class:`smartbft_tpu.crypto.provider.AsyncBatchCoalescer`).
+
+    Protocol components treat this as "escalate to sync", never as a
+    Byzantine signal — the device being down is not the leader's fault, so
+    no complaint is filed and the view task is not allowed to crash."""
+
+
 def proposal_digest(p: Proposal) -> str:
     """Hex SHA-256 over the canonical proposal encoding.
 

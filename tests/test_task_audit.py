@@ -1,10 +1,13 @@
 """Audit: no silent background tasks anywhere in smartbft_tpu.
 
-Every ``create_task`` call site must go through
+Every ``create_task`` AND ``ensure_future`` call site must go through
 ``smartbft_tpu.utils.tasks.create_logged_task``, whose done-callback
 retrieves and logs terminal exceptions — a consensus component whose run
 loop died silently is the one failure mode the chaos harness cannot
-observe from outside.  Plus behavioral pins for the helper itself.
+observe from outside.  ``ensure_future`` is pinned since the coalescer's
+background flushes used it: a flush task's exception vanishing silently
+is exactly how a dead verify plane could masquerade as a live one.  Plus
+behavioral pins for the helper itself.
 """
 
 import asyncio
@@ -18,7 +21,7 @@ ALLOWED = {PKG / "utils" / "tasks.py"}  # the helper's own create_task
 
 
 def test_every_create_task_site_is_logged():
-    raw = re.compile(r"\bcreate_task\(")
+    raw = re.compile(r"\b(?:create_task|ensure_future)\(")
     offenders = []
     for path in sorted(PKG.rglob("*.py")):
         if path in ALLOWED:
@@ -27,7 +30,7 @@ def test_every_create_task_site_is_logged():
             if raw.search(line) and "create_logged_task(" not in line:
                 offenders.append(f"{path.relative_to(PKG.parent)}:{lineno}: {line.strip()}")
     assert not offenders, (
-        "raw asyncio create_task call sites (use utils.tasks."
+        "raw asyncio create_task/ensure_future call sites (use utils.tasks."
         "create_logged_task so background failure is never silent):\n"
         + "\n".join(offenders)
     )
