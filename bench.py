@@ -258,6 +258,10 @@ def e2e_bench(cpu_mode: bool) -> None:
         # breaker accounting rides along so a degraded (host-fallback)
         # device row is never mistaken for a healthy device run
         "breaker": dev_row.get("breaker"),
+        # per-phase message-plane timers (ingest/route/vote-reg/codec) from
+        # the device row's timed window — the PERF.md decomposition inputs
+        "protocol_plane": dev_row.get("protocol_plane"),
+        "baseline_protocol_plane": cpu_row.get("protocol_plane"),
         "tx_per_sec_probe_normalized": norm_tx,
         "vs_baseline_probe_normalized": round(
             norm_tx / cpu_row["tx_per_sec"], 3)
@@ -402,6 +406,8 @@ def kernel_bench(cpu_mode: bool) -> None:
     mc_us, ncores = _openssl_all_cores_baseline(items[: max(base_n, 64 * ncores_hint())])
     _log(f"bench: openssl all-cores ({ncores}) {mc_us:.1f} us/sig effective")
 
+    from smartbft_tpu.metrics import protocol_plane_snapshot
+
     print(json.dumps({
         "metric": "p256_sig_verify_p50_us",
         "value": round(device_us, 2),
@@ -409,6 +415,10 @@ def kernel_bench(cpu_mode: bool) -> None:
         "vs_baseline": round(base_us / device_us, 3),
         "vs_all_cores": round(mc_us / device_us, 3),
         "cores": ncores,
+        # kernel micro bench drives no cluster, so the plane block is the
+        # (all-zero) process snapshot — present in EVERY bench row by
+        # contract so downstream tooling can rely on the key
+        "protocol_plane": protocol_plane_snapshot(),
     }), flush=True)
 
 

@@ -1,0 +1,170 @@
+"""Message-plane microbench: naive vs vectorized routing+codec cost.
+
+Measures the protocol plane's fan-out terms in isolation — no crypto, no
+WAL, no device — by driving synthetic prepare/commit/pre-prepare waves
+through the REAL in-process network into vote-registering stub receivers:
+
+* **naive** (``Network(naive=True)``): the pre-vectorization plane — one
+  encode per recipient, one decode per delivery, per-message dispatch.
+  This is what any transport pays without the encode-once/interned path.
+* **vectorized**: encode-once broadcast (1 marshal per broadcast, memoized
+  on the message), interned decode (<=1 unmarshal per broadcast, all
+  recipients share one frozen object), wave-batched ingest (one dispatch
+  call per drained inbox tick), bitmask vote registration.
+
+One simulated decision = one pre-prepare broadcast from the leader (with a
+batch-sized payload) + a full prepare wave + a full commit wave (n-1
+broadcasts each).  The metric is microseconds of wall time per decision,
+plus the PROTOCOL_PLANE counter deltas so the codec-call collapse
+((n-1) -> 1 encodes per broadcast) is visible, not inferred.
+
+Run:  python benchmarks/message_plane.py [--nodes 64] [--decisions 20]
+      [--payload 25000]
+Prints one JSON line per mode plus a comparison line with the ratio —
+the "routing+codec cut" number PERF.md records.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from smartbft_tpu.messages import Commit, PrePrepare, Prepare, Proposal, Signature
+from smartbft_tpu.metrics import PROTOCOL_PLANE, ProtocolPlaneTimers
+from smartbft_tpu.core.util import SignerIndex, VoteSet
+from smartbft_tpu.testing.network import Network
+
+
+class _WaveSink:
+    """Stub consensus: registers every vote into per-seq bitmask vote sets
+    (the real registration data structure) and counts deliveries."""
+
+    def __init__(self, node_id: int, index: SignerIndex):
+        self.id = node_id
+        self.index = index
+        self.received = 0
+        self.prepares: dict[int, VoteSet] = {}
+        self.commits: dict[int, VoteSet] = {}
+
+    def _register(self, sender: int, msg) -> None:
+        self.received += 1
+        if isinstance(msg, Prepare):
+            vs = self.prepares.get(msg.seq)
+            if vs is None:
+                vs = self.prepares[msg.seq] = VoteSet(
+                    lambda _s, m: isinstance(m, Prepare), self.index
+                )
+            vs.register_vote(sender, msg)
+        elif isinstance(msg, Commit):
+            vs = self.commits.get(msg.seq)
+            if vs is None:
+                vs = self.commits[msg.seq] = VoteSet(
+                    lambda _s, m: isinstance(m, Commit), self.index
+                )
+            vs.register_vote(sender, msg)
+
+    # naive / per-message intake
+    def handle_message(self, sender: int, msg) -> None:
+        self._register(sender, msg)
+
+    # vectorized / wave-batched intake
+    def handle_message_batch(self, items) -> None:
+        for sender, msg in items:
+            self._register(sender, msg)
+
+    async def handle_request(self, sender: int, req: bytes) -> None:
+        pass
+
+
+async def run_mode(naive: bool, n: int, decisions: int,
+                   payload_bytes: int) -> dict:
+    network = Network(seed=7, naive=naive)
+    index = SignerIndex(list(range(1, n + 1)))
+    sinks = {}
+    for i in range(1, n + 1):
+        node = network.add_node(i)
+        node.consensus = sinks[i] = _WaveSink(i, index)
+    network.start()
+    payload = bytes(payload_bytes)
+    # expected deliveries per decision: pre-prepare to n-1, plus n prepare
+    # and n commit broadcasts of n-1 recipients each
+    per_decision = (n - 1) * (1 + 2 * n)
+    before = PROTOCOL_PLANE.snapshot()
+    t0 = time.perf_counter()
+    for d in range(decisions):
+        seq = d + 1
+        pp = PrePrepare(view=0, seq=seq,
+                        proposal=Proposal(payload=payload, metadata=b"m"))
+        network.broadcast_consensus(1, pp)
+        digest = "d%032d" % seq
+        for i in range(1, n + 1):
+            network.broadcast_consensus(i, Prepare(view=0, seq=seq, digest=digest))
+        for i in range(1, n + 1):
+            network.broadcast_consensus(
+                i,
+                Commit(view=0, seq=seq, digest=digest,
+                       signature=Signature(signer=i, value=b"v", msg=b"m")),
+            )
+        # drain before the next decision so inboxes stay inside their bound
+        target = per_decision * (d + 1)  # total deliveries across all sinks
+        while sum(s.received for s in sinks.values()) < target:
+            await asyncio.sleep(0)
+    elapsed = time.perf_counter() - t0
+    plane = ProtocolPlaneTimers.delta(before, PROTOCOL_PLANE.snapshot())
+    await network.stop()
+    # sanity: every wave fully registered
+    got = sum(s.received for s in sinks.values())
+    assert got == per_decision * decisions, (got, per_decision * decisions)
+    return {
+        "mode": "naive" if naive else "vectorized",
+        "nodes": n,
+        "decisions": decisions,
+        "payload_bytes": payload_bytes,
+        "us_per_decision": round(1e6 * elapsed / decisions, 1),
+        "elapsed_s": round(elapsed, 3),
+        "protocol_plane": plane,
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=64)
+    ap.add_argument("--decisions", type=int, default=20)
+    ap.add_argument("--payload", type=int, default=25000,
+                    help="pre-prepare proposal payload size (bytes); the "
+                         "default is a ~500-request batch's worth")
+    args = ap.parse_args()
+
+    rows = []
+    for naive in (True, False):
+        row = asyncio.run(
+            run_mode(naive, args.nodes, args.decisions, args.payload)
+        )
+        print(json.dumps(row), flush=True)
+        rows.append(row)
+    naive_row, vec_row = rows
+    print(json.dumps({
+        "metric": f"message_plane_us_per_decision_n{args.nodes}",
+        "value": vec_row["us_per_decision"],
+        "unit": "us/decision",
+        "vs_naive": round(
+            naive_row["us_per_decision"] / vec_row["us_per_decision"], 3
+        ) if vec_row["us_per_decision"] else 0.0,
+        "naive_us_per_decision": naive_row["us_per_decision"],
+        "encodes_per_broadcast": {
+            "naive": round(naive_row["protocol_plane"]["encodes"]
+                           / naive_row["protocol_plane"]["broadcasts"], 2),
+            "vectorized": round(vec_row["protocol_plane"]["encodes"]
+                                / vec_row["protocol_plane"]["broadcasts"], 2),
+        },
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -225,6 +225,8 @@ async def run_cluster(engine_kind: str, n: int, requests: int, batch: int,
     for eng in set(engines.values()):
         eng.stats = VerifyStats()
 
+    from smartbft_tpu.metrics import PROTOCOL_PLANE, ProtocolPlaneTimers
+
     scheduler = Scheduler()
     driver = WallClockDriver(scheduler, tick_interval=0.01)
     network = Network(seed=13)
@@ -245,6 +247,9 @@ async def run_cluster(engine_kind: str, n: int, requests: int, batch: int,
         for a in apps:
             await a.start()
 
+        # snapshot the protocol-plane timers at the start of the timed
+        # window so the row's block covers exactly the measured burst
+        plane_before = PROTOCOL_PLANE.snapshot()
         t0 = time.perf_counter()
         for k in range(requests):
             await apps[0].submit("bench", f"req-{k}")
@@ -277,6 +282,9 @@ async def run_cluster(engine_kind: str, n: int, requests: int, batch: int,
         else:
             raise TimeoutError(f"cluster did not commit {target} requests in time")
         elapsed = time.perf_counter() - t0
+        # per-phase protocol-plane timers for the timed window (encode-once
+        # broadcast + wave-batched ingest accounting; PERF.md decomposition)
+        plane = ProtocolPlaneTimers.delta(plane_before, PROTOCOL_PLANE.snapshot())
 
         decisions = len(apps[0].ledger())
         stats = stats_eng.stats
@@ -320,6 +328,18 @@ async def run_cluster(engine_kind: str, n: int, requests: int, batch: int,
             "sigs_verified": stats.sigs_verified,
             "elapsed_s": round(elapsed, 2),
             "breaker": breaker_row,
+            "protocol_plane": dict(
+                plane,
+                # the four timers are disjoint (metrics.ProtocolPlaneTimers),
+                # so their sum is the plane's accounted cost per decision
+                us_per_decision=round(
+                    (plane["ingest_us"] + plane["route_us"]
+                     + plane["vote_reg_us"] + plane["codec_us"]) / decisions, 1
+                ) if decisions else 0.0,
+                encodes_per_broadcast=round(
+                    plane["encodes"] / plane["broadcasts"], 3
+                ) if plane["broadcasts"] else 0.0,
+            ),
         }
     finally:
         for a in apps:

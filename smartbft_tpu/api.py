@@ -32,7 +32,13 @@ class Application(abc.ABC):
 
 
 class Comm(abc.ABC):
-    """Node-to-node transport, supplied by the embedder (dependencies.go:22-30)."""
+    """Node-to-node transport, supplied by the embedder (dependencies.go:22-30).
+
+    ``broadcast_consensus`` is an OPTIONAL vectorization seam: transports
+    that can encode a message once and fan the same wire bytes out to
+    every peer (the in-process network; a real transport's scatter path)
+    override it — the default loops ``send_consensus``, which pays the
+    per-recipient cost."""
 
     @abc.abstractmethod
     def send_consensus(self, target_id: int, msg: Message) -> None: ...

@@ -306,6 +306,29 @@ class Consensus:
         if self.controller is not None:
             await self.controller.process_messages_async(sender, m)
 
+    def handle_message_batch(self, items) -> None:
+        """Wave-batched intake: one transport tick's (sender, msg) pairs
+        dispatched in a single call — consecutive view-bound runs register
+        into the view as one wave (see Controller.process_messages_batch)."""
+        filtered = self._filter_members(items)
+        if filtered and self.controller is not None:
+            self.controller.process_messages_batch(filtered)
+
+    async def handle_message_batch_async(self, items) -> None:
+        """Backpressure-capable mirror of :meth:`handle_message_batch`."""
+        filtered = self._filter_members(items)
+        if filtered and self.controller is not None:
+            await self.controller.process_messages_batch_async(filtered)
+
+    def _filter_members(self, items) -> list:
+        filtered = []
+        for sender, m in items:
+            if sender not in self._node_set:
+                self.logger.warnf("Received message from unexpected node %d", sender)
+                continue
+            filtered.append((sender, m))
+        return filtered
+
     async def handle_request(self, sender: int, req: bytes) -> None:
         if self.controller is not None:
             await self.controller.handle_request(sender, req)

@@ -134,6 +134,7 @@ class Controller(RequestTimeoutHandler):
         self.id = self_id
         self.n = n
         self.nodes_list = nodes_list
+        self._peers = [nid for nid in nodes_list if nid != self_id]
         self.leader_rotation = leader_rotation
         self.decisions_per_leader = decisions_per_leader
         self.request_pool = request_pool
@@ -353,6 +354,76 @@ class Controller(RequestTimeoutHandler):
                 await self.view_changer.handle_message_async(sender, m)
         else:
             self.process_messages(sender, m)
+
+    # -- wave-batched intake ------------------------------------------------
+
+    def _ingest_view_run(self, run: list) -> None:
+        """Synchronous view intake for one run of view-bound messages."""
+        view = self.curr_view
+        if view is None:
+            return
+        ingest = getattr(view, "ingest_batch", None)
+        if ingest is not None:
+            ingest(run)
+        else:
+            for sender, m in run:
+                view.handle_message(sender, m)
+
+    def _finish_view_run(self, run: list) -> None:
+        """Shared tail of both flush paths: view-change evidence fan-out +
+        artificial heartbeats, then reset the run."""
+        for sender, m in run:
+            self._route_view_message_tail(sender, m)
+        run.clear()
+
+    def _flush_view_run(self, run: list) -> None:
+        """Hand a run of consecutive view-bound messages to the view in ONE
+        ingest_batch call (one work-event wakeup per wave instead of ~n),
+        then fan the view-change evidence / artificial heartbeats out."""
+        if not run:
+            return
+        self._ingest_view_run(run)
+        self._finish_view_run(run)
+
+    async def _flush_view_run_async(self, run: list) -> None:
+        """Backpressure-capable flush: identical to :meth:`_flush_view_run`
+        except a view exposing ``ingest_batch_async`` is awaited (may block
+        the delivering task on a full inbox)."""
+        if not run:
+            return
+        view = self.curr_view
+        ingest_async = getattr(view, "ingest_batch_async", None) \
+            if view is not None else None
+        if ingest_async is not None:
+            await ingest_async(run)
+        else:
+            self._ingest_view_run(run)
+        self._finish_view_run(run)
+
+    def process_messages_batch(self, items) -> None:
+        """Dispatch a whole ingest tick of (sender, msg) pairs, registering
+        each consecutive run of pre-prepare/prepare/commit messages into
+        the view as one wave.  Relative message order is preserved: a
+        non-view message flushes the pending run before it dispatches."""
+        run: list = []
+        for sender, m in items:
+            if isinstance(m, (PrePrepare, Prepare, Commit)):
+                run.append((sender, m))
+                continue
+            self._flush_view_run(run)
+            self.process_messages(sender, m)
+        self._flush_view_run(run)
+
+    async def process_messages_batch_async(self, items) -> None:
+        """Backpressure-capable mirror of :meth:`process_messages_batch`."""
+        run: list = []
+        for sender, m in items:
+            if isinstance(m, (PrePrepare, Prepare, Commit)):
+                run.append((sender, m))
+                continue
+            await self._flush_view_run_async(run)
+            await self.process_messages_async(sender, m)
+        await self._flush_view_run_async(run)
 
     def _respond_to_state_transfer_request(self, sender: int) -> None:
         vs = self.view_sequences.load()
@@ -810,11 +881,19 @@ class Controller(RequestTimeoutHandler):
     # ------------------------------------------------------------------ comm
 
     def broadcast_consensus(self, m: Message) -> None:
-        """Broadcast = loop over peers (controller.go:912-926)."""
-        for node in self.nodes_list:
-            if node == self.id:
-                continue
-            self.comm.send_consensus(node, m)
+        """Broadcast (controller.go:912-926).  Prefers the Comm's native
+        ``broadcast_consensus`` seam — the vectorized message plane encodes
+        the message ONCE there and shares the frozen decoded object across
+        all recipients — falling back to the per-peer send loop for Comm
+        implementations without it."""
+        bcast = getattr(self.comm, "broadcast_consensus", None)
+        if bcast is not None:
+            bcast(m, self._peers)  # membership-scoped encode-once fan-out
+        else:
+            for node in self.nodes_list:
+                if node == self.id:
+                    continue
+                self.comm.send_consensus(node, m)
         if isinstance(m, (PrePrepare, Prepare, Commit)):
             if self.i_am_the_leader()[0]:
                 self.leader_monitor.heartbeat_was_sent()

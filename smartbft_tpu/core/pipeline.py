@@ -111,9 +111,10 @@ from ..types import (
     cached_view_metadata,
     proposal_digest,
 )
+from ..metrics import PROTOCOL_PLANE
 from .rotation import RotationState
 from .state import ABORT, COMMITTED, PREPARED, PROPOSED
-from .util import VoteSet, compute_quorum
+from .util import SignerIndex, VoteSet, compute_quorum, iter_bits
 from ..utils.tasks import create_logged_task
 from .view import (
     ViewAborted,
@@ -133,6 +134,9 @@ READY = 100
 @dataclass
 class _Slot:
     seq: int
+    #: shared per-cluster SignerIndex: the slot's vote sets and masks all
+    #: key on the same dense bit layout (integer ops, no hashing)
+    index: Optional[SignerIndex] = None
     phase: int = COMMITTED
     pre_prepare: Optional[PrePrepare] = None
     proposal: Optional[Proposal] = None
@@ -144,24 +148,26 @@ class _Slot:
     commit_sent: Optional[Commit] = None
     my_sig: Optional[Signature] = None
     prepare_voters: list[int] = field(default_factory=list)
-    prepares_taken: int = 0
-    commits_taken: int = 0
+    prepares_taken_mask: int = 0
+    commits_taken_mask: int = 0
     pending_sigs: list = field(default_factory=list)
-    seen_signers: set = field(default_factory=set)
+    seen_mask: int = 0  # signers with an accepted (verified-valid) commit
     valid_sigs: list = field(default_factory=list)
     verify_inflight: bool = False
     verify_failures: int = 0
     begin: float = 0.0
 
     def __post_init__(self):
-        self.prepares = VoteSet(lambda _s, m: isinstance(m, Prepare))
+        self.prepares = VoteSet(
+            lambda _s, m: isinstance(m, Prepare), self.index
+        )
 
         def accept_commit(sender: int, m: Message) -> bool:
             if not isinstance(m, Commit) or m.signature is None:
                 return False
             return m.signature.signer == sender  # view.go:160-171
 
-        self.commits = VoteSet(accept_commit)
+        self.commits = VoteSet(accept_commit, self.index)
 
 
 @dataclass(frozen=True)
@@ -236,6 +242,8 @@ class WindowedView:
         self.window = max(2, int(window))
         self.in_flight = in_flight
         self.metrics = metrics_view
+        #: one dense signer-id index shared by every slot's vote sets
+        self._signer_index = SignerIndex(nodes_list)
         #: called (no args) when propose capacity re-opens WITHOUT a
         #: delivery — the launch-shadow gate unlocking, or a WAL drain
         #: completing; the Controller re-arms the leader token on it
@@ -381,6 +389,29 @@ class WindowedView:
                 self.number, sender, e,
             )
             self._stop()
+        self._work.set()
+
+    def ingest_batch(self, items) -> None:
+        """Wave-batched intake: register a whole wave of (sender, msg)
+        pairs — e.g. all n-1 prepares of a phase — in ONE call with ONE
+        run-loop wakeup, instead of ~n handle_message call chains each
+        setting the work event.  Direct ingest never blocks (vote-set dedup
+        + the slot window bound memory), so the batch is synchronous."""
+        if self._aborted:
+            return
+        t0 = time.perf_counter()
+        try:
+            for sender, msg in items:
+                self._process_msg(sender, msg)
+        except ViewAborted:
+            pass
+        except Exception as e:
+            self.logger.errorf(
+                "WindowedView %d failed processing a message batch: %r",
+                self.number, e,
+            )
+            self._stop()
+        PROTOCOL_PLANE.vote_reg_us += (time.perf_counter() - t0) * 1e6
         self._work.set()
 
     # ------------------------------------------------------------------ windows
@@ -568,7 +599,9 @@ class WindowedView:
 
         slot = self.slots.get(msg_seq)
         if slot is None:
-            slot = self.slots[msg_seq] = _Slot(seq=msg_seq)
+            slot = self.slots[msg_seq] = _Slot(
+                seq=msg_seq, index=self._signer_index
+            )
 
         if isinstance(m, PrePrepare):
             if m.proposal is None:
@@ -827,16 +860,21 @@ class WindowedView:
     # -- phase 2: prepares --------------------------------------------------
 
     def _count_prepares(self, slot: _Slot) -> int:
-        while slot.prepares_taken < len(slot.prepares.votes):
-            vote = slot.prepares.votes[slot.prepares_taken]
-            slot.prepares_taken += 1
-            if vote.msg.digest != slot.digest:
-                self.logger.warnf(
-                    "Got wrong digest at processPrepares for prepare with seq %d",
-                    vote.msg.seq,
-                )
-                continue
-            slot.prepare_voters.append(vote.sender)
+        # incremental bitmask sweep: only signers not counted yet — the
+        # common case (no new votes) is one AND + one compare, no iteration
+        vs = slot.prepares
+        new = vs.mask & ~slot.prepares_taken_mask
+        if new:
+            slot.prepares_taken_mask |= new
+            for idx in iter_bits(new):
+                prepare: Prepare = vs.payloads[idx]
+                if prepare.digest != slot.digest:
+                    self.logger.warnf(
+                        "Got wrong digest at processPrepares for prepare with seq %d",
+                        prepare.seq,
+                    )
+                    continue
+                slot.prepare_voters.append(vs.signer_id(idx))
         return len(slot.prepare_voters)
 
     def _stage_commit(self, slot: _Slot):
@@ -878,16 +916,19 @@ class WindowedView:
         if slot.phase != PREPARED:
             return
         # drain newly registered votes into the slot's pending pool
-        while slot.commits_taken < len(slot.commits.votes):
-            vote = slot.commits.votes[slot.commits_taken]
-            slot.commits_taken += 1
-            commit: Commit = vote.msg
-            if commit.digest != slot.digest:
-                self.logger.warnf("Got wrong digest at processCommits for seq %d", commit.seq)
-                continue
-            if commit.signature.signer in slot.seen_signers:
-                continue
-            slot.pending_sigs.append(commit.signature)
+        # (incremental bitmask sweep — integer ops on the hot path)
+        vs = slot.commits
+        new = vs.mask & ~slot.commits_taken_mask
+        if new:
+            slot.commits_taken_mask |= new
+            for idx in iter_bits(new):
+                commit: Commit = vs.payloads[idx]
+                if commit.digest != slot.digest:
+                    self.logger.warnf("Got wrong digest at processCommits for seq %d", commit.seq)
+                    continue
+                if slot.seen_mask >> idx & 1:
+                    continue
+                slot.pending_sigs.append(commit.signature)
         if slot.verify_inflight or not slot.pending_sigs:
             return
         # quorum-feasibility flush policy (View._process_commits): launch
@@ -943,22 +984,30 @@ class WindowedView:
                 return
             # the engine call failed (not the signatures): re-pool the
             # candidates for a retry on the next flush attempt
+            index = self._signer_index
             slot.pending_sigs.extend(
-                s for s in sigs if s.signer not in slot.seen_signers
+                s for s in sigs
+                if index.index_of(s.signer) < 0
+                or not (slot.seen_mask >> index.index_of(s.signer) & 1)
             )
             return
         slot.verify_failures = 0
+        index = self._signer_index
         for sig, aux in zip(sigs, results):
             if aux is None:
                 self.logger.warnf("Couldn't verify %d's signature", sig.signer)
                 continue
-            if sig.signer in slot.seen_signers:
+            idx = index.index_of(sig.signer)
+            if idx < 0:
+                continue  # not a member (cannot complete any quorum)
+            bit = 1 << idx
+            if slot.seen_mask & bit:
                 continue
             # cap at exactly quorum-1 (certificate-size determinism; see
             # View._process_commits)
             if len(slot.valid_sigs) >= self.quorum - 1:
                 break
-            slot.seen_signers.add(sig.signer)
+            slot.seen_mask |= bit
             slot.valid_sigs.append(sig)
         if slot.valid_sigs and len(slot.valid_sigs) >= self.quorum - 1 and slot.phase == PREPARED:
             slot.phase = READY
@@ -1158,7 +1207,7 @@ class WindowedView:
                 break  # a gap: later records belong to an older window shape
             entry = by_seq[seq]
             pp: PrePrepare = entry["P"].pre_prepare
-            slot = self.slots[seq] = _Slot(seq=seq)
+            slot = self.slots[seq] = _Slot(seq=seq, index=self._signer_index)
             slot.pre_prepare = pp
             slot.proposal = pp.proposal
             slot.digest = proposal_digest(pp.proposal)

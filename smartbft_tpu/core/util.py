@@ -66,31 +66,162 @@ class Vote:
     sender: int
 
 
-class VoteSet:
-    """Dedup'd per-sender vote accumulator (util.go:102-136, event-driven)."""
+def iter_bits(mask: int):
+    """Indices of the set bits of ``mask``, lowest first (pure int ops)."""
+    while mask:
+        low = mask & -mask
+        yield low.bit_length() - 1
+        mask ^= low
 
-    def __init__(self, valid_vote: Callable[[int, Message], bool]):
-        self._valid_vote = valid_vote
-        self.voted: set[int] = set()
-        self.votes: list[Vote] = []
 
-    def clear(self) -> None:
-        self.voted.clear()
-        self.votes.clear()
+class SignerIndex:
+    """Dense signer-id -> bit-index mapping, shared by every vote set and
+    slot of a cluster.  Node ids are small ints, so the lookup is one list
+    index — no hashing on the vote hot path."""
 
-    def register_vote(self, voter: int, msg: Message) -> Optional[Vote]:
-        """Returns the registered Vote, or None if invalid/duplicate."""
-        if not self._valid_vote(voter, msg):
-            return None
-        if voter in self.voted:
-            return None  # double vote
-        self.voted.add(voter)
-        v = Vote(msg=msg, sender=voter)
-        self.votes.append(v)
-        return v
+    __slots__ = ("ids", "_tbl")
+
+    def __init__(self, ids: list[int]):
+        self.ids = list(ids)
+        size = (max(self.ids) + 1) if self.ids else 0
+        self._tbl = [-1] * size
+        for i, nid in enumerate(self.ids):
+            self._tbl[nid] = i
+
+    def index_of(self, nid: int) -> int:
+        """Bit index of ``nid``, or -1 for an unknown signer."""
+        if 0 <= nid < len(self._tbl):
+            return self._tbl[nid]
+        return -1
 
     def __len__(self) -> int:
-        return len(self.votes)
+        return len(self.ids)
+
+
+class _VotedView:
+    """len/in/iter view over a VoteSet's signer bitmask (API compat with
+    the old ``voted: set[int]`` field)."""
+
+    __slots__ = ("_vs",)
+
+    def __init__(self, vs: "VoteSet"):
+        self._vs = vs
+
+    def __len__(self) -> int:
+        return self._vs.mask.bit_count()
+
+    def __contains__(self, voter: int) -> bool:
+        idx = self._vs._index_of(voter)
+        return idx >= 0 and bool(self._vs.mask >> idx & 1)
+
+    def __iter__(self):
+        vs = self._vs
+        for idx in iter_bits(vs.mask):
+            yield vs.signer_id(idx)
+
+
+class VoteSet:
+    """Dedup'd per-sender vote accumulator (util.go:102-136, event-driven).
+
+    Bitmask representation: ``mask`` holds one bit per signer, payloads
+    live in a per-signer array, so registration and the quorum test are
+    integer ops (bit set + popcount) instead of set hashing and per-vote
+    object allocation — the vote path runs ~12k times per decision at
+    n=64, which made the old set+list representation a top-2 item of the
+    protocol-plane profile (PERF.md).
+
+    Two index modes:
+
+    * ``signers=`` (hot paths — View / WindowedView slots): a shared
+      :class:`SignerIndex` preallocates the payload array and maps ids by
+      list lookup.  Payload order is signer-index order.
+    * dynamic (cold paths — ViewChanger, StateCollector, doubles): indices
+      are assigned first-seen, preserving the old arrival-order iteration
+      exactly.
+
+    Compat surface: ``voted`` (len/in/iter view over the mask) and
+    ``votes`` (a lazily built list of :class:`Vote`) keep the cold
+    consumers and existing tests working unchanged.
+    """
+
+    __slots__ = ("_valid_vote", "_signers", "_dyn_ids", "_dyn_idx",
+                 "mask", "payloads")
+
+    def __init__(self, valid_vote: Callable[[int, Message], bool],
+                 signers: Optional[SignerIndex] = None):
+        self._valid_vote = valid_vote
+        self._signers = signers
+        self._dyn_ids: Optional[list[int]] = None if signers is not None else []
+        self._dyn_idx: Optional[dict[int, int]] = None if signers is not None else {}
+        self.mask = 0
+        self.payloads: list[Optional[Message]] = (
+            [None] * len(signers) if signers is not None else []
+        )
+
+    # -- index plumbing ----------------------------------------------------
+
+    def _index_of(self, voter: int) -> int:
+        if self._signers is not None:
+            return self._signers.index_of(voter)
+        idx = self._dyn_idx.get(voter)
+        return -1 if idx is None else idx
+
+    def signer_id(self, idx: int) -> int:
+        if self._signers is not None:
+            return self._signers.ids[idx]
+        return self._dyn_ids[idx]
+
+    # -- core --------------------------------------------------------------
+
+    def clear(self) -> None:
+        self.mask = 0
+        if self._signers is not None:
+            for i in range(len(self.payloads)):
+                self.payloads[i] = None
+        else:
+            self._dyn_ids.clear()
+            self._dyn_idx.clear()
+            self.payloads.clear()
+
+    def register_vote(self, voter: int, msg: Message) -> Optional[Message]:
+        """Returns the registered message, or None if invalid/duplicate."""
+        if not self._valid_vote(voter, msg):
+            return None
+        if self._signers is not None:
+            idx = self._signers.index_of(voter)
+            if idx < 0:
+                return None  # not a member
+        else:
+            idx = self._dyn_idx.get(voter)
+            if idx is None:
+                idx = len(self._dyn_ids)
+                self._dyn_idx[voter] = idx
+                self._dyn_ids.append(voter)
+                self.payloads.append(None)
+        bit = 1 << idx
+        if self.mask & bit:
+            return None  # double vote
+        self.mask |= bit
+        self.payloads[idx] = msg
+        return msg
+
+    def __len__(self) -> int:
+        return self.mask.bit_count()
+
+    def items(self):
+        """(sender, msg) pairs of the registered votes."""
+        for idx in iter_bits(self.mask):
+            yield self.signer_id(idx), self.payloads[idx]
+
+    # -- compat views ------------------------------------------------------
+
+    @property
+    def voted(self) -> _VotedView:
+        return _VotedView(self)
+
+    @property
+    def votes(self) -> list[Vote]:
+        return [Vote(msg=m, sender=s) for s, m in self.items()]
 
 
 class NextViews:

@@ -45,9 +45,10 @@ from ..messages import (
 )
 from ..metrics import BlacklistMetrics, ViewMetrics
 from ..types import VerifyPlaneDown, proposal_digest
+from ..metrics import PROTOCOL_PLANE
 from .rotation import RotationState
 from .state import ABORT, COMMITTED, PREPARED, PROPOSED
-from .util import VoteSet, compute_quorum
+from .util import SignerIndex, VoteSet, compute_quorum, iter_bits
 from ..utils.tasks import create_logged_task
 
 _MAX_U64 = 2**64 - 1
@@ -240,6 +241,8 @@ class View:
         # 1-slot pre-prepare stashes (view.go:105-111)
         self._pre_prepare: Optional[PrePrepare] = None
         self._next_pre_prepare: Optional[PrePrepare] = None
+        #: shared id->bit mapping: one per view, reused by all 4 vote sets
+        self._signer_index = SignerIndex(nodes_list)
         self._setup_votes()
 
     # ------------------------------------------------------------------ votes
@@ -253,10 +256,11 @@ class View:
                 return False
             return m.signature.signer == sender  # view.go:160-171
 
-        self.prepares = VoteSet(accept_prepares)
-        self.next_prepares = VoteSet(accept_prepares)
-        self.commits = VoteSet(accept_commits)
-        self.next_commits = VoteSet(accept_commits)
+        idx = self._signer_index
+        self.prepares = VoteSet(accept_prepares, idx)
+        self.next_prepares = VoteSet(accept_prepares, idx)
+        self.commits = VoteSet(accept_commits, idx)
+        self.next_commits = VoteSet(accept_commits, idx)
 
     # ------------------------------------------------------------------ life
 
@@ -334,6 +338,23 @@ class View:
             return
         await self._inbox.put((sender, msg))
 
+    def ingest_batch(self, items) -> None:
+        """Wave-batched intake: enqueue a whole wave of (sender, msg) pairs
+        in one call.  The run task's pending ``get()`` wakes once for the
+        wave instead of once per message; ``_drain_inbox`` then registers
+        the rest without further awaits."""
+        for sender, msg in items:
+            self.handle_message(sender, msg)
+
+    async def ingest_batch_async(self, items) -> None:
+        """Backpressure-aware wave intake (blocks per message on a full
+        inbox, like handle_message_async)."""
+        if not self.backpressure:
+            self.ingest_batch(items)
+            return
+        for sender, msg in items:
+            await self.handle_message_async(sender, msg)
+
     # ------------------------------------------------------------------ loop
 
     async def _run(self) -> None:
@@ -389,15 +410,22 @@ class View:
     def _drain_inbox(self) -> None:
         """Process everything already queued without awaiting — lets votes
         coalesce ahead of a batched verify."""
-        while True:
-            try:
-                item = self._inbox.get_nowait()
-            except asyncio.QueueEmpty:
-                return
-            if item is _ABORT or self._aborted:
-                raise ViewAborted()
-            sender, msg = item
-            self._process_msg(sender, msg)
+        t0 = time.perf_counter()
+        drained = False
+        try:
+            while True:
+                try:
+                    item = self._inbox.get_nowait()
+                except asyncio.QueueEmpty:
+                    return
+                if item is _ABORT or self._aborted:
+                    raise ViewAborted()
+                drained = True
+                sender, msg = item
+                self._process_msg(sender, msg)
+        finally:
+            if drained:
+                PROTOCOL_PLANE.vote_reg_us += (time.perf_counter() - t0) * 1e6
 
     # ------------------------------------------------------------------ routing
 
@@ -539,20 +567,26 @@ class View:
         proposal = self.in_flight_proposal
         expected_digest = proposal_digest(proposal)
         voter_ids: list[int] = []
-        taken = 0
+        taken_mask = 0
 
-        while len(voter_ids) < self.quorum - 1:
-            while taken < len(self.prepares.votes):
-                vote = self.prepares.votes[taken]
-                taken += 1
-                prepare: Prepare = vote.msg
+        def sweep() -> None:
+            # incremental mask sweep: only bits not seen before — popcount
+            # + bit iteration, no per-vote objects or hashing
+            nonlocal taken_mask
+            new = self.prepares.mask & ~taken_mask
+            taken_mask |= new
+            for idx in iter_bits(new):
+                prepare: Prepare = self.prepares.payloads[idx]
                 if prepare.digest != expected_digest:
                     self.logger.warnf(
                         "Got wrong digest at processPrepares for prepare with seq %d",
                         prepare.seq,
                     )
                     continue
-                voter_ids.append(vote.sender)
+                voter_ids.append(self.prepares.signer_id(idx))
+
+        while len(voter_ids) < self.quorum - 1:
+            sweep()
             if len(voter_ids) >= self.quorum - 1:
                 break
             await self._next_event()
@@ -565,17 +599,7 @@ class View:
         # (the vote set dedupes per sender, so one more pass of the same
         # collection loop suffices)
         self._drain_inbox()
-        while taken < len(self.prepares.votes):
-            vote = self.prepares.votes[taken]
-            taken += 1
-            prepare = vote.msg
-            if prepare.digest != expected_digest:
-                self.logger.warnf(
-                    "Got wrong digest at processPrepares for prepare with seq %d",
-                    prepare.seq,
-                )
-                continue
-            voter_ids.append(vote.sender)
+        sweep()
 
         self.logger.infof(
             "%d collected %d prepares from %s", self.self_id, len(voter_ids), voter_ids
@@ -636,14 +660,15 @@ class View:
         valid: list[Signature] = []
         seen: set[int] = set()
         pending: list[Signature] = []
-        taken = 0
+        taken_mask = 0
 
         while len(valid) < self.quorum - 1:
             # gather every pending, digest-matching vote not yet verified
-            while taken < len(self.commits.votes):
-                vote = self.commits.votes[taken]
-                taken += 1
-                commit: Commit = vote.msg
+            # (incremental mask sweep — integer ops, no vote objects)
+            new = self.commits.mask & ~taken_mask
+            taken_mask |= new
+            for idx in iter_bits(new):
+                commit: Commit = self.commits.payloads[idx]
                 if commit.digest != expected_digest:
                     self.logger.warnf("Got wrong digest at processCommits for seq %d", commit.seq)
                     continue

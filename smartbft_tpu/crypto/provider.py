@@ -39,7 +39,7 @@ import numpy as np
 from ..codec import decode, encode, wiremsg
 from ..messages import Proposal, Signature
 from ..types import VerifyPlaneDown, proposal_digest
-from ..utils.memo import BoundedMemo
+from ..utils.memo import LruMemo
 from ..utils.tasks import create_logged_task
 from . import bls12381, ed25519, p256
 
@@ -925,7 +925,11 @@ class CryptoProvider:
         host engines keep the legacy single-attempt contract unless a
         policy is supplied (or wired later by the Consensus facade)."""
         self.keyring = keyring
-        self._sig_msg_memo: BoundedMemo[bytes, "ConsenterSigMsg"] = BoundedMemo(8192)
+        # LRU-bounded with an eviction counter: the keys are adversary-
+        # chosen wire bytes, so a Byzantine flood of unique sig messages
+        # churns the tail one entry at a time instead of wiping the honest
+        # working set (and can never grow memory past the bound)
+        self._sig_msg_memo: LruMemo[bytes, "ConsenterSigMsg"] = LruMemo(8192)
         if coalescer is not None and engine is not None \
                 and coalescer.engine is not engine:
             raise ValueError("shared coalescer wraps a different engine")
@@ -1214,6 +1218,59 @@ class BlsCryptoProvider(CryptoProvider):
         except ValueError:
             return None  # mixed messages / degenerate sums
 
+    def _quorum_minus_one(self) -> int:
+        n = len(self.keyring.public_keys)
+        f = (n - 1) // 3
+        return max(2, (n + f + 1 + 1) // 2 - 1)  # ceil((n+f+1)/2) - 1
+
+    def _canonical_split(self, signatures, items, idxs):
+        """Canonicalized aggregation: the CANONICAL quorum subset — the
+        lowest quorum-1 signer ids present — aggregates into one lane;
+        leftovers get per-item lanes.
+
+        Cross-replica dedupe (PERF.md round-5 row [4]'s named lever):
+        without canonicalization every replica aggregates ITS OWN collected
+        subset, so the aggregated items of two replicas checking the same
+        decision never match and the shared coalescer's dedupe pass cannot
+        collapse them.  Sorting by signer id and capping at quorum-1 makes
+        replicas that hold the same votes produce BYTE-IDENTICAL aggregate
+        items (aggregation is a commutative point sum over the canonical
+        codec's byte encodings), so an n-replica wave dedupes to one lane.
+
+        Returns (lane, chosen_positions, rest_positions) or None when no
+        aggregation applies (<=1 item / mixed messages)."""
+        if len(items) <= 1:
+            return None
+        order = sorted(range(len(items)),
+                       key=lambda p: signatures[idxs[p]].signer)
+        chosen = order[: self._quorum_minus_one()]
+        if len(chosen) <= 1:
+            return None
+        rest = order[len(chosen):]
+        try:
+            lane = self.scheme.aggregate_items([items[p] for p in chosen])
+        except ValueError:
+            return None  # mixed messages / degenerate sums
+        return lane, chosen, rest
+
+    @staticmethod
+    def _merge_split_verdicts(split, results, chosen_results, n_items) -> list[bool]:
+        """Fan the [lane, rest...] result vector (plus, on lane failure,
+        the per-item re-attribution of the chosen subset) onto positions.
+        Rest verdicts are REUSED either way — a failed canonical lane only
+        costs re-verifying the chosen items, never the whole batch."""
+        _, chosen, rest = split
+        mask = [False] * n_items
+        for j, p in enumerate(rest):
+            mask[p] = results[1 + j]
+        if results[0]:
+            for p in chosen:
+                mask[p] = True
+        else:
+            for j, p in enumerate(chosen):
+                mask[p] = chosen_results[j]
+        return mask
+
     def _verify_items(self, items) -> list[bool]:
         lane = self._aggregate_lane(items)
         if lane is not None and self.engine.verify([lane])[0]:
@@ -1227,3 +1284,39 @@ class BlsCryptoProvider(CryptoProvider):
         if lane is not None and (await self._coalescer.submit([lane]))[0]:
             return [True] * len(items)
         return await self._coalescer.submit(items)
+
+    def verify_consenter_sigs_batch(
+        self, signatures: Sequence[Signature], proposal: Proposal
+    ) -> list:
+        auxes, items, idxs = self._collect(signatures, proposal)
+        split = self._canonical_split(signatures, items, idxs)
+        if split is None:
+            return self._apply_mask(auxes, idxs, self._verify_items(items))
+        lane, chosen, rest = split
+        results = self.engine.verify([lane] + [items[p] for p in rest])
+        chosen_results = None
+        if not results[0]:
+            # canonical lane failed: attribute only the chosen subset
+            chosen_results = self.engine.verify([items[p] for p in chosen])
+        mask = self._merge_split_verdicts(split, results, chosen_results, len(items))
+        return self._apply_mask(auxes, idxs, mask)
+
+    async def verify_consenter_sigs_batch_async(
+        self, signatures: Sequence[Signature], proposal: Proposal
+    ) -> list:
+        auxes, items, idxs = self._collect(signatures, proposal)
+        split = self._canonical_split(signatures, items, idxs)
+        if split is None:
+            return self._apply_mask(auxes, idxs,
+                                    await self._verify_items_async(items))
+        lane, chosen, rest = split
+        results = await self._coalescer.submit(
+            [lane] + [items[p] for p in rest]
+        )
+        chosen_results = None
+        if not results[0]:
+            chosen_results = await self._coalescer.submit(
+                [items[p] for p in chosen]
+            )
+        mask = self._merge_split_verdicts(split, results, chosen_results, len(items))
+        return self._apply_mask(auxes, idxs, mask)

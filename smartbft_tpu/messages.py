@@ -231,3 +231,104 @@ def marshal_untagged(msg) -> bytes:
 
 def unmarshal_as(cls, data: bytes):
     return decode(cls, data)
+
+
+# ---------------------------------------------------------------------------
+# Vectorized message plane: encode-once + interned decode.
+#
+# A broadcast used to pay one encode per recipient and one decode per
+# delivery (n-1 each at fan-out n).  ``wire_of`` memoizes the canonical
+# encoding ON the frozen message instance, so a broadcast (and every
+# re-broadcast/assist resend of the same object) encodes at most once;
+# ``unmarshal_interned`` memoizes decode BY WIRE BYTES in a bounded LRU, so
+# the n-1 identical deliveries of one broadcast decode once and every
+# recipient shares the same frozen message object.  The contract that makes
+# the sharing sound: ingested messages are IMMUTABLE — receivers never
+# mutate a decoded message (wiremsg dataclasses are frozen; protocol code
+# copies nested lists before touching them), and fault injection that wants
+# to corrupt a message must deep-copy it first (``deep_copy_message``).
+# ---------------------------------------------------------------------------
+
+from time import perf_counter as _perf_counter  # noqa: E402
+
+from .metrics import PROTOCOL_PLANE as _PLANE  # noqa: E402
+from .utils.memo import LruMemo  # noqa: E402
+
+_WIRE_MEMO_ATTR = "_wire_memo"
+
+#: default bound for the tagged-decode intern memo: comfortably above the
+#: live window of any cluster this harness runs (3k slots x a few message
+#: kinds x n senders collapse to one entry per distinct broadcast), small
+#: enough that a Byzantine flood of unique messages cannot grow memory
+INTERN_MEMO_BOUND = 4096
+
+
+def _count_intern_eviction() -> None:
+    _PLANE.intern_evictions += 1
+
+
+_INTERN: LruMemo[bytes, object] = LruMemo(
+    INTERN_MEMO_BOUND, on_evict=_count_intern_eviction
+)
+
+
+def wire_of(msg) -> bytes:
+    """Canonical tagged encoding, memoized on the (frozen) instance.
+
+    The memo makes "exactly one encode per broadcast" a structural
+    invariant: the fan-out loop, re-broadcasts after view restarts, and
+    lagging-replica assist resends all reuse the first encoding."""
+    w = getattr(msg, _WIRE_MEMO_ATTR, None)
+    if w is None:
+        t0 = _perf_counter()
+        w = encode_tagged(msg)
+        _PLANE.codec_us += (_perf_counter() - t0) * 1e6
+        _PLANE.encodes += 1
+        object.__setattr__(msg, _WIRE_MEMO_ATTR, w)
+    else:
+        _PLANE.encode_memo_hits += 1
+    return w
+
+
+def unmarshal_interned(data: bytes):
+    """Tagged decode through the bounded intern memo.
+
+    All recipients of one broadcast receive byte-identical wire payloads,
+    so the first delivery decodes and every later one is a dict hit
+    returning the SAME frozen message object — receivers must treat it as
+    immutable.  The memo is LRU-bounded (eviction counted in
+    ``metrics.PROTOCOL_PLANE.intern_evictions``), so unique-message floods
+    cannot grow memory."""
+    msg = _INTERN.get(data)
+    if msg is not None:
+        _PLANE.decode_interned_hits += 1
+        return msg
+    t0 = _perf_counter()
+    msg = decode_tagged(data)
+    _PLANE.codec_us += (_perf_counter() - t0) * 1e6
+    _PLANE.decodes += 1
+    # the decoded object already knows its own encoding — assists and
+    # forwards of an ingested message re-send without re-encoding
+    object.__setattr__(msg, _WIRE_MEMO_ATTR, data)
+    _INTERN.put(data, msg)
+    return msg
+
+
+def intern_memo_len() -> int:
+    return len(_INTERN)
+
+
+def clear_intern_memo() -> None:
+    _INTERN.clear()
+
+
+def deep_copy_message(msg):
+    """A genuinely fresh copy of a wire message (codec round-trip).
+
+    For fault injection that MUTATES messages: broadcasts share one frozen
+    decoded object across all recipients, so in-place corruption of the
+    shared instance would leak into every replica's ingest.  A codec
+    round-trip yields an independent object tree with none of the cached
+    derivations (`_wire_memo`, `_digest_memo`) that an in-place mutation
+    would otherwise leave stale."""
+    return decode_tagged(encode_tagged(msg))

@@ -492,6 +492,82 @@ class TPUCryptoMetrics:
         self.breaker_state = _g(p, "tpu", "verify_breaker_open")
 
 
+# ---------------------------------------------------------------------------
+# Protocol-plane timers (the vectorized message plane's measurement surface)
+# ---------------------------------------------------------------------------
+
+
+class ProtocolPlaneTimers:
+    """Process-wide accumulator for the message plane's hot-path terms.
+
+    The round-6 ceiling decomposition (PERF.md) showed the paired ratio
+    bound by the PROTOCOL plane, dominated by per-message routing, vote
+    registration, and (in any real transport) per-recipient codec work.
+    These counters make that cost measured instead of asserted: the
+    in-process network, the controller dispatch, and the views accumulate
+    wall-time (microseconds) and call counts here, and every
+    ``bench.py`` / ``benchmarks/throughput.py`` JSON row exports a
+    ``protocol_plane`` block from a snapshot delta.
+
+    Accumulation is a couple of float adds per WAVE (never per message),
+    so the accounting itself stays off the path it measures.  The four
+    timers are DISJOINT: the network subtracts the codec time accrued
+    inside a fan-out from ``route_us`` and the codec + vote-registration
+    time accrued inside an ingest tick from ``ingest_us``, so
+    ``ingest_us + route_us + vote_reg_us + codec_us`` is the plane total
+    without double-counting.  (``route_us`` is the sender side: fault
+    checks + enqueue; ``ingest_us`` is the receiver-side drain/dispatch
+    remainder; ``codec_us`` covers every marshal/unmarshal wherever it
+    runs; ``vote_reg_us`` is view-level wave registration.)
+    """
+
+    __slots__ = (
+        "ingest_us", "route_us", "vote_reg_us", "codec_us",
+        "broadcasts", "sends", "encodes", "encode_memo_hits",
+        "decodes", "decode_interned_hits", "intern_evictions",
+        "batch_ingests", "msgs_ingested", "malformed_dropped",
+    )
+
+    def __init__(self) -> None:
+        self.reset()
+
+    def reset(self) -> None:
+        self.ingest_us = 0.0    # node batch-drain -> dispatch, total
+        self.route_us = 0.0     # sender-side fan-out (fault checks + enqueue)
+        self.vote_reg_us = 0.0  # view-level wave registration (slots/vote sets)
+        self.codec_us = 0.0     # marshal + (interned) unmarshal wall time
+        self.broadcasts = 0           # broadcast_consensus fan-outs
+        self.sends = 0                # single-target consensus sends
+        self.encodes = 0              # actual marshal() compilations
+        self.encode_memo_hits = 0     # wire bytes served from the message memo
+        self.decodes = 0              # actual unmarshal() runs (intern misses)
+        self.decode_interned_hits = 0  # deliveries served by the intern memo
+        self.intern_evictions = 0     # bounded intern memo evictions
+        self.batch_ingests = 0        # node ingest ticks (batches drained)
+        self.msgs_ingested = 0        # messages across those ticks
+        self.malformed_dropped = 0    # undecodable wire payloads dropped
+
+    def snapshot(self) -> dict:
+        return {name: getattr(self, name) for name in self.__slots__}
+
+    @staticmethod
+    def delta(before: dict, after: dict) -> dict:
+        return {
+            k: round(after[k] - before[k], 1)
+            if isinstance(after[k], float) else after[k] - before[k]
+            for k in after
+        }
+
+
+#: the process-wide instance the message plane feeds (one in-process
+#: cluster = one plane, which is exactly the deployment the bench measures)
+PROTOCOL_PLANE = ProtocolPlaneTimers()
+
+
+def protocol_plane_snapshot() -> dict:
+    return PROTOCOL_PLANE.snapshot()
+
+
 class MetricsBundle:
     """All bundles wired from one provider — what Consensus hands to components."""
 

@@ -228,6 +228,11 @@ class App(Application, Assembler, Comm, Signer, Verifier, RequestInspector,
     def send_consensus(self, target_id: int, msg) -> None:
         self.network.send_consensus(self.id, target_id, msg)
 
+    def broadcast_consensus(self, msg, targets=None) -> None:
+        # encode-once fan-out: the network marshals once and shares the
+        # wire bytes (and the interned decoded object) across recipients
+        self.network.broadcast_consensus(self.id, msg, targets)
+
     def send_transaction(self, target_id: int, request: bytes) -> None:
         self.network.send_transaction(self.id, target_id, request)
 

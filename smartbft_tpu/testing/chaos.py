@@ -402,6 +402,16 @@ class ChaosCluster:
         return self.engine
 
     def _corruptor(self, fraction: float):
+        """Per-target message corruption.
+
+        Copy-on-write contract: broadcasts share ONE frozen decoded message
+        object across all recipients (the encode-once plane), so a mutation
+        hook must never touch the routed original — the network enforces
+        this by handing every mutate_send hook a deep copy
+        (``messages.deep_copy_message``), making it impossible for the
+        corruption of one recipient's message to leak into another
+        replica's ingest (regression-pinned in tests/test_message_plane.py).
+        """
         rng = self.rng
 
         def mutate(_target, msg):

@@ -4,18 +4,70 @@ Re-design of /root/reference/test/network.go:18-252: a map of node id ->
 Node, each with a bounded inbox drained by its own asyncio task.  Faults are
 injectable per node and per peer: probabilistic message loss, message
 mutation hooks, full disconnects, and drop-on-overflow.
+
+**Vectorized message plane.**  Messages travel as wire BYTES (the canonical
+tagged codec — what any real transport carries), but the plane is
+vectorized so fan-out costs O(1) codec work instead of O(n):
+
+* **Encode-once broadcast** — ``broadcast_consensus`` encodes the message
+  once (``messages.wire_of``, memoized on the frozen instance) and enqueues
+  the same bytes at every recipient;
+* **Interned decode** — delivery decodes through a bounded LRU keyed by
+  wire bytes (``messages.unmarshal_interned``), so the n-1 identical
+  payloads of one broadcast decode once and all recipients share one
+  frozen message object.  Receivers treat ingested messages as IMMUTABLE;
+  fault hooks that mutate messages get a deep copy (copy-on-write), so
+  corrupting one recipient's message cannot leak into another's ingest;
+* **Wave-batched ingest** — a node's serve task drains everything queued in
+  its inbox per wakeup and hands the whole run to
+  ``Consensus.handle_message_batch`` in one call, so a quorum wave of votes
+  registers in one scheduler tick instead of ~n call chains.
+
+``Network(naive=True)`` disables all three (per-recipient encode,
+per-delivery decode, per-message dispatch) — the pre-vectorization plane,
+kept as the A/B baseline for the message-plane microbench and regression
+tests.  All costs and call counts feed :data:`smartbft_tpu.metrics.
+PROTOCOL_PLANE`.
 """
 
 from __future__ import annotations
 
 import asyncio
 import random
+from time import perf_counter
 from typing import Callable, Optional
 
-from ..messages import Message
+from ..codec import CodecError
+from ..messages import (
+    Message,
+    deep_copy_message,
+    marshal,
+    unmarshal,
+    unmarshal_interned,
+    wire_of,
+)
+from ..metrics import PROTOCOL_PLANE
 from ..utils.tasks import create_logged_task
 
 INCOMING_BUFFER = 1000  # network.go:18-20
+
+
+def _marshal_timed(msg: Message) -> bytes:
+    """Plain (un-memoized) encode with codec accounting — the naive plane's
+    per-recipient cost, and the path mutated (per-target) copies take."""
+    t0 = perf_counter()
+    w = marshal(msg)
+    PROTOCOL_PLANE.codec_us += (perf_counter() - t0) * 1e6
+    PROTOCOL_PLANE.encodes += 1
+    return w
+
+
+def _unmarshal_timed(data: bytes) -> Message:
+    t0 = perf_counter()
+    m = unmarshal(data)
+    PROTOCOL_PLANE.codec_us += (perf_counter() - t0) * 1e6
+    PROTOCOL_PLANE.decodes += 1
+    return m
 
 
 class Node:
@@ -37,6 +89,7 @@ class Node:
         self._inbox: asyncio.Queue = asyncio.Queue(maxsize=INCOMING_BUFFER)
         self._task: Optional[asyncio.Task] = None
         self.dropped = 0
+        self.malformed = 0  # undecodable wire payloads (Byzantine/corrupt)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -56,31 +109,99 @@ class Node:
             self._task = None
 
     async def _serve(self) -> None:
+        """Wave-batched drain: each wakeup collects EVERYTHING already
+        queued and dispatches it as one batch — a whole prepare/commit wave
+        registers in one ``handle_message_batch`` call instead of ~n
+        per-message call chains (naive mode dispatches per message)."""
         while True:
             item = await self._inbox.get()
-            if item is None or not self.running:
-                return
-            kind, sender, payload = item
-            try:
-                if kind == "consensus":
-                    # async intake: a backpressure-configured cluster blocks
-                    # THIS node's delivery task on a full component inbox
-                    # (the reference's full-channel semantics); in drop mode
-                    # it behaves exactly like the sync intake
-                    intake = getattr(
-                        self.consensus, "handle_message_async", None
-                    )
-                    if intake is not None:
-                        await intake(sender, payload)
-                    else:  # injected doubles without the async surface
-                        self.consensus.handle_message(sender, payload)
-                else:
-                    await self.consensus.handle_request(sender, payload)
-            except Exception as e:  # pragma: no cover — harness robustness
-                import traceback
+            batch: list = []
+            stop = False
+            while True:
+                if item is None or not self.running:
+                    stop = True
+                    break
+                batch.append(item)
+                try:
+                    item = self._inbox.get_nowait()
+                except asyncio.QueueEmpty:
+                    break
+            if batch:
+                try:
+                    await self._dispatch(batch)
+                except Exception:  # pragma: no cover — harness robustness
+                    import traceback
 
-                traceback.print_exc()
-                raise
+                    traceback.print_exc()
+                    raise
+            if stop:
+                return
+
+    async def _dispatch(self, batch: list) -> None:
+        """Decode (interned) and route one drained batch, preserving the
+        arrival order across kinds."""
+        t0 = perf_counter()
+        codec0 = PROTOCOL_PLANE.codec_us
+        vote0 = PROTOCOL_PLANE.vote_reg_us
+        naive = self.network.naive
+        run: list = []  # consecutive consensus (sender, msg) pairs
+        for kind, sender, payload in batch:
+            if kind == "consensus":
+                msg = payload
+                if isinstance(payload, (bytes, bytearray)):
+                    try:
+                        if naive:
+                            msg = _unmarshal_timed(payload)
+                        else:
+                            msg = unmarshal_interned(payload)
+                    except CodecError:
+                        self.malformed += 1
+                        PROTOCOL_PLANE.malformed_dropped += 1
+                        continue
+                run.append((sender, msg))
+            else:
+                await self._flush_consensus(run)
+                await self.consensus.handle_request(sender, payload)
+        await self._flush_consensus(run)
+        # disjoint accounting: decode time (codec_us) and view registration
+        # (vote_reg_us) accrued inside this tick are reported in their own
+        # terms — ingest_us is the drain/dispatch REMAINDER, so the four
+        # plane terms sum without double-counting
+        PROTOCOL_PLANE.ingest_us += (
+            (perf_counter() - t0) * 1e6
+            - (PROTOCOL_PLANE.codec_us - codec0)
+            - (PROTOCOL_PLANE.vote_reg_us - vote0)
+        )
+        PROTOCOL_PLANE.batch_ingests += 1
+        PROTOCOL_PLANE.msgs_ingested += len(batch)
+
+    async def _flush_consensus(self, run: list) -> None:
+        if not run:
+            return
+        c = self.consensus
+        if not self.network.naive:
+            batch_async = getattr(c, "handle_message_batch_async", None)
+            if batch_async is not None:
+                await batch_async(list(run))
+                run.clear()
+                return
+            batch_sync = getattr(c, "handle_message_batch", None)
+            if batch_sync is not None:
+                batch_sync(list(run))
+                run.clear()
+                return
+        # naive mode / injected doubles without the batch surface
+        for sender, msg in run:
+            # async intake: a backpressure-configured cluster blocks THIS
+            # node's delivery task on a full component inbox (the
+            # reference's full-channel semantics); in drop mode it behaves
+            # exactly like the sync intake
+            intake = getattr(c, "handle_message_async", None)
+            if intake is not None:
+                await intake(sender, msg)
+            else:  # injected doubles without the async surface
+                c.handle_message(sender, msg)
+        run.clear()
 
     # -- ingress -----------------------------------------------------------
 
@@ -150,10 +271,15 @@ class Node:
 
 
 class Network:
-    """The mesh (network.go:34-74)."""
+    """The mesh (network.go:34-74).
 
-    def __init__(self, seed: int = 0):
+    ``naive=True`` reverts to the pre-vectorization message plane — one
+    encode per recipient, one decode per delivery, per-message dispatch —
+    as the A/B baseline for the message-plane microbench."""
+
+    def __init__(self, seed: int = 0, naive: bool = False):
         self.nodes: dict[int, Node] = {}
+        self.naive = naive
         self.rng = random.Random(seed)
         #: (node, peer) -> loss probability the link had BEFORE partition()
         #: cut it.  heal() restores exactly these links to their prior
@@ -188,7 +314,9 @@ class Network:
         if src.muted or src._drops(target):
             return
         if src.mutate_send is not None:
-            msg = src.mutate_send(target, msg)
+            # copy-on-write: decoded messages are shared/interned objects —
+            # a mutation hook must never touch the original in place
+            msg = src.mutate_send(target, deep_copy_message(msg))
             if msg is None:
                 return
         # receiver-side faults
@@ -197,7 +325,70 @@ class Network:
         for f in dst.filters:
             if not f(msg, source):
                 return
-        dst._offer("consensus", source, msg)
+        PROTOCOL_PLANE.sends += 1
+        wire = _marshal_timed(msg) if self.naive else wire_of(msg)
+        dst._offer("consensus", source, wire)
+
+    def broadcast_consensus(self, source: int, msg: Message,
+                            targets: Optional[list[int]] = None) -> None:
+        """Encode-once fan-out to ``targets`` (default: every other node).
+
+        The canonical encoding is computed at most ONCE (memoized on the
+        frozen message instance) and the same wire bytes are enqueued at
+        all n-1 recipients; delivery decodes through the intern memo, so
+        the whole broadcast costs 1 encode + <=1 decode.  Per-link faults
+        (loss, filters) still apply per recipient, and a mutation hook
+        forces a per-target copy + re-encode for the targets it touches —
+        correctness over cheapness under fault injection."""
+        src = self.nodes.get(source)
+        if src is None:
+            return
+        PROTOCOL_PLANE.broadcasts += 1
+        if src.muted:
+            return  # outbound silence: nothing leaves, nothing encodes
+        t0 = perf_counter()
+        codec0 = PROTOCOL_PLANE.codec_us
+        wire: Optional[bytes] = None
+        if not self.naive and src.mutate_send is None:
+            wire = wire_of(msg)  # ONE encode for the whole fan-out
+        target_ids = targets if targets is not None else self.nodes
+        for target in target_ids:
+            if target == source:
+                continue
+            dst = self.nodes.get(target)
+            if dst is None:
+                continue
+            if src._drops(target):
+                continue
+            m, w = msg, wire
+            if src.mutate_send is not None:
+                # copy-on-write (see send_consensus)
+                m = src.mutate_send(target, deep_copy_message(msg))
+                if m is None:
+                    continue
+                w = None
+            if dst._drops_inbound(source):
+                continue
+            veto = False
+            for f in dst.filters:
+                if not f(m, source):
+                    veto = True
+                    break
+            if veto:
+                continue
+            if w is None:
+                if not self.naive and m == msg:
+                    w = wire_of(msg)  # hook did not change this target's copy
+                else:
+                    w = _marshal_timed(m)
+            dst._offer("consensus", source, w)
+        # disjoint accounting: the encode time spent inside this fan-out is
+        # already in codec_us — subtract it so route_us + codec_us +
+        # ingest_us + vote_reg_us sum without double-counting
+        PROTOCOL_PLANE.route_us += (
+            (perf_counter() - t0) * 1e6
+            - (PROTOCOL_PLANE.codec_us - codec0)
+        )
 
     def send_transaction(self, source: int, target: int, request: bytes) -> None:
         src = self.nodes.get(source)

@@ -10,7 +10,7 @@ working set (requests in flight) is far below the bound.
 
 from __future__ import annotations
 
-from typing import Callable, Generic, TypeVar
+from typing import Callable, Generic, Optional, TypeVar
 
 K = TypeVar("K")
 V = TypeVar("V")
@@ -29,6 +29,61 @@ class BoundedMemo(Generic[K, V]):
                 self._map.clear()
             self._map[key] = v
         return v
+
+    def __len__(self) -> int:
+        return len(self._map)
+
+
+class LruMemo(Generic[K, V]):
+    """Bounded memo with true LRU eviction and an eviction counter.
+
+    Used where an adversary CHOOSES the keys (the wire-decode intern memo,
+    the consenter sig-msg decode memo): a Byzantine flood of unique
+    messages then evicts one-by-one instead of wiping the whole working
+    set the way :class:`BoundedMemo`'s wholesale clear would — honest
+    traffic keeps hitting while garbage churns through the tail.  Eviction
+    counts are exposed (``evictions``) and mirrored into whatever counter
+    the owner wires via ``on_evict``.
+
+    Recency is maintained with dict ordering: a hit re-inserts the key at
+    the back (two dict ops), eviction pops the front.
+    """
+
+    __slots__ = ("bound", "evictions", "_map", "_on_evict")
+
+    def __init__(self, bound: int = 4096,
+                 on_evict: Optional[Callable[[], None]] = None):
+        self.bound = bound
+        self.evictions = 0
+        self._map: dict[K, V] = {}
+        self._on_evict = on_evict
+
+    def get(self, key: K) -> Optional[V]:
+        v = self._map.get(key)
+        if v is not None:
+            del self._map[key]
+            self._map[key] = v
+        return v
+
+    def put(self, key: K, value: V) -> None:
+        if key in self._map:
+            del self._map[key]
+        elif len(self._map) >= self.bound:
+            self._map.pop(next(iter(self._map)))
+            self.evictions += 1
+            if self._on_evict is not None:
+                self._on_evict()
+        self._map[key] = value
+
+    def get_or(self, key: K, compute: Callable[[], V]) -> V:
+        v = self.get(key)
+        if v is None:
+            v = compute()
+            self.put(key, v)
+        return v
+
+    def clear(self) -> None:
+        self._map.clear()
 
     def __len__(self) -> int:
         return len(self._map)
