@@ -1,0 +1,385 @@
+"""Sharded scaling sweep: aggregate device tx/s vs shard count S.
+
+The tentpole claim of sharded mode (README "Sharded mode"): S independent
+consensus groups sharing ONE verify plane multiply aggregate committed
+tx/s with S while device LAUNCH counts grow sublinearly, because launches
+carry verify items from many shards at once (cross-shard fill).  This
+sweep measures exactly that: for each S in ``--shards`` it runs a full
+S-shard cluster (n nodes per shard, pipelined windows, routed front-door
+submission) against one shared coalescer/engine and prints one JSON row
+with aggregate tx/s, launch counts, mean launch fill, the cross-shard
+wave mix, and per-shard attribution blocks; a final ``sharded_scaling``
+line compares the top S against S=1.
+
+Engine selection (``--engine``):
+
+* ``launch-cost`` (default) — a fixed-cost launch stand-in: every verify
+  launch pays the rig's measured fixed device-launch overhead (PERF.md:
+  ~110-1500 ms through the axon tunnel REGARDLESS of batch size; the
+  default ``--launch-cost 0.22`` is the round-5 measured-stable value,
+  0.11 the historical best-case floor) over a padded lane ladder, while
+  verification itself is trivial.  This models precisely the economics
+  sharding exploits — fixed launch cost, fill-dependent value — and runs
+  anywhere (CI included) in seconds.  Fill %, launch counts, and the
+  scaling ratio behave like the device engine's.
+* ``jax`` — the real batched device kernels (``--crypto p256`` signs and
+  verifies genuine signatures); the configuration for TPU rigs.
+* ``host`` — pure-Python arithmetic floor reference.
+
+Run:  python benchmarks/sharded.py [--shards 1,2,4,8] [--nodes 4]
+      [--batch 100] [--decisions 8] [--pipeline 16] [--cpu]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from smartbft_tpu.utils.jaxenv import force_cpu  # noqa: E402
+
+
+def _log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+#: per-sweep-point commit deadline (seconds); overridable for slow rigs.
+#: bench.py's subprocess timeout is derived from this (reps x points x
+#: POINT_TIMEOUT + slack) so a stuck point degrades the sweep to fewer
+#: reps instead of the parent killing the whole shard block.
+POINT_TIMEOUT = float(os.environ.get("SMARTBFT_BENCH_SHARD_POINT_TIMEOUT",
+                                     "120"))
+
+
+class LaunchCostEngine:
+    """Fixed-cost launch stand-in for the device verify engine.
+
+    Every ``verify`` call sleeps ``launch_cost`` seconds on its worker
+    thread (the coalescer launches off the event loop, exactly like the
+    real engine) and records padded-lane stats, so launch counts, fill %,
+    and the protocol's overlap behavior match the device engine while the
+    verdicts are trivially True.  The cost default is the rig's measured
+    fixed per-launch overhead (PERF.md: ~110 ms through the tunnel,
+    independent of batch size) — which is the entire economic premise of
+    cross-shard coalescing."""
+
+    preferred_coalesce_window = 0.02
+
+    def __init__(self, launch_cost: float = 0.11,
+                 pad_sizes=(8, 32, 128, 512, 2048, 8192)):
+        from smartbft_tpu.crypto.provider import VerifyStats
+
+        self.launch_cost = launch_cost
+        self.pad_sizes = tuple(sorted(pad_sizes))
+        self.stats = VerifyStats()
+        self.scheme = None
+        self._lock = threading.Lock()
+
+    def _pad_to(self, n: int) -> int:
+        for s in self.pad_sizes:
+            if n <= s:
+                return s
+        return self.pad_sizes[-1]
+
+    def verify(self, items) -> list:
+        t0 = time.perf_counter()
+        time.sleep(self.launch_cost)
+        n = len(items)
+        with self._lock:
+            self.stats.record(n, self._pad_to(n), time.perf_counter() - t0)
+        return [True] * n
+
+
+def build_cluster(tmp, *, shards, nodes, depth, batch, requests,
+                  engine_kind, crypto, window, launch_cost, pad_sizes):
+    import dataclasses
+
+    from smartbft_tpu.testing.sharded import ShardedCluster, sharded_config
+
+    def cfg(s, i):
+        return dataclasses.replace(
+            sharded_config(i, depth=depth),
+            wal_group_commit=True,  # production durability path
+            request_batch_max_count=batch,
+            request_batch_max_interval=0.02,
+            request_pool_size=max(2 * requests, 800),
+            incoming_message_buffer_size=max(2000, 40 * nodes),
+            request_forward_timeout=300.0,
+            request_complain_timeout=600.0,
+            request_auto_remove_timeout=1200.0,
+            view_change_resend_interval=300.0,
+            view_change_timeout=1200.0,
+            leader_heartbeat_timeout=900.0,
+        )
+
+    if engine_kind == "launch-cost":
+        cluster = ShardedCluster(
+            tmp, shards=shards, n=nodes, depth=depth, crypto="trivial",
+            window=window, config_fn=cfg, seed=13,
+        )
+        # swap the always-valid host engine for the fixed-cost launcher —
+        # same trivial verdicts, device-shaped launch economics
+        engine = LaunchCostEngine(launch_cost=launch_cost,
+                                  pad_sizes=pad_sizes)
+        cluster.engine = engine
+        cluster.coalescer.engine = engine
+        return cluster
+    if engine_kind in ("jax", "host"):
+        from smartbft_tpu.crypto import ed25519, p256
+        from smartbft_tpu.crypto.provider import HostVerifyEngine, JaxVerifyEngine
+
+        scheme = {"p256": p256, "ed25519": ed25519}[crypto]
+        engine = JaxVerifyEngine(pad_sizes=pad_sizes, scheme=scheme) \
+            if engine_kind == "jax" else HostVerifyEngine(scheme=scheme)
+        return ShardedCluster(
+            tmp, shards=shards, n=nodes, depth=depth, crypto=crypto,
+            engine=engine, window=window, config_fn=cfg, seed=13,
+        )
+    raise ValueError(f"unknown engine {engine_kind}")
+
+
+async def run_sweep_point(S: int, args, pad_sizes) -> dict:
+    from smartbft_tpu.utils.clock import WallClockDriver
+
+    requests_per_shard = args.decisions * args.batch
+    tmp = tempfile.mkdtemp(prefix=f"bench-sharded-{S}-")
+    cluster = build_cluster(
+        tmp, shards=S, nodes=args.nodes, depth=args.pipeline,
+        batch=args.batch, requests=requests_per_shard,
+        engine_kind=args.engine, crypto=args.crypto, window=args.window,
+        launch_cost=args.launch_cost, pad_sizes=pad_sizes,
+    )
+    engine = cluster.engine
+    if args.engine == "jax":
+        # pre-warm every ring's keys + every lane shape so no XLA compile
+        # lands inside the timed window (mirrors benchmarks/throughput.py)
+        from smartbft_tpu.crypto.provider import VerifyStats
+
+        scheme = engine.scheme
+        sk, pub = scheme.keygen(b"shard-0-1")
+        item = scheme.make_item(b"warm", scheme.sign_raw(sk, b"warm"), pub)
+        if hasattr(engine, "prewarm_keys"):
+            for ring in cluster._rings.values():
+                engine.prewarm_keys(ring[1].public_keys.values())
+        t0 = time.perf_counter()
+        for size in pad_sizes:
+            engine.verify([item] * size)
+        _log(f"sharded[{S}]: pre-warmed {tuple(pad_sizes)} in "
+             f"{time.perf_counter() - t0:.1f}s")
+        engine.stats = VerifyStats()
+    # warm-launch probe, same contract as throughput.py rows (for the
+    # launch-cost engine the probe IS the configured cost, by construction)
+    if args.engine == "launch-cost":
+        launch_probe_ms = args.launch_cost * 1e3
+    else:
+        from smartbft_tpu.crypto.provider import VerifyStats
+
+        scheme = engine.scheme
+        sk, pub = scheme.keygen(b"probe")
+        item = scheme.make_item(b"p", scheme.sign_raw(sk, b"p"), pub)
+        engine.verify([item])
+        t0 = time.perf_counter()
+        for _ in range(3):
+            engine.verify([item])
+        launch_probe_ms = 1e3 * (time.perf_counter() - t0) / 3
+        engine.stats = VerifyStats()
+
+    driver = WallClockDriver(cluster.scheduler, tick_interval=0.01)
+    try:
+        driver.start()
+        await cluster.start()
+        plane_bases = {
+            sh.shard_id: sh.plane.snapshot() for sh in cluster.shard_list
+        }
+        target = requests_per_shard
+        # resolve the routed client ids once — id-space scanning is load
+        # GENERATION, not the system under test
+        for s in range(S):
+            cluster.client_for_shard(s, 3)
+        t0 = time.perf_counter()
+        # decision-major interleave: all shards' load arrives together, so
+        # their quorum waves are in phase — the deployment shape (many
+        # front-door clients, one process), not S sequential bursts
+        for j in range(args.decisions):
+            for s in range(S):
+                for k in range(args.batch):
+                    cid = cluster.client_for_shard(s, (j + k) % 4)
+                    await cluster.submit(cid, f"r-{s}-{j}-{k}")
+        # per-point salvage deadline: generous (healthy points take ~1-2 s
+        # on this rig) yet small enough that a stuck rep only costs ITS
+        # slot — bench.py sizes its whole-sweep subprocess timeout as
+        # reps x points x this + slack, so the sweep degrades to fewer
+        # reps instead of the parent killing the whole shard block
+        deadline = time.perf_counter() + POINT_TIMEOUT
+        while time.perf_counter() < deadline:
+            if all(sh.committed() >= target for sh in cluster.shard_list):
+                break
+            await asyncio.sleep(0.02)
+        else:
+            raise TimeoutError(
+                f"S={S}: shards committed "
+                f"{[sh.committed() for sh in cluster.shard_list]} "
+                f"of {target} in time"
+            )
+        elapsed = time.perf_counter() - t0
+        cluster.check_invariants()
+
+        stats = engine.stats
+        total_committed = sum(sh.committed() for sh in cluster.shard_list)
+        decisions = sum(sh.height() for sh in cluster.shard_list)
+        shard_block = cluster.stats_block()
+        # overwrite the harness's cumulative plane blocks with the timed
+        # window's deltas
+        from smartbft_tpu.metrics import ProtocolPlaneTimers
+
+        for sh in cluster.shard_list:
+            shard_block["per_shard"][sh.shard_id]["plane"] = \
+                ProtocolPlaneTimers.delta(
+                    plane_bases[sh.shard_id], sh.plane.snapshot()
+                )
+        shard_block["aggregate"]["plane"] = ProtocolPlaneTimers.sum_snapshots(
+            [shard_block["per_shard"][s]["plane"] for s in range(S)]
+        )
+        return {
+            "shards": S,
+            "engine": args.engine,
+            "crypto": args.crypto if args.engine != "launch-cost" else "trivial",
+            "nodes_per_shard": args.nodes,
+            "pipeline": args.pipeline,
+            "batch": args.batch,
+            "decisions_per_shard": args.decisions,
+            "tx_per_sec": round(total_committed / elapsed, 1),
+            "tx_per_sec_per_shard": round(total_committed / elapsed / S, 1),
+            "decisions": decisions,
+            "launches": stats.launches,
+            "launches_per_decision": round(stats.launches / decisions, 3)
+            if decisions else 0.0,
+            "batch_fill_pct": round(stats.batch_fill_pct, 1),
+            "items_per_launch": round(
+                stats.sigs_verified / stats.launches, 1
+            ) if stats.launches else 0.0,
+            "sigs_verified": stats.sigs_verified,
+            "launch_probe_ms": round(launch_probe_ms, 2),
+            "elapsed_s": round(elapsed, 2),
+            "mixed_waves": shard_block["aggregate"]["coalescer"]["mixed_waves"],
+            "shard": shard_block,
+        }
+    finally:
+        try:
+            await cluster.stop()
+        except Exception:
+            pass
+        await driver.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--shards", default="1,2,4,8",
+                    help="comma-separated shard counts to sweep")
+    ap.add_argument("--nodes", type=int, default=4, help="replicas per shard")
+    ap.add_argument("--batch", type=int, default=50)
+    ap.add_argument("--decisions", type=int, default=12,
+                    help="decisions committed per shard per point")
+    ap.add_argument("--pipeline", type=int, default=2)
+    ap.add_argument("--engine", choices=("launch-cost", "jax", "host"),
+                    default="launch-cost")
+    ap.add_argument("--crypto", choices=("p256", "ed25519"), default="p256",
+                    help="signature scheme for --engine jax/host")
+    ap.add_argument("--launch-cost", type=float, default=0.22,
+                    help="fixed per-launch seconds for --engine launch-cost "
+                         "(default: the rig's round-5 MEASURED-STABLE launch "
+                         "overhead, 220 ms — PERF.md; the historical "
+                         "best-case floor is 0.11)")
+    ap.add_argument("--window", type=float, default=0.05,
+                    help="coalescer fan-in window (seconds)")
+    ap.add_argument("--pad-sizes", default="auto",
+                    help="engine lane ladder; auto = a device-profitable "
+                         "ladder (1024..8192) for launch-cost — small waves "
+                         "underfill it, which IS the single-chain problem — "
+                         "and the production small-rung ladder for jax/host")
+    ap.add_argument("--reps", type=int, default=3,
+                    help="repetitions per sweep point; the BEST-tx row is "
+                         "reported with every rep's tx/s listed alongside "
+                         "(host contention on a shared rig swings single "
+                         "shots 2-3x — far more than the effect size — so "
+                         "the sweep measures capability, not weather; same "
+                         "rationale as bench.py's best-of-3 CPU baseline)")
+    ap.add_argument("--cpu", action="store_true",
+                    help="pin JAX to the CPU backend")
+    args = ap.parse_args()
+
+    if args.cpu or os.environ.get("SMARTBFT_BENCH_CPU") == "1":
+        force_cpu()
+    if args.pad_sizes == "auto":
+        pad_sizes = (1024, 2048, 4096, 8192) \
+            if args.engine == "launch-cost" else (8, 32, 128, 512)
+    else:
+        pad_sizes = tuple(int(x) for x in args.pad_sizes.split(","))
+    sweep = [int(x) for x in args.shards.split(",")]
+
+    # reps are INTERLEAVED across sweep points (rep 0 of every S, then rep
+    # 1 of every S, ...) so a minutes-long host-contention episode degrades
+    # every point roughly equally instead of wiping out one S's whole
+    # sample — the cross-S ratios are what the sweep exists to measure
+    reps_by_s: dict = {S: [] for S in sweep}
+    for rep in range(max(1, args.reps)):
+        for S in sweep:
+            try:
+                reps_by_s[S].append(
+                    asyncio.run(run_sweep_point(S, args, pad_sizes))
+                )
+            except Exception as exc:  # noqa: BLE001 — a failed rep (stuck
+                # point, invariant trip, engine error) costs ITS slot only;
+                # the sweep degrades to fewer reps and still prints rows
+                _log(f"sharded[{S}] rep {rep}: FAILED — {exc!r}")
+    rows = []
+    for S in sweep:
+        reps = reps_by_s[S]
+        if not reps:
+            continue
+        reps.sort(key=lambda r: r["tx_per_sec"])
+        row = dict(reps[-1],
+                   reps=len(reps),
+                   tx_per_sec_reps=[r["tx_per_sec"] for r in reps])
+        _log(f"sharded[{S}]: {row['tx_per_sec']} tx/s (best of "
+             f"{row['tx_per_sec_reps']}), {row['launches']} launches, "
+             f"fill {row['batch_fill_pct']}%, mixed_waves {row['mixed_waves']}")
+        print(json.dumps(row), flush=True)
+        rows.append(row)
+
+    by_s = {r["shards"]: r for r in rows}
+    if 1 in by_s and len(by_s) >= 2:
+        top = max(by_s)
+        base, peak = by_s[1], by_s[top]
+        line = {
+            "metric": "sharded_scaling",
+            "value": round(peak["tx_per_sec"] / base["tx_per_sec"], 3)
+            if base["tx_per_sec"] else 0.0,
+            "unit": f"x aggregate tx/s at S={top} vs S=1",
+            "s1_tx_per_sec": base["tx_per_sec"],
+            f"s{top}_tx_per_sec": peak["tx_per_sec"],
+            "launch_growth": round(peak["launches"] / base["launches"], 3)
+            if base["launches"] else 0.0,
+            "fill_s1_pct": base["batch_fill_pct"],
+            f"fill_s{top}_pct": peak["batch_fill_pct"],
+            "mixed_waves_at_top": peak["mixed_waves"],
+        }
+        if 4 in by_s and top != 4:
+            # the acceptance bar names S=4 explicitly — always surface it
+            line["s4_vs_s1"] = round(
+                by_s[4]["tx_per_sec"] / base["tx_per_sec"], 3
+            ) if base["tx_per_sec"] else 0.0
+        print(json.dumps(line), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -16,6 +16,8 @@ Layering (top-down, mirrors SURVEY.md §1):
   wal                  — durable segmented log
   crypto + ops         — TPU batch Signer/Verifier (the point of the project)
   parallel             — device-mesh sharding for the verify kernels
+  shard                — S consensus groups over one shared verify plane
+                         (router / delivery mux / ShardSet front door)
   testing              — in-process fault-injection network harness
 """
 

@@ -339,6 +339,15 @@ class Consensus:
             raise RuntimeError("no leader")
         await self.controller.submit_request(req)
 
+    def pool_occupancy(self) -> dict:
+        """This node's request-pool backpressure snapshot (empty before
+        start).  The sharded front door (shard.ShardSet) reads this from
+        each shard's submit target to expose one combined submit/
+        backpressure surface over the per-shard pools."""
+        if self.pool is None:
+            return {}
+        return self.pool.occupancy()
+
     # ------------------------------------------------------------------ wiring
 
     def validate_configuration(self, nodes: list[int]) -> None:

@@ -111,7 +111,7 @@ from ..types import (
     cached_view_metadata,
     proposal_digest,
 )
-from ..metrics import PROTOCOL_PLANE
+from ..metrics import current_plane
 from .rotation import RotationState
 from .state import ABORT, COMMITTED, PREPARED, PROPOSED
 from .util import SignerIndex, VoteSet, compute_quorum, iter_bits
@@ -411,7 +411,7 @@ class WindowedView:
                 self.number, e,
             )
             self._stop()
-        PROTOCOL_PLANE.vote_reg_us += (time.perf_counter() - t0) * 1e6
+        current_plane().vote_reg_us += (time.perf_counter() - t0) * 1e6
         self._work.set()
 
     # ------------------------------------------------------------------ windows

@@ -218,6 +218,21 @@ class Pool:
     def size_bytes(self) -> int:
         return self._size_bytes
 
+    def occupancy(self) -> dict:
+        """One JSON-able backpressure snapshot — the per-shard building
+        block of the sharded front door's combined occupancy surface
+        (shard.ShardSet.occupancy sums these across shards).  ``free`` is
+        how many submits can land before :meth:`submit` starts waiting;
+        ``waiters`` is how many submitters are ALREADY parked on space."""
+        return {
+            "size": len(self._items),
+            "bytes": self._size_bytes,
+            "capacity": self._opts.queue_size,
+            "free": max(0, self._opts.queue_size - len(self._items)),
+            "in_flight": len(self._in_flight),
+            "waiters": len(self._space_waiters),
+        }
+
     def next_requests(
         self, max_count: int, max_size_bytes: int, check: bool
     ) -> tuple[list[bytes], bool]:

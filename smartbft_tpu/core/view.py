@@ -45,7 +45,7 @@ from ..messages import (
 )
 from ..metrics import BlacklistMetrics, ViewMetrics
 from ..types import VerifyPlaneDown, proposal_digest
-from ..metrics import PROTOCOL_PLANE
+from ..metrics import PROTOCOL_PLANE, current_plane
 from .rotation import RotationState
 from .state import ABORT, COMMITTED, PREPARED, PROPOSED
 from .util import SignerIndex, VoteSet, compute_quorum, iter_bits
@@ -237,6 +237,11 @@ class View:
         )
         self._dropped_msgs = 0  # overflow counter for the bounded inbox
         self._aborted = False
+        # the per-shard accounting plane captured at intake: _drain_inbox
+        # runs in the view's OWN task (whose context predates any transport
+        # dispatch), so the drain must use the plane the transport installed
+        # when it fed the inbox, not current_plane() at drain time
+        self._vote_plane = None
         self._task: Optional[asyncio.Task] = None
         # 1-slot pre-prepare stashes (view.go:105-111)
         self._pre_prepare: Optional[PrePrepare] = None
@@ -316,6 +321,7 @@ class View:
         overflow so a Byzantine flooder cannot grow memory without limit."""
         if self._aborted:
             return
+        self._note_intake_plane()
         if self._inbox.qsize() >= self.in_msg_q_size:
             self._dropped_msgs += 1
             if self._dropped_msgs == 1 or self._dropped_msgs % 1000 == 0:
@@ -336,6 +342,7 @@ class View:
             return
         if self._aborted:
             return
+        self._note_intake_plane()
         await self._inbox.put((sender, msg))
 
     def ingest_batch(self, items) -> None:
@@ -345,6 +352,16 @@ class View:
         the rest without further awaits."""
         for sender, msg in items:
             self.handle_message(sender, msg)
+
+    def _note_intake_plane(self) -> None:
+        """Latch the transport's per-shard plane the first time one feeds
+        this inbox.  A view belongs to exactly one group, so the capture is
+        stable; loopback/self-deliveries (default-plane contexts) never
+        overwrite it."""
+        if self._vote_plane is None:
+            p = current_plane()
+            if p is not PROTOCOL_PLANE:
+                self._vote_plane = p
 
     async def ingest_batch_async(self, items) -> None:
         """Backpressure-aware wave intake (blocks per message on a full
@@ -425,7 +442,10 @@ class View:
                 self._process_msg(sender, msg)
         finally:
             if drained:
-                PROTOCOL_PLANE.vote_reg_us += (time.perf_counter() - t0) * 1e6
+                plane = self._vote_plane
+                if plane is None:
+                    plane = current_plane()
+                plane.vote_reg_us += (time.perf_counter() - t0) * 1e6
 
     # ------------------------------------------------------------------ routing
 

@@ -31,7 +31,7 @@ import os
 import random
 import threading
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Optional, Sequence
 
 import numpy as np
@@ -168,6 +168,71 @@ class VerifyFaultPolicy:
             breaker_threshold=config.verify_breaker_threshold,
             probe_interval=config.verify_probe_interval,
         )
+
+
+@dataclass
+class TagStats:
+    """Per-tag (per-shard) attribution of coalesced verify traffic."""
+
+    items: int = 0       # verify items this tag submitted
+    waves: int = 0       # flushes containing >=1 of this tag's items
+    solo_waves: int = 0  # flushes containing ONLY this tag's items
+
+
+@dataclass
+class ShardAttribution:
+    """Wave-composition accounting for a shared coalescer.
+
+    The sharded deployment's whole point is that one device launch carries
+    verify items from MANY consensus groups (cross-shard fill); these
+    counters make that measured instead of asserted.  Tags are opaque
+    (shard ids in practice); untagged submissions are legal and only
+    counted in ``waves``.  Updated at flush time — when the wave's
+    composition is fixed — so failed launches still attribute."""
+
+    waves: int = 0          # coalesced flushes total
+    tagged_waves: int = 0   # flushes with >=1 tagged submission
+    mixed_waves: int = 0    # flushes mixing >=2 distinct tags — the
+    #                         cross-shard-coalescing witness
+    max_tags_in_wave: int = 0
+    per_tag: dict = field(default_factory=dict)
+
+    def note_wave(self, futures) -> None:
+        self.waves += 1
+        counts: dict = {}
+        for entry in futures:
+            _fut, _start, n, tag = entry
+            if tag is None:
+                continue
+            counts[tag] = counts.get(tag, 0) + n
+        if not counts:
+            return
+        self.tagged_waves += 1
+        if len(counts) >= 2:
+            self.mixed_waves += 1
+        self.max_tags_in_wave = max(self.max_tags_in_wave, len(counts))
+        for tag, n in counts.items():
+            st = self.per_tag.get(tag)
+            if st is None:
+                st = self.per_tag[tag] = TagStats()
+            st.items += n
+            st.waves += 1
+            if len(counts) == 1:
+                st.solo_waves += 1
+
+    def snapshot(self) -> dict:
+        """JSON-able block for bench rows and the tier-1 coalescing gate."""
+        return {
+            "waves": self.waves,
+            "tagged_waves": self.tagged_waves,
+            "mixed_waves": self.mixed_waves,
+            "max_tags_in_wave": self.max_tags_in_wave,
+            "per_tag": {
+                str(tag): {"items": st.items, "waves": st.waves,
+                           "solo_waves": st.solo_waves}
+                for tag, st in sorted(self.per_tag.items(), key=lambda kv: str(kv[0]))
+            },
+        }
 
 
 @dataclass
@@ -483,8 +548,9 @@ class AsyncBatchCoalescer:
         if metrics is not None:
             metrics.breaker_state.set(0.0)  # healthy until proven otherwise
         self.fault_stats = VerifyFaultStats()
+        self.shard_stats = ShardAttribution()
         self._pending: list[tuple] = []
-        self._futures: list[tuple[asyncio.Future, int, int]] = []
+        self._futures: list[tuple[asyncio.Future, int, int, object]] = []
         self._flush_scheduled = False
         self._launch_inflight = False
         self._lock = asyncio.Lock()
@@ -542,7 +608,14 @@ class AsyncBatchCoalescer:
             "abandoned_late_arrivals": s.abandoned_late_arrivals,
         }
 
-    async def submit(self, items) -> list[bool]:
+    def shard_snapshot(self) -> dict:
+        """Wave-composition attribution (see :class:`ShardAttribution`)."""
+        return self.shard_stats.snapshot()
+
+    async def submit(self, items, tag=None) -> list[bool]:
+        """``tag``: opaque attribution label (the submitter's shard id in
+        sharded mode) — flush composition is tracked per tag in
+        :attr:`shard_stats`, so cross-shard launch mixing is measurable."""
         if not items:
             return []
         loop = asyncio.get_running_loop()
@@ -550,7 +623,7 @@ class AsyncBatchCoalescer:
         async with self._lock:
             start = len(self._pending)
             self._pending.extend(items)
-            self._futures.append((fut, start, len(items)))
+            self._futures.append((fut, start, len(items), tag))
             # _flush_scheduled covers exactly the CURRENT batch: it resets
             # when a flush swaps the batch out.  While a launch is already
             # in flight nothing is scheduled here — completion-triggered
@@ -590,18 +663,21 @@ class AsyncBatchCoalescer:
                 self._launch_inflight = True
         if not pending:
             return
+        # attribution happens when the wave's composition is fixed, so a
+        # failed launch still counts its shard mix
+        self.shard_stats.note_wave(futures)
         try:
             results = await self._launch_wave(pending)
         except Exception as exc:
             err = exc if isinstance(exc, VerifyPlaneDown) else RuntimeError(
                 f"batch verify failed: {exc!r}"
             )
-            for fut, _, _ in futures:
+            for fut, _, _, _ in futures:
                 if not fut.done():
                     fut.set_exception(err)
             await self._launch_done()
             return
-        for fut, start, count in futures:
+        for fut, start, count, _tag in futures:
             if not fut.done():
                 fut.set_result(results[start : start + count])
         await self._launch_done()
@@ -925,6 +1001,12 @@ class CryptoProvider:
         host engines keep the legacy single-attempt contract unless a
         policy is supplied (or wired later by the Consensus facade)."""
         self.keyring = keyring
+        #: opaque attribution tag (the shard id in sharded deployments) —
+        #: every coalesced submission from this provider carries it, so a
+        #: shared coalescer can report per-shard items and cross-shard
+        #: launch mixing (ShardAttribution).  Settable post-construction;
+        #: None = untagged (single-group deployments).
+        self.verify_tag: Optional[object] = None
         # LRU-bounded with an eviction counter: the keys are adversary-
         # chosen wire bytes, so a Byzantine flood of unique sig messages
         # churns the tail one entry at a time instead of wiping the honest
@@ -1077,7 +1159,7 @@ class CryptoProvider:
         return self.engine.verify(items)
 
     async def _verify_items_async(self, items) -> list[bool]:
-        return await self._coalescer.submit(items)
+        return await self._coalescer.submit(items, tag=self.verify_tag)
 
     def _collect(self, signatures: Sequence[Signature], proposal: Proposal):
         auxes: list[Optional[bytes]] = []
@@ -1281,9 +1363,11 @@ class BlsCryptoProvider(CryptoProvider):
         """Aggregate path with coalescing: the single aggregated lane joins
         other in-flight quorums in one shared kernel launch."""
         lane = self._aggregate_lane(items)
-        if lane is not None and (await self._coalescer.submit([lane]))[0]:
+        if lane is not None and (
+            await self._coalescer.submit([lane], tag=self.verify_tag)
+        )[0]:
             return [True] * len(items)
-        return await self._coalescer.submit(items)
+        return await self._coalescer.submit(items, tag=self.verify_tag)
 
     def verify_consenter_sigs_batch(
         self, signatures: Sequence[Signature], proposal: Proposal
@@ -1311,12 +1395,12 @@ class BlsCryptoProvider(CryptoProvider):
                                     await self._verify_items_async(items))
         lane, chosen, rest = split
         results = await self._coalescer.submit(
-            [lane] + [items[p] for p in rest]
+            [lane] + [items[p] for p in rest], tag=self.verify_tag
         )
         chosen_results = None
         if not results[0]:
             chosen_results = await self._coalescer.submit(
-                [items[p] for p in chosen]
+                [items[p] for p in chosen], tag=self.verify_tag
             )
         mask = self._merge_split_verdicts(split, results, chosen_results, len(items))
         return self._apply_mask(auxes, idxs, mask)

@@ -272,25 +272,30 @@ _INTERN: LruMemo[bytes, object] = LruMemo(
 )
 
 
-def wire_of(msg) -> bytes:
+def wire_of(msg, plane=None) -> bytes:
     """Canonical tagged encoding, memoized on the (frozen) instance.
 
     The memo makes "exactly one encode per broadcast" a structural
     invariant: the fan-out loop, re-broadcasts after view restarts, and
-    lagging-replica assist resends all reuse the first encoding."""
+    lagging-replica assist resends all reuse the first encoding.
+
+    ``plane``: the :class:`~smartbft_tpu.metrics.ProtocolPlaneTimers` the
+    codec cost is attributed to — per-shard planes in sharded mode; the
+    process default otherwise."""
+    plane = _PLANE if plane is None else plane
     w = getattr(msg, _WIRE_MEMO_ATTR, None)
     if w is None:
         t0 = _perf_counter()
         w = encode_tagged(msg)
-        _PLANE.codec_us += (_perf_counter() - t0) * 1e6
-        _PLANE.encodes += 1
+        plane.codec_us += (_perf_counter() - t0) * 1e6
+        plane.encodes += 1
         object.__setattr__(msg, _WIRE_MEMO_ATTR, w)
     else:
-        _PLANE.encode_memo_hits += 1
+        plane.encode_memo_hits += 1
     return w
 
 
-def unmarshal_interned(data: bytes):
+def unmarshal_interned(data: bytes, plane=None):
     """Tagged decode through the bounded intern memo.
 
     All recipients of one broadcast receive byte-identical wire payloads,
@@ -298,15 +303,18 @@ def unmarshal_interned(data: bytes):
     returning the SAME frozen message object — receivers must treat it as
     immutable.  The memo is LRU-bounded (eviction counted in
     ``metrics.PROTOCOL_PLANE.intern_evictions``), so unique-message floods
-    cannot grow memory."""
+    cannot grow memory.  ``plane``: see :func:`wire_of` — the intern memo
+    itself stays process-wide (it is keyed by wire bytes, which cannot
+    collide across shards), only the accounting is attributed."""
+    plane = _PLANE if plane is None else plane
     msg = _INTERN.get(data)
     if msg is not None:
-        _PLANE.decode_interned_hits += 1
+        plane.decode_interned_hits += 1
         return msg
     t0 = _perf_counter()
     msg = decode_tagged(data)
-    _PLANE.codec_us += (_perf_counter() - t0) * 1e6
-    _PLANE.decodes += 1
+    plane.codec_us += (_perf_counter() - t0) * 1e6
+    plane.decodes += 1
     # the decoded object already knows its own encoding — assists and
     # forwards of an ingested message re-send without re-encoding
     object.__setattr__(msg, _WIRE_MEMO_ATTR, data)

@@ -13,7 +13,9 @@ The in-memory provider doubles as the benchmark introspection surface.
 from __future__ import annotations
 
 import abc
+import contextvars
 import threading
+import weakref
 from dataclasses import dataclass, field
 from typing import Optional, Sequence
 
@@ -468,7 +470,15 @@ class ViewChangeMetrics:
 
 
 class TPUCryptoMetrics:
-    """TPU-plane additions (BASELINE.json): batch occupancy + verify latency."""
+    """TPU-plane additions (BASELINE.json): batch occupancy + verify latency.
+
+    PER-INSTANCE by construction (one bundle per provider) — nothing here
+    is process-global, so counters from colocated shards/nodes never smear
+    unless the embedder deliberately shares one provider.  The sharded
+    harness DOES share one (the verify plane is one coalescer, so its
+    fill/latency/breaker counters are inherently whole-plane); an embedder
+    that instead builds per-shard providers reads the roll-up with
+    :func:`tpu_counters_aggregate`."""
 
     def __init__(self, p: Provider):
         self.batch_fill_percent = _h(p, "tpu", "batch_fill_percent")
@@ -490,6 +500,29 @@ class TPUCryptoMetrics:
         #: 1.0 while the host-fallback circuit breaker is open (degraded
         #: mode: waves verify on CPU), 0.0 when the device engine serves
         self.breaker_state = _g(p, "tpu", "verify_breaker_open")
+
+
+def tpu_counters_aggregate(providers: Sequence[InMemoryProvider]) -> dict:
+    """Explicit aggregate view over per-shard TPU metric providers.
+
+    Sums every ``.tpu.`` counter across the given
+    :class:`InMemoryProvider` instances; gauges sum too (a 0/1 gauge like
+    ``verify_breaker_open`` aggregates to "how many providers are
+    degraded"); histograms contribute their observation counts under
+    ``<name>_count``.  For an embedder that gives each shard its own
+    provider, this is the one-call roll-up (the in-process harness instead
+    shares one provider across the shared plane — see
+    :class:`TPUCryptoMetrics`)."""
+    out: dict = {}
+    for p in providers:
+        for store in (p.counters, p.gauges):
+            for key, val in store.items():
+                if ".tpu." in key:
+                    out[key] = out.get(key, 0.0) + val
+        for key, vals in p.histograms.items():
+            if ".tpu." in key:
+                out[key + "_count"] = out.get(key + "_count", 0.0) + len(vals)
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -519,17 +552,42 @@ class ProtocolPlaneTimers:
     checks + enqueue; ``ingest_us`` is the receiver-side drain/dispatch
     remainder; ``codec_us`` covers every marshal/unmarshal wherever it
     runs; ``vote_reg_us`` is view-level wave registration.)
+
+    **Per-instance attribution (sharded mode).**  Timers are PER-INSTANCE:
+    every constructed ``ProtocolPlaneTimers`` joins a process-wide
+    registry, and :func:`protocol_plane_snapshot` returns the AGGREGATE
+    across all instances — so embedders that only ever touch the default
+    :data:`PROTOCOL_PLANE` singleton see exactly the old behavior, while a
+    sharded deployment hands each consensus group its own plane (via
+    ``testing.network.Network.group(gid, plane=...)``) and can attribute
+    message-plane cost per shard AND still read the whole-process
+    aggregate from the same back-compat function.
     """
 
     __slots__ = (
+        "name", "__weakref__",
         "ingest_us", "route_us", "vote_reg_us", "codec_us",
         "broadcasts", "sends", "encodes", "encode_memo_hits",
         "decodes", "decode_interned_hits", "intern_evictions",
         "batch_ingests", "msgs_ingested", "malformed_dropped",
     )
 
-    def __init__(self) -> None:
+    #: process-wide registry of every live plane — the aggregate view.
+    #: Weak references: a plane lives exactly as long as its owner (a
+    #: Network/cluster holds a strong ref), so long-lived processes that
+    #: build many clusters (benches, soaks) neither grow the registry
+    #: without bound nor smear dead clusters' counters into the aggregate.
+    _registry: "list[weakref.ref[ProtocolPlaneTimers]]" = []
+    _registry_lock = threading.Lock()
+
+    #: slots that carry measurement (everything except the identity field)
+    _COUNTER_SLOTS: tuple[str, ...] = ()
+
+    def __init__(self, name: str = "") -> None:
+        self.name = name
         self.reset()
+        with ProtocolPlaneTimers._registry_lock:
+            ProtocolPlaneTimers._registry.append(weakref.ref(self))
 
     def reset(self) -> None:
         self.ingest_us = 0.0    # node batch-drain -> dispatch, total
@@ -548,7 +606,8 @@ class ProtocolPlaneTimers:
         self.malformed_dropped = 0    # undecodable wire payloads dropped
 
     def snapshot(self) -> dict:
-        return {name: getattr(self, name) for name in self.__slots__}
+        return {name: getattr(self, name)
+                for name in ProtocolPlaneTimers._COUNTER_SLOTS}
 
     @staticmethod
     def delta(before: dict, after: dict) -> dict:
@@ -558,14 +617,83 @@ class ProtocolPlaneTimers:
             for k in after
         }
 
+    @staticmethod
+    def sum_snapshots(snapshots: Sequence[dict]) -> dict:
+        """Element-wise sum — the aggregate view over per-shard planes."""
+        out: dict = {
+            k: 0.0 if k.endswith("_us") else 0
+            for k in ProtocolPlaneTimers._COUNTER_SLOTS
+        }
+        for snap in snapshots:
+            for k, v in snap.items():
+                out[k] = out.get(k, 0) + v
+        return {k: round(v, 1) if isinstance(v, float) else v
+                for k, v in out.items()}
 
-#: the process-wide instance the message plane feeds (one in-process
-#: cluster = one plane, which is exactly the deployment the bench measures)
-PROTOCOL_PLANE = ProtocolPlaneTimers()
+
+ProtocolPlaneTimers._COUNTER_SLOTS = tuple(
+    s for s in ProtocolPlaneTimers.__slots__
+    if s not in ("name", "__weakref__")
+)
+
+
+#: the process-wide DEFAULT instance — what every accounting site feeds
+#: unless the embedder wired a per-instance plane (one in-process cluster
+#: = one plane, the single-group deployment the original benches measure)
+PROTOCOL_PLANE = ProtocolPlaneTimers(name="default")
+
+
+def protocol_plane_instances() -> "list[ProtocolPlaneTimers]":
+    """Every live plane (default singleton first) — per-shard attribution.
+    Dead weakrefs (planes whose owning cluster was collected) are pruned."""
+    with ProtocolPlaneTimers._registry_lock:
+        alive: list = []
+        out: list = []
+        for ref in ProtocolPlaneTimers._registry:
+            plane = ref()
+            if plane is not None:
+                alive.append(ref)
+                out.append(plane)
+        ProtocolPlaneTimers._registry[:] = alive
+        return out
 
 
 def protocol_plane_snapshot() -> dict:
-    return PROTOCOL_PLANE.snapshot()
+    """AGGREGATE snapshot across every plane instance in the process.
+
+    Back-compat contract: when only the default :data:`PROTOCOL_PLANE`
+    exists (every pre-sharding embedder), this is exactly its snapshot;
+    with per-shard planes wired it is their element-wise sum, so existing
+    bench/JSON consumers keep reading whole-process numbers."""
+    return ProtocolPlaneTimers.sum_snapshots(
+        [p.snapshot() for p in protocol_plane_instances()]
+    )
+
+
+#: task-context plane installed by the transport around an ingest dispatch,
+#: so accounting sites deep in the protocol core (view/pipeline vote
+#: registration) attribute to the right shard without plumbing a plane
+#: through every constructor.  None = use the process default.
+_CURRENT_PLANE: "contextvars.ContextVar[Optional[ProtocolPlaneTimers]]" = (
+    contextvars.ContextVar("smartbft_protocol_plane", default=None)
+)
+
+
+def current_plane() -> ProtocolPlaneTimers:
+    """The plane the calling context should feed: the per-shard plane the
+    transport installed for this dispatch, or the process default."""
+    p = _CURRENT_PLANE.get()
+    return PROTOCOL_PLANE if p is None else p
+
+
+def install_plane(plane: Optional[ProtocolPlaneTimers]):
+    """Install ``plane`` as this context's accounting target (the network
+    wraps each ingest dispatch); returns the token for :func:`reset_plane`."""
+    return _CURRENT_PLANE.set(plane)
+
+
+def reset_plane(token) -> None:
+    _CURRENT_PLANE.reset(token)
 
 
 class MetricsBundle:

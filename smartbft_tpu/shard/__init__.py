@@ -1,0 +1,29 @@
+"""Sharded consensus groups over one shared TPU verify plane.
+
+S independent consensus groups ("shards") run in one process behind a
+single client-facing front door; their prepare/commit verify waves
+coalesce into COMMON device launches through one shared
+``AsyncBatchCoalescer``/``JaxVerifyEngine``, so launch fill — and with it
+aggregate committed tx/s — multiplies with the shard count while launch
+counts grow sublinearly (the Mir-BFT/SBFT multi-instance multiplier,
+landed on this codebase's strongest axis).  See README "Sharded mode".
+
+Components:
+  ShardRouter  — deterministic, reconfig-friendly client-id -> shard map
+  DeliveryMux  — combined committed stream, per-shard exactly-once/gapless
+  ShardSet     — composition root / front door / metrics roll-up
+"""
+
+from .mux import CommittedEntry, DeliveryMux, ShardStreamViolation
+from .router import ShardRouter, jump_hash
+from .set import ShardHandle, ShardSet
+
+__all__ = [
+    "CommittedEntry",
+    "DeliveryMux",
+    "ShardHandle",
+    "ShardRouter",
+    "ShardSet",
+    "ShardStreamViolation",
+    "jump_hash",
+]

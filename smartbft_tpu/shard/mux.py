@@ -1,0 +1,169 @@
+"""Delivery multiplexer: one combined committed stream over S shards.
+
+Each shard commits an independent, totally-ordered chain; the embedder of
+a sharded deployment wants ONE stream of committed entries (to apply to
+state, index, or serve reads from) without losing the per-shard ordering
+guarantees.  :class:`DeliveryMux` is that seam: shards feed their newly
+committed decisions in, the mux enforces the per-shard invariants —
+**gapless** (each shard's sequence numbers arrive as 1,2,3,... with no
+hole) and **exactly-once** (no request id delivered twice within a shard)
+— and appends to a combined, arrival-ordered stream of
+:class:`CommittedEntry`.
+
+There is deliberately NO cross-shard ordering claim: entries from
+different shards interleave in arrival order only.  Cross-shard
+transactions are out of scope (README "Sharded mode"); anything needing
+an order across shards must impose it above this layer.
+
+A violation raises :class:`ShardStreamViolation` — a sharded deployment
+that forked or double-delivered must fail loudly at the front door, not
+smear bad entries into the embedder's state machine.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Iterable, Optional, Sequence
+
+__all__ = ["CommittedEntry", "DeliveryMux", "ShardStreamViolation"]
+
+
+class ShardStreamViolation(RuntimeError):
+    """A shard's committed feed broke gaplessness or exactly-once."""
+
+
+@dataclass(frozen=True)
+class CommittedEntry:
+    """One committed decision in the combined stream."""
+
+    shard_id: int
+    seq: int          # the shard-local consensus sequence (1-based, gapless)
+    index: int        # position in the combined stream (0-based, arrival order)
+    decision: object  # the shard's Decision (proposal + signatures)
+    request_ids: tuple = ()
+
+
+@dataclass
+class _ShardCursor:
+    next_seq: int = 1
+    delivered: int = 0
+    requests: int = 0  # total request ids delivered (survives pruning)
+    seen_requests: set = field(default_factory=set)
+
+
+class DeliveryMux:
+    """Combined committed stream with per-shard invariant enforcement.
+
+    ``ingest(shard_id, decision, seq=..., request_ids=...)`` appends one
+    decision; feeds usually come from :class:`~smartbft_tpu.shard.set.
+    ShardSet.poll_committed`, which extracts ``seq`` from the decision's
+    ViewMetadata and the request ids from the shard's inspector.  Readers
+    either poll ``combined[since:]`` or register an ``on_deliver``
+    callback (called synchronously per entry, in stream order).  A
+    long-lived embedder calls ``prune(upto)`` once entries are applied, so
+    the committed path does not grow memory with history.
+    """
+
+    def __init__(self, shard_ids: Sequence[int],
+                 on_deliver: Optional[Callable[[CommittedEntry], None]] = None):
+        self._cursors: dict[int, _ShardCursor] = {
+            int(s): _ShardCursor() for s in shard_ids
+        }
+        self.combined: list[CommittedEntry] = []
+        self._pruned = 0  # entries dropped by prune(); indexes stay absolute
+        self._on_deliver = on_deliver
+
+    # -- feeding -----------------------------------------------------------
+
+    def ingest(self, shard_id: int, decision, *, seq: int,
+               request_ids: Iterable = ()) -> CommittedEntry:
+        cur = self._cursors.get(shard_id)
+        if cur is None:
+            raise ShardStreamViolation(
+                f"decision from unknown shard {shard_id}"
+            )
+        if seq != cur.next_seq:
+            raise ShardStreamViolation(
+                f"shard {shard_id} stream gap: got seq {seq}, "
+                f"expected {cur.next_seq}"
+            )
+        ids = tuple(str(r) for r in request_ids)
+        # duplicates against everything delivered before AND within this
+        # very decision — both violate per-shard exactly-once
+        seen_here: set = set()
+        dupes = []
+        for r in ids:
+            if r in cur.seen_requests or r in seen_here:
+                dupes.append(r)
+            seen_here.add(r)
+        if dupes:
+            raise ShardStreamViolation(
+                f"shard {shard_id} delivered duplicates at seq {seq}: "
+                f"{sorted(set(dupes))}"
+            )
+        cur.seen_requests.update(ids)
+        cur.next_seq += 1
+        cur.delivered += 1
+        cur.requests += len(ids)
+        entry = CommittedEntry(
+            shard_id=shard_id, seq=seq,
+            index=self._pruned + len(self.combined),
+            decision=decision, request_ids=ids,
+        )
+        self.combined.append(entry)
+        if self._on_deliver is not None:
+            self._on_deliver(entry)
+        return entry
+
+    # -- reading -----------------------------------------------------------
+
+    def since(self, index: int) -> list[CommittedEntry]:
+        """Combined entries from stream position ``index`` on (entries
+        below the prune watermark are gone)."""
+        return self.combined[max(index - self._pruned, 0):]
+
+    def prune(self, upto: int) -> int:
+        """Drop combined entries with stream index < ``upto`` — the
+        embedder's acknowledgment that they are applied/persisted.  Keeps
+        the committed-path memory bounded in long-lived deployments
+        (everything else history-driven in this codebase is bounded too).
+        Per-shard cursors and counters are untouched; duplicate-request
+        detection narrows to the ids delivered at/after the watermark (the
+        per-shard request pool's client dedup covers the full history).
+        Returns the number of entries dropped."""
+        drop = min(max(upto - self._pruned, 0), len(self.combined))
+        if not drop:
+            return 0
+        for e in self.combined[:drop]:
+            self._cursors[e.shard_id].seen_requests.difference_update(
+                e.request_ids
+            )
+        del self.combined[:drop]
+        self._pruned += drop
+        return drop
+
+    def height(self, shard_id: int) -> int:
+        """Decisions delivered through the mux for one shard."""
+        return self._cursors[shard_id].delivered
+
+    def heights(self) -> dict[int, int]:
+        return {s: c.delivered for s, c in self._cursors.items()}
+
+    def total(self) -> int:
+        return self._pruned + len(self.combined)
+
+    def requests_delivered(self, shard_id: int) -> int:
+        return self._cursors[shard_id].requests
+
+    def snapshot(self) -> dict:
+        """JSON-able per-shard + combined block for bench rows."""
+        return {
+            "total": self.total(),
+            "pruned": self._pruned,
+            "per_shard": {
+                s: {"decisions": c.delivered,
+                    "requests": c.requests,
+                    "next_seq": c.next_seq}
+                for s, c in sorted(self._cursors.items())
+            },
+        }

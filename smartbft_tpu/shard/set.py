@@ -1,0 +1,206 @@
+"""ShardSet: S independent consensus groups behind one front door.
+
+The composition root of sharded mode (README "Sharded mode").  A shard is
+an independent consensus group — its own membership, WAL directories, and
+totally-ordered chain — and the ShardSet owns everything that spans them:
+
+* the client-facing **front door**: ``submit`` routes by client id through
+  a deterministic :class:`~smartbft_tpu.shard.router.ShardRouter` and
+  forwards into the owning shard's request pool (per-shard backpressure
+  applies; ``occupancy`` exposes the combined surface);
+* the **delivery multiplexer**: ``poll_committed`` drains each shard's
+  newly committed decisions into one :class:`~smartbft_tpu.shard.mux.
+  DeliveryMux` stream, enforcing per-shard exactly-once/gapless;
+* **metrics roll-up**: ``stats_block`` emits per-shard blocks (decisions,
+  committed requests, pool occupancy, protocol-plane delta) plus the
+  aggregate, including the shared verify plane's cross-shard wave
+  attribution when a coalescer is attached.
+
+The ShardSet is deliberately generic over a small shard-handle protocol
+(duck-typed; see :class:`ShardHandle`) so the same front door drives the
+in-process test harness (``testing.sharded.AppShard`` — n test Apps over
+one group-namespaced network) and an embedder's production wiring (S
+``Consensus`` facades over real transports).  What makes the set more
+than S independent processes is the SHARED verify plane: every shard's
+CryptoProvider is constructed over ONE ``AsyncBatchCoalescer`` /
+``JaxVerifyEngine`` (each provider tagged with its shard id), so
+prepare/commit verify waves from all shards coalesce into common device
+launches — cross-shard fill is the throughput multiplier, and the fault
+plane (deadline / retry / host-fallback breaker) degrades or recovers all
+shards coherently because it IS one plane.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Optional, Sequence
+
+from .mux import DeliveryMux, ShardStreamViolation
+from .router import ShardRouter
+
+__all__ = ["ShardHandle", "ShardSet"]
+
+
+class ShardHandle(abc.ABC):
+    """What the ShardSet needs from one consensus group.
+
+    ``testing.sharded.AppShard`` is the in-process implementation; a
+    production embedder wraps its per-shard ``Consensus`` facade + ledger
+    the same way.  Implementations are matched by duck typing — this ABC
+    documents the protocol and provides the registration hook."""
+
+    shard_id: int
+
+    @abc.abstractmethod
+    async def start(self) -> None: ...
+
+    @abc.abstractmethod
+    async def stop(self) -> None: ...
+
+    @abc.abstractmethod
+    async def submit(self, raw_request: bytes) -> None:
+        """Forward one raw request into this shard's pool (its leader's
+        submit path: blocks on a full pool, raises on closed/no-leader)."""
+
+    @abc.abstractmethod
+    def poll_committed(self, since: int) -> list:
+        """Committed decisions from chain position ``since`` (0-based) on,
+        each as ``(seq, request_ids, decision)``."""
+
+    @abc.abstractmethod
+    def pool_occupancy(self) -> dict: ...
+
+    def stats_block(self) -> dict:
+        """Optional per-shard extras merged into the roll-up."""
+        return {}
+
+
+class ShardSet:
+    """S shard handles + router + delivery mux behind one surface."""
+
+    def __init__(self, shards: Sequence, router: Optional[ShardRouter] = None,
+                 coalescer=None):
+        """``shards``: shard handles, one per group; their ``shard_id``
+        must be 0..S-1 (the router's bucket space).  ``coalescer``: the
+        SHARED AsyncBatchCoalescer all shards verify through — optional,
+        but without it the set is just S processes glued together; with it
+        ``stats_block`` reports the cross-shard wave mix and breaker
+        state.  ``router`` defaults to a seed-0 ShardRouter over S."""
+        self.shards = {int(s.shard_id): s for s in shards}
+        if sorted(self.shards) != list(range(len(shards))):
+            raise ValueError(
+                f"shard ids must be 0..{len(shards) - 1}, "
+                f"got {sorted(self.shards)}"
+            )
+        self.router = router or ShardRouter(len(shards))
+        if self.router.num_shards != len(shards):
+            raise ValueError(
+                f"router covers {self.router.num_shards} shards, "
+                f"set has {len(shards)}"
+            )
+        self.coalescer = coalescer
+        self.mux = DeliveryMux(sorted(self.shards))
+        #: per-shard chain cursor for poll_committed
+        self._chain_pos: dict[int, int] = {s: 0 for s in self.shards}
+        self.submitted = 0
+
+    @property
+    def num_shards(self) -> int:
+        return len(self.shards)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> None:
+        for s in sorted(self.shards):
+            await self.shards[s].start()
+
+    async def stop(self) -> None:
+        for s in sorted(self.shards):
+            await self.shards[s].stop()
+
+    # -- the front door ----------------------------------------------------
+
+    def route(self, client_id) -> int:
+        return self.router.route(client_id)
+
+    async def submit(self, client_id, raw_request: bytes) -> int:
+        """Route ``client_id``'s request to its owning shard and forward
+        into that shard's pool.  Returns the shard id it landed on.
+
+        Backpressure is PER SHARD and real: a full pool parks this
+        submitter exactly as a single-group deployment would (Pool.submit
+        waits up to submit_timeout, then raises), and other shards'
+        intake is unaffected — one hot shard cannot stall the set."""
+        sid = self.router.route(client_id)
+        shard = self.shards.get(sid)
+        if shard is None:
+            raise ValueError(
+                f"client {client_id!r} routes to shard {sid}, but this set "
+                f"has shards 0..{self.num_shards - 1} — after router."
+                f"reshard() the embedder must rebuild the ShardSet with the "
+                f"new groups (and drain removed ones) before submitting"
+            )
+        await shard.submit(raw_request)
+        self.submitted += 1
+        return sid
+
+    def occupancy(self) -> dict:
+        """Combined submit/backpressure surface over the per-shard pools."""
+        per = {s: self.shards[s].pool_occupancy() for s in sorted(self.shards)}
+        live = [o for o in per.values() if o]
+        return {
+            "per_shard": per,
+            "total_size": sum(o.get("size", 0) for o in live),
+            "total_free": sum(o.get("free", 0) for o in live),
+            "total_waiters": sum(o.get("waiters", 0) for o in live),
+        }
+
+    # -- the combined committed stream -------------------------------------
+
+    def poll_committed(self) -> list:
+        """Drain newly committed decisions from every shard into the mux.
+
+        Returns the new :class:`~smartbft_tpu.shard.mux.CommittedEntry`
+        list (combined arrival order).  Raises
+        :class:`~smartbft_tpu.shard.mux.ShardStreamViolation` if any
+        shard's feed broke gaplessness or exactly-once — the set fails
+        loudly rather than applying a forked shard's entries."""
+        start = self.mux.total()
+        for sid in sorted(self.shards):
+            pos = self._chain_pos[sid]
+            fresh = self.shards[sid].poll_committed(pos)
+            for seq, request_ids, decision in fresh:
+                self.mux.ingest(sid, decision, seq=seq,
+                                request_ids=request_ids)
+            self._chain_pos[sid] = pos + len(fresh)
+        return self.mux.since(start)
+
+    def committed_requests(self, shard_id: Optional[int] = None) -> int:
+        if shard_id is not None:
+            return self.mux.requests_delivered(shard_id)
+        return sum(self.mux.requests_delivered(s) for s in self.shards)
+
+    # -- metrics roll-up ---------------------------------------------------
+
+    def stats_block(self) -> dict:
+        """Per-shard attribution + aggregate, JSON-able for bench rows."""
+        per_shard = {}
+        for sid in sorted(self.shards):
+            shard = self.shards[sid]
+            block = {
+                "decisions": self.mux.height(sid),
+                "committed_requests": self.mux.requests_delivered(sid),
+                "pool": shard.pool_occupancy(),
+            }
+            block.update(shard.stats_block())
+            per_shard[sid] = block
+        agg = {
+            "shards": self.num_shards,
+            "decisions": self.mux.total(),
+            "committed_requests": self.committed_requests(),
+            "submitted": self.submitted,
+        }
+        if self.coalescer is not None:
+            agg["coalescer"] = self.coalescer.shard_snapshot()
+            agg["breaker"] = self.coalescer.fault_snapshot()
+        return {"per_shard": per_shard, "aggregate": agg}

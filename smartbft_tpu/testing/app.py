@@ -410,6 +410,13 @@ class App(Application, Assembler, Comm, Signer, Verifier, RequestInspector,
 
         await self.submit("reconfig", request_id, reconfig_request_payload(nodes, config))
 
+    def pool_occupancy(self) -> dict:
+        """Backpressure snapshot of this node's request pool — the shard
+        front door's per-shard surface ({} while stopped)."""
+        if self.consensus is None:
+            return {}
+        return self.consensus.pool_occupancy()
+
     # -- fault injection convenience --------------------------------------
 
     def disconnect(self) -> None:

@@ -27,7 +27,13 @@ Soak entry point (CI, behind ``-m slow``)::
     python -m smartbft_tpu.testing.chaos --soak [--rounds N] [--depth K]
 
 runs randomized schedules against a rotation-on pipelined cluster and
-fails loudly on any invariant violation.
+fails loudly on any invariant violation.  ``--shards S`` (with
+``--engine-faults``) runs the engine-fault soak against S consensus
+groups sharing ONE coalescer/engine — the sharded deployment shape — and
+asserts the breaker open/close cycle affects all shards coherently:
+every shard keeps committing through the outage on the host fallback,
+every shard's traffic shows in the shared plane's per-tag attribution,
+and the post-heal close restores them together.
 """
 
 from __future__ import annotations
@@ -732,6 +738,95 @@ async def soak(
                 )
 
 
+async def sharded_soak(
+    *, rounds: int = 3, shards: int = 2, n: int = 4, depth: int = 4,
+    seed: int = 1, requests: int = 8, verbose: bool = True,
+) -> None:
+    """Engine-fault soak against the SHARED verify plane of a sharded
+    cluster: every round rides hang -> transient fail-burst -> heal while
+    all S shards stay under load.  Asserts the breaker cycle is coherent
+    across shards — one plane means one open, every shard degrades to the
+    host fallback together (and keeps committing), every shard's items
+    show in the per-tag wave attribution, and one close restores them all.
+    Per-shard fork-free/exactly-once/gapless invariants are checked
+    through the delivery mux."""
+    import tempfile
+    import time as _time
+
+    from .sharded import ShardedCluster, sharded_config
+
+    rng = random.Random(seed)
+    for r in range(rounds):
+        with tempfile.TemporaryDirectory(prefix="chaos-shard-soak-") as root:
+            cfg = lambda s, i: sharded_config(
+                i, depth=depth,
+                request_forward_timeout=120.0,
+                request_complain_timeout=240.0,
+                request_auto_remove_timeout=480.0,
+                leader_heartbeat_timeout=30.0,
+                view_change_resend_interval=15.0,
+                view_change_timeout=60.0,
+                verify_launch_timeout=0.15, verify_launch_retries=2,
+                verify_breaker_threshold=3, verify_probe_interval=0.05,
+            )
+            cluster = ShardedCluster(
+                root, shards=shards, n=n, depth=depth, engine_faults=True,
+                config_fn=cfg, seed=seed + r,
+            )
+            await cluster.start()
+            try:
+                # warm-up decision per shard on the healthy device
+                for s in range(shards):
+                    await cluster.submit(cluster.client_for_shard(s), f"w{r}-{s}a")
+                    await cluster.submit(cluster.client_for_shard(s, 1), f"w{r}-{s}b")
+                from .app import wait_for
+
+                await wait_for(
+                    lambda: all(sh.committed() >= 2 for sh in cluster.shard_list),
+                    cluster.scheduler, 90.0,
+                )
+                # outage: hang, then a transient fail-burst (the un-wedged
+                # but still-sick device), under load on every shard
+                cluster.engine.hang()
+                for s in range(shards):
+                    for j in range(requests):
+                        await cluster.submit(
+                            cluster.client_for_shard(s, j % 2), f"o{r}-{s}-{j}"
+                        )
+                cluster.engine.fail_next(rng.randrange(4, 12))
+                await wait_for(
+                    lambda: all(sh.committed() >= 2 + requests
+                                for sh in cluster.shard_list),
+                    cluster.scheduler, 240.0,
+                )
+                snap = cluster.coalescer.fault_snapshot()
+                assert snap["opens"] >= 1, snap
+                assert snap["host_fallback_batches"] >= 1, snap
+                tag_snap = cluster.coalescer.shard_snapshot()
+                assert set(tag_snap["per_tag"]) == {
+                    str(s) for s in range(shards)
+                }, tag_snap
+                # heal: the canary probe closes the breaker for everyone
+                cluster.engine.heal()
+                deadline = _time.monotonic() + 10.0
+                while cluster.coalescer.breaker_open \
+                        and _time.monotonic() < deadline:
+                    await asyncio.sleep(0.02)
+                snap = cluster.coalescer.fault_snapshot()
+                assert not cluster.coalescer.breaker_open, snap
+                assert snap["opens"] == snap["closes"], snap
+                cluster.check_invariants()
+            finally:
+                await cluster.stop()
+            if verbose:
+                print(
+                    f"sharded round {r}: shards={shards} "
+                    f"committed={[sh.committed() for sh in cluster.shard_list]} "
+                    f"breaker opens={snap['opens']} closes={snap['closes']} "
+                    f"mixed_waves={tag_snap['mixed_waves']} — OK"
+                )
+
+
 def main(argv: Optional[list[str]] = None) -> int:
     import argparse
 
@@ -749,9 +844,27 @@ def main(argv: Optional[list[str]] = None) -> int:
         help="add randomized device-plane faults (hang / transient fail / "
              "slow / permanent) against the shared verify engine",
     )
+    ap.add_argument(
+        "--shards", type=int, default=0,
+        help="run the engine-fault soak against S consensus groups sharing "
+             "one verify plane (implies --engine-faults; breaker cycle must "
+             "affect all shards coherently)",
+    )
     args = ap.parse_args(argv)
     if not args.soak:
         ap.error("nothing to do: pass --soak")
+    if args.shards > 0:
+        asyncio.run(
+            sharded_soak(
+                rounds=args.rounds,
+                shards=args.shards,
+                depth=min(args.depth, 4),
+                seed=args.seed,
+                requests=args.requests,
+            )
+        )
+        print("chaos soak (sharded): all rounds passed")
+        return 0
     asyncio.run(
         soak(
             rounds=args.rounds,

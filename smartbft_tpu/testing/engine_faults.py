@@ -152,9 +152,13 @@ class CoalescedTrivialCrypto:
     crypto-less default except that quorum verification now traverses the
     verify plane under test."""
 
-    def __init__(self, node_id: int, coalescer):
+    def __init__(self, node_id: int, coalescer, tag=None):
+        """``tag``: shard-attribution label forwarded with every coalesced
+        submission (see AsyncBatchCoalescer.submit) — the sharded chaos
+        harness tags each replica's traffic with its shard id."""
         self.node_id = node_id
         self._coalescer = coalescer
+        self.verify_tag = tag
 
     # -- Signer ------------------------------------------------------------
 
@@ -184,7 +188,7 @@ class CoalescedTrivialCrypto:
     async def verify_consenter_sigs_batch_async(self, signatures,
                                                 proposal: Proposal):
         items = [("sig", s.signer, bytes(s.msg)) for s in signatures]
-        mask = await self._coalescer.submit(items)
+        mask = await self._coalescer.submit(items, tag=self.verify_tag)
         return [s.msg if ok else None for s, ok in zip(signatures, mask)]
 
     def configure_fault_policy(self, policy=None, metrics=None,

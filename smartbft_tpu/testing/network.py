@@ -27,7 +27,20 @@ vectorized so fan-out costs O(1) codec work instead of O(n):
 per-delivery decode, per-message dispatch) — the pre-vectorization plane,
 kept as the A/B baseline for the message-plane microbench and regression
 tests.  All costs and call counts feed :data:`smartbft_tpu.metrics.
-PROTOCOL_PLANE`.
+PROTOCOL_PLANE` by default, or a per-group plane in sharded mode.
+
+**Consensus groups (sharded mode).**  Transport keys are namespaced by a
+GROUP id: several independent consensus groups ("shards") can reuse node
+ids 1..n on ONE in-process mesh without inbox collisions.  ``Network.
+group(gid)`` returns a :class:`GroupNet` facade exposing the exact Comm
+surface a single-group embedder sees (``add_node`` / ``send_consensus`` /
+``broadcast_consensus`` / ``node_ids`` / fault injection), all scoped to
+that group; group 0 is the implicit default, so pre-sharding callers are
+untouched.  ``mute``/``partition``/``heal`` take the shard scope the same
+way — a partition in one group never cuts links in another.  Each group
+may carry its own :class:`~smartbft_tpu.metrics.ProtocolPlaneTimers` for
+per-shard cost attribution (the aggregate stays readable through
+``metrics.protocol_plane_snapshot()``).
 """
 
 from __future__ import annotations
@@ -46,27 +59,27 @@ from ..messages import (
     unmarshal_interned,
     wire_of,
 )
-from ..metrics import PROTOCOL_PLANE
+from ..metrics import PROTOCOL_PLANE, install_plane, reset_plane
 from ..utils.tasks import create_logged_task
 
 INCOMING_BUFFER = 1000  # network.go:18-20
 
 
-def _marshal_timed(msg: Message) -> bytes:
+def _marshal_timed(msg: Message, plane) -> bytes:
     """Plain (un-memoized) encode with codec accounting — the naive plane's
     per-recipient cost, and the path mutated (per-target) copies take."""
     t0 = perf_counter()
     w = marshal(msg)
-    PROTOCOL_PLANE.codec_us += (perf_counter() - t0) * 1e6
-    PROTOCOL_PLANE.encodes += 1
+    plane.codec_us += (perf_counter() - t0) * 1e6
+    plane.encodes += 1
     return w
 
 
-def _unmarshal_timed(data: bytes) -> Message:
+def _unmarshal_timed(data: bytes, plane) -> Message:
     t0 = perf_counter()
     m = unmarshal(data)
-    PROTOCOL_PLANE.codec_us += (perf_counter() - t0) * 1e6
-    PROTOCOL_PLANE.decodes += 1
+    plane.codec_us += (perf_counter() - t0) * 1e6
+    plane.decodes += 1
     return m
 
 
@@ -74,9 +87,11 @@ class Node:
     """One endpoint: wraps a Consensus instance's handle_message/
     handle_request behind an inbox task (network.go:200-241)."""
 
-    def __init__(self, node_id: int, network: "Network", rng: random.Random):
+    def __init__(self, node_id: int, network: "Network", rng: random.Random,
+                 group: int = 0):
         self.id = node_id
         self.network = network
+        self.group = group  # consensus-group (shard) namespace
         self.rng = rng
         self.consensus = None  # set by the harness (an App or Consensus)
         self.running = False
@@ -98,7 +113,9 @@ class Node:
             return
         self.running = True
         self._task = create_logged_task(
-            self._serve(), name=f"netnode-{self.id}"
+            self._serve(),
+            name=f"netnode-{self.id}" if self.group == 0
+            else f"netnode-g{self.group}-{self.id}",
         )
 
     async def stop(self) -> None:
@@ -139,41 +156,48 @@ class Node:
 
     async def _dispatch(self, batch: list) -> None:
         """Decode (interned) and route one drained batch, preserving the
-        arrival order across kinds."""
+        arrival order across kinds.  The node's group plane is installed as
+        the task-context accounting target for the duration, so protocol-
+        core sites (vote registration) attribute to the right shard."""
+        plane = self.network.plane_of(self.group)
         t0 = perf_counter()
-        codec0 = PROTOCOL_PLANE.codec_us
-        vote0 = PROTOCOL_PLANE.vote_reg_us
+        codec0 = plane.codec_us
+        vote0 = plane.vote_reg_us
         naive = self.network.naive
-        run: list = []  # consecutive consensus (sender, msg) pairs
-        for kind, sender, payload in batch:
-            if kind == "consensus":
-                msg = payload
-                if isinstance(payload, (bytes, bytearray)):
-                    try:
-                        if naive:
-                            msg = _unmarshal_timed(payload)
-                        else:
-                            msg = unmarshal_interned(payload)
-                    except CodecError:
-                        self.malformed += 1
-                        PROTOCOL_PLANE.malformed_dropped += 1
-                        continue
-                run.append((sender, msg))
-            else:
-                await self._flush_consensus(run)
-                await self.consensus.handle_request(sender, payload)
-        await self._flush_consensus(run)
+        token = install_plane(plane)
+        try:
+            run: list = []  # consecutive consensus (sender, msg) pairs
+            for kind, sender, payload in batch:
+                if kind == "consensus":
+                    msg = payload
+                    if isinstance(payload, (bytes, bytearray)):
+                        try:
+                            if naive:
+                                msg = _unmarshal_timed(payload, plane)
+                            else:
+                                msg = unmarshal_interned(payload, plane)
+                        except CodecError:
+                            self.malformed += 1
+                            plane.malformed_dropped += 1
+                            continue
+                    run.append((sender, msg))
+                else:
+                    await self._flush_consensus(run)
+                    await self.consensus.handle_request(sender, payload)
+            await self._flush_consensus(run)
+        finally:
+            reset_plane(token)
         # disjoint accounting: decode time (codec_us) and view registration
         # (vote_reg_us) accrued inside this tick are reported in their own
         # terms — ingest_us is the drain/dispatch REMAINDER, so the four
         # plane terms sum without double-counting
-        PROTOCOL_PLANE.ingest_us += (
+        plane.ingest_us += (
             (perf_counter() - t0) * 1e6
-            - (PROTOCOL_PLANE.codec_us - codec0)
-            - (PROTOCOL_PLANE.vote_reg_us - vote0)
+            - (plane.codec_us - codec0)
+            - (plane.vote_reg_us - vote0)
         )
-        PROTOCOL_PLANE.batch_ingests += 1
-        PROTOCOL_PLANE.msgs_ingested += len(batch)
+        plane.batch_ingests += 1
+        plane.msgs_ingested += len(batch)
 
     async def _flush_consensus(self, run: list) -> None:
         if not run:
@@ -275,39 +299,82 @@ class Network:
 
     ``naive=True`` reverts to the pre-vectorization message plane — one
     encode per recipient, one decode per delivery, per-message dispatch —
-    as the A/B baseline for the message-plane microbench."""
+    as the A/B baseline for the message-plane microbench.
 
-    def __init__(self, seed: int = 0, naive: bool = False):
-        self.nodes: dict[int, Node] = {}
+    ``plane`` is the default cost-attribution sink (the process-wide
+    :data:`~smartbft_tpu.metrics.PROTOCOL_PLANE` unless given); per-GROUP
+    planes registered via :meth:`group` override it for that group's
+    traffic.  Transport keys are ``(group, node_id)`` internally: shards
+    reuse node ids 1..n without inbox collisions; ``self.nodes`` stays the
+    group-0 map so every pre-sharding caller is untouched."""
+
+    def __init__(self, seed: int = 0, naive: bool = False, plane=None):
         self.naive = naive
+        self.plane = PROTOCOL_PLANE if plane is None else plane
         self.rng = random.Random(seed)
-        #: (node, peer) -> loss probability the link had BEFORE partition()
-        #: cut it.  heal() restores exactly these links to their prior
-        #: state (0.0 entries are removed), leaving independently injected
-        #: disconnect_from() cuts and fractional losses intact.
-        self._partition_cuts: dict[tuple[int, int], float] = {}
+        self._groups: dict[int, dict[int, Node]] = {0: {}}
+        self._group_planes: dict[int, object] = {}
+        #: (group, node, peer) -> loss probability the link had BEFORE
+        #: partition() cut it.  heal() restores exactly these links to
+        #: their prior state (0.0 entries are removed), leaving
+        #: independently injected disconnect_from() cuts and fractional
+        #: losses intact.  Partitions are per group: shards never share
+        #: links, so a cut in one group cannot touch another.
+        self._partition_cuts: dict[tuple[int, int, int], float] = {}
 
-    def add_node(self, node_id: int) -> Node:
-        node = Node(node_id, self, self.rng)
-        self.nodes[node_id] = node
+    # -- group namespacing -------------------------------------------------
+
+    @property
+    def nodes(self) -> dict[int, Node]:
+        """Back-compat: the default group's node map."""
+        return self._groups[0]
+
+    def group(self, gid: int, plane=None) -> "GroupNet":
+        """A group-scoped facade over this mesh (see :class:`GroupNet`).
+
+        ``plane``: optional per-group ProtocolPlaneTimers — all codec /
+        route / ingest / vote-registration cost of this group's traffic is
+        attributed there (per-shard attribution), while the process
+        aggregate stays readable via ``metrics.protocol_plane_snapshot``."""
+        self._groups.setdefault(gid, {})
+        if plane is not None:
+            self._group_planes[gid] = plane
+        return GroupNet(self, gid)
+
+    def plane_of(self, gid: int):
+        return self._group_planes.get(gid, self.plane)
+
+    def group_ids(self) -> list[int]:
+        return sorted(self._groups.keys())
+
+    def _gmap(self, group: int) -> dict[int, Node]:
+        return self._groups.setdefault(group, {})
+
+    def add_node(self, node_id: int, group: int = 0) -> Node:
+        node = Node(node_id, self, self.rng, group=group)
+        self._gmap(group)[node_id] = node
         return node
 
-    def node_ids(self) -> list[int]:
-        return sorted(self.nodes.keys())
+    def node_ids(self, group: int = 0) -> list[int]:
+        return sorted(self._gmap(group).keys())
 
     def start(self) -> None:
-        for node in self.nodes.values():
-            node.start()
+        for gmap in self._groups.values():
+            for node in gmap.values():
+                node.start()
 
     async def stop(self) -> None:
-        for node in self.nodes.values():
-            await node.stop()
+        for gmap in self._groups.values():
+            for node in gmap.values():
+                await node.stop()
 
     # -- transport ---------------------------------------------------------
 
-    def send_consensus(self, source: int, target: int, msg: Message) -> None:
-        src = self.nodes.get(source)
-        dst = self.nodes.get(target)
+    def send_consensus(self, source: int, target: int, msg: Message,
+                       group: int = 0) -> None:
+        gmap = self._gmap(group)
+        src = gmap.get(source)
+        dst = gmap.get(target)
         if src is None or dst is None:
             return
         # sender-side faults
@@ -325,13 +392,17 @@ class Network:
         for f in dst.filters:
             if not f(msg, source):
                 return
-        PROTOCOL_PLANE.sends += 1
-        wire = _marshal_timed(msg) if self.naive else wire_of(msg)
+        plane = self.plane_of(group)
+        plane.sends += 1
+        wire = _marshal_timed(msg, plane) if self.naive \
+            else wire_of(msg, plane)
         dst._offer("consensus", source, wire)
 
     def broadcast_consensus(self, source: int, msg: Message,
-                            targets: Optional[list[int]] = None) -> None:
-        """Encode-once fan-out to ``targets`` (default: every other node).
+                            targets: Optional[list[int]] = None,
+                            group: int = 0) -> None:
+        """Encode-once fan-out to ``targets`` (default: every other node
+        of ``group``).
 
         The canonical encoding is computed at most ONCE (memoized on the
         frozen message instance) and the same wire bytes are enqueued at
@@ -340,22 +411,24 @@ class Network:
         (loss, filters) still apply per recipient, and a mutation hook
         forces a per-target copy + re-encode for the targets it touches —
         correctness over cheapness under fault injection."""
-        src = self.nodes.get(source)
+        gmap = self._gmap(group)
+        src = gmap.get(source)
         if src is None:
             return
-        PROTOCOL_PLANE.broadcasts += 1
+        plane = self.plane_of(group)
+        plane.broadcasts += 1
         if src.muted:
             return  # outbound silence: nothing leaves, nothing encodes
         t0 = perf_counter()
-        codec0 = PROTOCOL_PLANE.codec_us
+        codec0 = plane.codec_us
         wire: Optional[bytes] = None
         if not self.naive and src.mutate_send is None:
-            wire = wire_of(msg)  # ONE encode for the whole fan-out
-        target_ids = targets if targets is not None else self.nodes
+            wire = wire_of(msg, plane)  # ONE encode for the whole fan-out
+        target_ids = targets if targets is not None else gmap
         for target in target_ids:
             if target == source:
                 continue
-            dst = self.nodes.get(target)
+            dst = gmap.get(target)
             if dst is None:
                 continue
             if src._drops(target):
@@ -378,59 +451,142 @@ class Network:
                 continue
             if w is None:
                 if not self.naive and m == msg:
-                    w = wire_of(msg)  # hook did not change this target's copy
+                    # hook did not change this target's copy
+                    w = wire_of(msg, plane)
                 else:
-                    w = _marshal_timed(m)
+                    w = _marshal_timed(m, plane)
             dst._offer("consensus", source, w)
         # disjoint accounting: the encode time spent inside this fan-out is
         # already in codec_us — subtract it so route_us + codec_us +
         # ingest_us + vote_reg_us sum without double-counting
-        PROTOCOL_PLANE.route_us += (
+        plane.route_us += (
             (perf_counter() - t0) * 1e6
-            - (PROTOCOL_PLANE.codec_us - codec0)
+            - (plane.codec_us - codec0)
         )
 
-    def send_transaction(self, source: int, target: int, request: bytes) -> None:
-        src = self.nodes.get(source)
-        dst = self.nodes.get(target)
+    def send_transaction(self, source: int, target: int, request: bytes,
+                         group: int = 0) -> None:
+        gmap = self._gmap(group)
+        src = gmap.get(source)
+        dst = gmap.get(target)
         if src is None or dst is None:
             return
         if src.muted or src._drops(target) or dst._drops_inbound(source):
             return
         dst._offer("request", source, request)
 
-    # -- partitions (chaos harness) ----------------------------------------
+    # -- faults (chaos harness; all take the optional shard scope) ---------
 
-    def partition(self, *groups: list[int]) -> None:
-        """Split the mesh into disjoint groups: messages cross group
-        boundaries in neither direction until :meth:`heal`.  Nodes not
-        named in any group form an implicit final group."""
+    def mute(self, node_id: int, group: int = 0) -> None:
+        self._gmap(group)[node_id].mute()
+
+    def unmute(self, node_id: int, group: int = 0) -> None:
+        self._gmap(group)[node_id].unmute()
+
+    def partition(self, *groups: list[int], shard: int = 0) -> None:
+        """Split ONE consensus group's mesh into disjoint partitions:
+        messages cross partition boundaries in neither direction until
+        :meth:`heal`.  Nodes not named in any partition form an implicit
+        final one.  ``shard`` scopes the cut — other groups' links are
+        untouched (shards never share links in the first place)."""
+        gmap = self._gmap(shard)
         named = {n for g in groups for n in g}
-        rest = [n for n in self.nodes if n not in named]
+        rest = [n for n in gmap if n not in named]
         all_groups = [list(g) for g in groups] + ([rest] if rest else [])
         group_of = {n: i for i, g in enumerate(all_groups) for n in g}
-        for nid, node in self.nodes.items():
-            for peer in self.nodes:
+        for nid, node in gmap.items():
+            for peer in gmap:
                 if peer != nid and group_of.get(peer) != group_of.get(nid):
                     # a link some other fault already cut stays its fault's
                     # responsibility — heal() must not reconnect it; a
                     # fractional pre-existing loss is remembered so heal()
                     # restores it instead of clearing the link
                     prior = node.peer_loss_probability.get(peer, 0.0)
-                    if prior < 1.0 and (nid, peer) not in self._partition_cuts:
-                        self._partition_cuts[(nid, peer)] = prior
+                    key = (shard, nid, peer)
+                    if prior < 1.0 and key not in self._partition_cuts:
+                        self._partition_cuts[key] = prior
                     node.disconnect_from(peer)
 
-    def heal(self) -> None:
+    def heal(self, shard: Optional[int] = None) -> None:
         """Undo :meth:`partition` — exactly the link cuts it installed,
         restoring any pre-partition fractional loss; independently injected
         per-peer cuts (disconnect_from) and node-level faults
-        (mute/disconnect/loss) are left as-is."""
-        for (nid, peer), prior in self._partition_cuts.items():
-            node = self.nodes.get(nid)
+        (mute/disconnect/loss) are left as-is.  ``shard``: heal only that
+        group's cuts; None (default) heals every group."""
+        remaining: dict[tuple[int, int, int], float] = {}
+        for (gid, nid, peer), prior in self._partition_cuts.items():
+            if shard is not None and gid != shard:
+                remaining[(gid, nid, peer)] = prior
+                continue
+            node = self._gmap(gid).get(nid)
             if node is not None:
                 if prior > 0.0:
                     node.peer_loss_probability[peer] = prior
                 else:
                     node.peer_loss_probability.pop(peer, None)
-        self._partition_cuts.clear()
+        self._partition_cuts = remaining
+
+
+class GroupNet:
+    """Group-scoped view of a :class:`Network`: the exact transport surface
+    a single-group embedder uses (what ``testing.app.App`` calls), with
+    every operation namespaced to one consensus group — so S shards reuse
+    node ids 1..n over ONE mesh with zero inbox collisions.  Handed to
+    each shard's Apps by the sharded harness in place of the raw Network.
+    """
+
+    def __init__(self, network: Network, gid: int):
+        self.network = network
+        self.gid = gid
+
+    @property
+    def naive(self) -> bool:
+        return self.network.naive
+
+    @property
+    def plane(self):
+        return self.network.plane_of(self.gid)
+
+    @property
+    def nodes(self) -> dict[int, Node]:
+        return self.network._gmap(self.gid)
+
+    def add_node(self, node_id: int) -> Node:
+        return self.network.add_node(node_id, group=self.gid)
+
+    def node_ids(self) -> list[int]:
+        return self.network.node_ids(self.gid)
+
+    def start(self) -> None:
+        for node in self.nodes.values():
+            node.start()
+
+    async def stop(self) -> None:
+        for node in self.nodes.values():
+            await node.stop()
+
+    # -- transport (Comm surface) ------------------------------------------
+
+    def send_consensus(self, source: int, target: int, msg: Message) -> None:
+        self.network.send_consensus(source, target, msg, group=self.gid)
+
+    def broadcast_consensus(self, source: int, msg: Message,
+                            targets: Optional[list[int]] = None) -> None:
+        self.network.broadcast_consensus(source, msg, targets, group=self.gid)
+
+    def send_transaction(self, source: int, target: int, request: bytes) -> None:
+        self.network.send_transaction(source, target, request, group=self.gid)
+
+    # -- shard-scoped faults ----------------------------------------------
+
+    def mute(self, node_id: int) -> None:
+        self.network.mute(node_id, group=self.gid)
+
+    def unmute(self, node_id: int) -> None:
+        self.network.unmute(node_id, group=self.gid)
+
+    def partition(self, *groups: list[int]) -> None:
+        self.network.partition(*groups, shard=self.gid)
+
+    def heal(self) -> None:
+        self.network.heal(shard=self.gid)

@@ -1,0 +1,412 @@
+"""In-process sharded cluster harness: S App-clusters, ONE verify plane.
+
+The test/bench realization of ``smartbft_tpu.shard``: each shard is an
+n-node cluster of :class:`~smartbft_tpu.testing.app.App` replicas over a
+group-namespaced slice of ONE in-process :class:`~smartbft_tpu.testing.
+network.Network` (shards reuse node ids 1..n with no inbox collisions),
+with per-shard WAL directories, per-shard ledgers, and a per-shard
+:class:`~smartbft_tpu.metrics.ProtocolPlaneTimers` for cost attribution —
+while EVERY replica of EVERY shard verifies through one shared
+``AsyncBatchCoalescer`` (each provider tagged with its shard id), so
+quorum waves from different shards coalesce into common launches.  That
+shared plane is the whole point: it is what the cross-shard-coalescing
+tier-1 gate (tests/test_sharded.py) and the ``benchmarks/sharded.py``
+sweep measure, and what the ``--shards`` chaos soak stresses.
+
+Crypto modes:
+
+* ``"trivial"`` — :class:`~smartbft_tpu.testing.engine_faults.
+  CoalescedTrivialCrypto` over an always-valid host engine: signature
+  semantics identical to the crypto-less test App, but quorum checks
+  genuinely traverse the shared coalescer (and its fault machinery when
+  ``engine_faults=True`` wraps the engine in a FaultyEngine).
+* ``"p256"`` / ``"ed25519"`` — real per-shard keyrings + CryptoProviders
+  over a caller-supplied (or host-default) shared engine: the bench
+  configuration.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional, Sequence
+
+from ..codec import decode, encode
+from ..config import Configuration
+from ..crypto.provider import (
+    AsyncBatchCoalescer,
+    HostVerifyEngine,
+    VerifyFaultPolicy,
+)
+from ..messages import ViewMetadata
+from ..metrics import InMemoryProvider, ProtocolPlaneTimers, TPUCryptoMetrics
+from ..shard import ShardHandle, ShardRouter, ShardSet
+from ..utils.clock import Scheduler
+from .app import App, SharedLedgers, TestRequest, fast_config
+from .engine_faults import (
+    CoalescedTrivialCrypto,
+    FaultyEngine,
+    always_valid_engine,
+)
+from .network import Network
+
+__all__ = ["AppShard", "ShardedCluster", "sharded_config"]
+
+
+def sharded_config(i: int, *, depth: int = 1, rotation: bool = False,
+                   **overrides) -> Configuration:
+    """Per-node configuration for sharded runs: the fast test config with
+    the pipelined window and (optionally) window-granular rotation, plus
+    headroom on the complaint chain — a shard sharing one event loop with
+    S-1 siblings must not misread scheduler contention as a dead leader."""
+    base = dict(
+        leader_rotation=rotation,
+        decisions_per_leader=1 if rotation else 0,
+        rotation_granularity="window" if (rotation and depth > 1) else "decision",
+        pipeline_depth=depth,
+        request_batch_max_count=2,
+        request_batch_max_interval=0.05,
+        leader_heartbeat_timeout=15.0,
+        leader_heartbeat_count=10,
+        view_change_timeout=30.0,
+        view_change_resend_interval=4.0,
+        request_forward_timeout=8.0,
+        request_complain_timeout=20.0,
+        request_auto_remove_timeout=120.0,
+    )
+    base.update(overrides)
+    return dataclasses.replace(fast_config(i), **base)
+
+
+class AppShard(ShardHandle):
+    """One shard: n test Apps over a group-scoped network slice."""
+
+    def __init__(self, shard_id: int, network: Network, scheduler: Scheduler,
+                 wal_root: str, *, n: int = 4,
+                 config_fn: Callable[[int], Configuration],
+                 crypto_fn: Callable[[int], Optional[object]],
+                 plane: Optional[ProtocolPlaneTimers] = None):
+        self.shard_id = int(shard_id)
+        self.plane = plane if plane is not None \
+            else ProtocolPlaneTimers(name=f"shard-{shard_id}")
+        self.net = network.group(self.shard_id, plane=self.plane)
+        self.shared = SharedLedgers()
+        self.scheduler = scheduler
+        self.apps = [
+            App(i, self.net, self.shared, scheduler,
+                wal_dir=f"{wal_root}/shard-{shard_id}/wal-{i}",
+                config=config_fn(i), crypto=crypto_fn(i))
+            for i in range(1, n + 1)
+        ]
+        self.down: set[int] = set()
+        self._plane_base = self.plane.snapshot()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> None:
+        for a in self.apps:
+            if a.id not in self.down:
+                await a.start()
+        self._plane_base = self.plane.snapshot()
+
+    async def stop(self) -> None:
+        for a in self.apps:
+            if a.id not in self.down:
+                await a.stop()
+
+    def app(self, node_id: int) -> App:
+        return self.apps[node_id - 1]
+
+    def live_apps(self) -> list[App]:
+        return [a for a in self.apps if a.id not in self.down]
+
+    # -- front-door surface (ShardHandle) ----------------------------------
+
+    def leader_id(self) -> int:
+        for a in self.live_apps():
+            if a.consensus is not None:
+                lead = a.consensus.get_leader_id()
+                if lead:
+                    return lead
+        return 0
+
+    def _submit_app(self) -> App:
+        lead = self.leader_id()
+        if lead and lead not in self.down:
+            return self.app(lead)
+        live = self.live_apps()
+        if not live:
+            raise RuntimeError(f"shard {self.shard_id} has no live node")
+        return live[0]
+
+    async def submit(self, raw_request: bytes) -> None:
+        await self._submit_app().consensus.submit_request(raw_request)
+
+    def probe_app(self) -> App:
+        """The live app with the longest chain — the mux feed source (all
+        chains are prefix-consistent, so the longest is a safe monotone
+        view of the shard's committed stream)."""
+        live = self.live_apps()
+        if not live:
+            raise RuntimeError(f"shard {self.shard_id} has no live node")
+        return max(live, key=lambda a: a.height())
+
+    def poll_committed(self, since: int) -> list:
+        probe = self.probe_app()
+        out = []
+        for i, d in enumerate(probe.ledger()[since:]):
+            # a metadata-less decision (the shape chaos.py's gapless checker
+            # filters) carries no latest_sequence; its chain position IS its
+            # sequence in a gapless ledger — don't feed seq 0 into the mux
+            if d.proposal.metadata:
+                seq = decode(ViewMetadata, d.proposal.metadata).latest_sequence
+            else:
+                seq = since + i + 1
+            infos = probe.requests_from_proposal(d.proposal)
+            out.append((seq, [str(r) for r in infos], d))
+        return out
+
+    def pool_occupancy(self) -> dict:
+        try:
+            return self._submit_app().pool_occupancy()
+        except RuntimeError:
+            return {}
+
+    def stats_block(self) -> dict:
+        return {
+            "height": self.height(),
+            "leader": self.leader_id(),
+            "plane": ProtocolPlaneTimers.delta(
+                self._plane_base, self.plane.snapshot()
+            ),
+        }
+
+    # -- queries -----------------------------------------------------------
+
+    def height(self) -> int:
+        live = self.live_apps()
+        return max((a.height() for a in live), default=0)
+
+    def committed(self, app: Optional[App] = None) -> int:
+        app = app or self.probe_app()
+        return sum(
+            len(app.requests_from_proposal(d.proposal)) for d in app.ledger()
+        )
+
+    def assert_fork_free(self) -> None:
+        apps = self.live_apps()
+        ref = [(d.proposal.payload, d.proposal.metadata)
+               for d in apps[0].ledger()]
+        for a in apps[1:]:
+            other = [(d.proposal.payload, d.proposal.metadata)
+                     for d in a.ledger()]
+            m = min(len(ref), len(other))
+            assert ref[:m] == other[:m], (
+                f"shard {self.shard_id}: ledger fork between node "
+                f"{apps[0].id} and node {a.id}"
+            )
+
+    # -- fault injection ----------------------------------------------------
+
+    def mute_leader(self) -> int:
+        """Mute the current leader's egress; returns its node id."""
+        lead = self.leader_id()
+        if not lead:
+            raise RuntimeError(f"shard {self.shard_id} has no leader to mute")
+        self.net.mute(lead)
+        return lead
+
+    def unmute(self, node_id: int) -> None:
+        self.net.unmute(node_id)
+
+    async def crash(self, node_id: int) -> None:
+        self.down.add(node_id)
+        await self.app(node_id).stop()
+
+    async def restart(self, node_id: int) -> None:
+        await self.app(node_id).start()
+        self.down.discard(node_id)
+
+
+class ShardedCluster:
+    """S AppShards + shared verify plane + ShardSet front door."""
+
+    def __init__(
+        self,
+        wal_root,
+        *,
+        shards: int = 2,
+        n: int = 4,
+        depth: int = 1,
+        rotation: bool = False,
+        crypto: str = "trivial",
+        engine=None,
+        engine_faults: bool = False,
+        window: float = 0.01,
+        seed: int = 7,
+        router_seed: int = 0,
+        config_fn: Optional[Callable[[int, int], Configuration]] = None,
+        naive: bool = False,
+    ):
+        """``crypto``: "trivial" | "p256" | "ed25519" (see module
+        docstring).  ``engine``: the shared device-stand-in engine for the
+        real-crypto modes (defaults to a HostVerifyEngine of the scheme);
+        trivial mode always uses the always-valid host engine, wrapped in
+        a :class:`FaultyEngine` when ``engine_faults`` — then the
+        ``engine`` attribute exposes hang/fail/heal and the coalescer runs
+        the full fault policy (tight wall-clock knobs, like ChaosCluster).
+        ``config_fn(shard_id, node_id)`` overrides the per-node config."""
+        self.wal_root = str(wal_root)
+        self.num_shards = shards
+        self.n = n
+        self.depth = depth
+        self.scheduler = Scheduler()
+        self.network = Network(seed=seed, naive=naive)
+        self.verify_metrics_provider = InMemoryProvider()
+        tpu_metrics = TPUCryptoMetrics(self.verify_metrics_provider)
+
+        policy = None
+        fallback = None
+        if engine_faults:
+            if crypto != "trivial":
+                raise ValueError("engine_faults requires crypto='trivial'")
+            # wall-clock fault knobs sized like ChaosCluster: the deadline →
+            # retry → breaker cycle completes well inside the real seconds a
+            # logical-clock schedule takes to play out
+            policy = VerifyFaultPolicy(
+                launch_timeout=0.15, launch_retries=2,
+                backoff_base=0.02, backoff_max=0.08, backoff_jitter=0.25,
+                breaker_threshold=3, probe_interval=0.05,
+                probe_backoff_max=0.2,
+            )
+            fallback = always_valid_engine()
+
+        if crypto == "trivial":
+            base_engine = always_valid_engine()
+            self.engine = FaultyEngine(base_engine) if engine_faults \
+                else base_engine
+            self.coalescer = AsyncBatchCoalescer(
+                self.engine, window=window, max_batch=4096,
+                policy=policy, fallback_engine=fallback, metrics=tpu_metrics,
+            )
+            crypto_for = lambda s, i: CoalescedTrivialCrypto(
+                i, self.coalescer, tag=s
+            )
+        elif crypto in ("p256", "ed25519"):
+            from ..crypto import ed25519, p256
+            from ..crypto.provider import (
+                Ed25519CryptoProvider,
+                Keyring,
+                P256CryptoProvider,
+            )
+
+            scheme = p256 if crypto == "p256" else ed25519
+            provider_cls = P256CryptoProvider if crypto == "p256" \
+                else Ed25519CryptoProvider
+            self.engine = engine if engine is not None \
+                else HostVerifyEngine(scheme=scheme)
+            max_batch = getattr(self.engine, "pad_sizes", (2048,))[-1]
+            self.coalescer = AsyncBatchCoalescer(
+                self.engine, window=window,
+                max_batch=max(2 * depth * max_batch, 4096),
+                dedupe=True, metrics=tpu_metrics,
+            )
+            node_ids = list(range(1, n + 1))
+            # per-shard keyrings — shard s's membership signs with its own
+            # keys, so cross-shard votes can never validate even if a bug
+            # leaked a message across group namespaces
+            self._rings = {
+                s: Keyring.generate(
+                    node_ids, seed=b"shard-%d" % s, scheme=scheme
+                )
+                for s in range(shards)
+            }
+
+            def crypto_for(s, i):
+                p = provider_cls(self._rings[s][i], coalescer=self.coalescer)
+                p.verify_tag = s
+                return p
+        else:
+            raise ValueError(f"unknown crypto mode {crypto!r}")
+
+        cfg = config_fn or (
+            lambda s, i: sharded_config(i, depth=depth, rotation=rotation)
+        )
+        self.shard_list = [
+            AppShard(
+                s, self.network, self.scheduler, self.wal_root, n=n,
+                config_fn=lambda i, _s=s: cfg(_s, i),
+                crypto_fn=lambda i, _s=s: crypto_for(_s, i),
+            )
+            for s in range(shards)
+        ]
+        self.set = ShardSet(
+            self.shard_list,
+            router=ShardRouter(shards, seed=router_seed),
+            coalescer=self.coalescer,
+        )
+        self._client_ids: dict[int, list[str]] = {}
+        self._client_scan_pos: dict[int, int] = {}
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> None:
+        await self.set.start()
+
+    async def stop(self) -> None:
+        if hasattr(self.engine, "heal"):
+            self.engine.heal()  # release verify calls parked in a hang
+        await self.set.stop()
+
+    def shard(self, sid: int) -> AppShard:
+        return self.shard_list[sid]
+
+    # -- the front door -----------------------------------------------------
+
+    async def submit(self, client_id: str, request_id: str,
+                     payload: bytes = b"") -> int:
+        """Encode a TestRequest and push it through the routed front door;
+        returns the shard it landed on."""
+        req = encode(TestRequest(
+            client_id=client_id, request_id=request_id, payload=payload
+        ))
+        return await self.set.submit(client_id, req)
+
+    def client_for_shard(self, sid: int, j: int = 0) -> str:
+        """A deterministic client id that ROUTES to shard ``sid`` — lets
+        tests and benches place load evenly while still going through the
+        real router (no bypass).  Memoized: benches call this per submit,
+        and re-scanning the id space would dominate the timed window."""
+        cached = self._client_ids.get(sid, [])
+        while len(cached) <= j:
+            k = self._client_scan_pos.get(sid, 0)
+            while True:
+                cid = f"s{sid}c{k}"
+                k += 1
+                if self.set.router.route(cid) == sid:
+                    cached.append(cid)
+                    break
+                if k > 100_000:  # pragma: no cover — 2^-100000 miss odds
+                    raise RuntimeError(f"no client id routes to shard {sid}")
+            self._client_scan_pos[sid] = k
+        self._client_ids[sid] = cached
+        return cached[j]
+
+    # -- queries / invariants ----------------------------------------------
+
+    def poll(self) -> list:
+        return self.set.poll_committed()
+
+    def committed_requests(self, sid: Optional[int] = None) -> int:
+        self.set.poll_committed()
+        return self.set.committed_requests(sid)
+
+    def check_invariants(self) -> None:
+        """Fork-free within each shard + per-shard gapless/exactly-once
+        across the combined stream (the mux raises on violation)."""
+        for shard in self.shard_list:
+            shard.assert_fork_free()
+        self.set.poll_committed()
+
+    def stats_block(self) -> dict:
+        self.set.poll_committed()
+        return self.set.stats_block()

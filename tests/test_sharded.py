@@ -1,0 +1,583 @@
+"""Sharded consensus groups over one shared verify plane (smartbft_tpu.shard).
+
+Count-based tier-1 gates (never wall-clock), mirroring test_message_plane's
+philosophy:
+
+- router: deterministic, uniform-ish, and MINIMAL-MOVEMENT on reshard
+  (jump consistent hash — growing S only moves keys into new shards);
+- delivery mux: per-shard gapless + exactly-once enforced loudly;
+- network namespacing: two groups reuse node ids 1..n on one mesh with no
+  inbox collisions, and mute/partition are shard-scoped;
+- CROSS-SHARD COALESCING (the tentpole's pinned invariant): at S=4, k=16
+  on trivial-crypto engines, at least one device launch carries verify
+  items from >= 2 shards, and total launches are far below S x decisions;
+- shard isolation: muting shard A's leader mid-burst leaves shards B/C
+  committing within bounded logical time, A view-changes and catches up,
+  and the combined stream stays per-shard gapless throughout;
+- per-shard plane attribution sums into the back-compat process aggregate;
+- a shared-plane breaker cycle (hang -> fallback -> heal -> close) affects
+  every shard coherently: all shards commit through the outage.
+"""
+
+import asyncio
+import collections
+
+import pytest
+
+from smartbft_tpu.metrics import ProtocolPlaneTimers, protocol_plane_snapshot
+from smartbft_tpu.shard import (
+    DeliveryMux,
+    ShardRouter,
+    ShardStreamViolation,
+    jump_hash,
+)
+from smartbft_tpu.testing.app import wait_for
+from smartbft_tpu.testing.network import Network
+from smartbft_tpu.testing.sharded import ShardedCluster, sharded_config
+
+
+# ---------------------------------------------------------------------- router
+
+def test_router_deterministic_and_in_range():
+    r1 = ShardRouter(4, seed=9)
+    r2 = ShardRouter(4, seed=9)
+    for k in range(200):
+        cid = f"client-{k}"
+        assert r1.route(cid) == r2.route(cid)
+        assert 0 <= r1.route(cid) < 4
+    # a different seed yields a genuinely different mapping
+    r3 = ShardRouter(4, seed=10)
+    assert any(r1.route(f"client-{k}") != r3.route(f"client-{k}")
+               for k in range(50))
+
+
+def test_router_roughly_uniform():
+    r = ShardRouter(4, seed=1)
+    counts = collections.Counter(r.route(f"c{k}") for k in range(2000))
+    assert set(counts) == {0, 1, 2, 3}
+    for s in range(4):
+        assert 350 <= counts[s] <= 650, counts  # 500 expected
+
+
+def test_router_reshard_moves_minimally():
+    """Jump consistent hash: growing 4 -> 5 shards moves only keys INTO
+    shard 4 (never between 0..3), and about 1/5 of the space."""
+    r = ShardRouter(4, seed=2)
+    before = {f"c{k}": r.route(f"c{k}") for k in range(2000)}
+    info = r.reshard(5)
+    assert info == {"old": 4, "new": 5}
+    moved = 0
+    for cid, old in before.items():
+        new = r.route(cid)
+        if new != old:
+            moved += 1
+            assert new == 4, (cid, old, new)  # monotone: only into the new shard
+    assert 250 <= moved <= 550, moved  # ~400 expected
+
+
+def test_jump_hash_rejects_bad_buckets():
+    with pytest.raises(ValueError):
+        jump_hash(123, 0)
+    with pytest.raises(ValueError):
+        ShardRouter(0)
+
+
+def test_router_negative_seed_is_distinct():
+    """seed=-s and seed=+s must be independent mappings (the salt is the
+    canonical 64-bit reduction of the seed, not its magnitude)."""
+    pos, neg = ShardRouter(4, seed=3), ShardRouter(4, seed=-3)
+    assert any(pos.route(f"c{k}") != neg.route(f"c{k}") for k in range(50))
+    # and huge seeds reduce instead of raising OverflowError
+    assert 0 <= ShardRouter(4, seed=1 << 80).route("c0") < 4
+
+
+# ------------------------------------------------------------------------- mux
+
+def test_mux_combined_stream_and_invariants():
+    mux = DeliveryMux([0, 1])
+    e1 = mux.ingest(0, "d0-1", seq=1, request_ids=["a", "b"])
+    e2 = mux.ingest(1, "d1-1", seq=1, request_ids=["a"])  # ids are per-shard
+    e3 = mux.ingest(0, "d0-2", seq=2, request_ids=["c"])
+    assert [e.index for e in (e1, e2, e3)] == [0, 1, 2]
+    assert mux.height(0) == 2 and mux.height(1) == 1
+    assert [e.shard_id for e in mux.since(0)] == [0, 1, 0]
+    snap = mux.snapshot()
+    assert snap["total"] == 3
+    assert snap["per_shard"][0]["requests"] == 3
+
+    # gap: seq 4 after 2
+    with pytest.raises(ShardStreamViolation, match="gap"):
+        mux.ingest(0, "d0-4", seq=4)
+    # duplicate request id within a shard
+    with pytest.raises(ShardStreamViolation, match="duplicates"):
+        mux.ingest(0, "d0-3", seq=3, request_ids=["a"])
+    # duplicate request id WITHIN one decision is just as loud
+    with pytest.raises(ShardStreamViolation, match="duplicates"):
+        mux.ingest(0, "d0-3", seq=3, request_ids=["x", "x"])
+    # unknown shard
+    with pytest.raises(ShardStreamViolation, match="unknown shard"):
+        mux.ingest(7, "d", seq=1)
+
+
+def test_set_rejects_submit_after_unrebuilt_reshard():
+    """reshard() re-points the MAPPING only; a set that was not rebuilt
+    for the new shard count refuses routed-out clients loudly instead of
+    dying with a bare KeyError at the front door."""
+    from smartbft_tpu.shard import ShardHandle, ShardSet
+
+    class _Stub(ShardHandle):
+        def __init__(self, sid):
+            self.shard_id = sid
+
+        async def start(self): ...
+        async def stop(self): ...
+        async def submit(self, raw): ...
+        def poll_committed(self, since):
+            return []
+
+        def pool_occupancy(self):
+            return {}
+
+    async def run():
+        s = ShardSet([_Stub(0), _Stub(1)])
+        s.router.reshard(8)
+        # some client now routes outside 0..1; find one and submit it
+        cid = next(f"c{k}" for k in range(10_000)
+                   if s.router.route(f"c{k}") >= 2)
+        with pytest.raises(ValueError, match="rebuild the ShardSet"):
+            await s.submit(cid, b"payload")
+
+    asyncio.run(run())
+
+
+def test_mux_prune_bounds_retention():
+    """prune() drops applied entries (and their dup-check ids) while
+    stream indexes, per-shard counters, and gaplessness keep working."""
+    mux = DeliveryMux([0, 1])
+    for k in range(1, 5):
+        mux.ingest(0, f"d0-{k}", seq=k, request_ids=[f"a{k}"])
+    mux.ingest(1, "d1-1", seq=1, request_ids=["b1"])
+    assert mux.prune(3) == 3  # entries 0..2 acknowledged
+    assert mux.prune(3) == 0  # idempotent
+    assert mux.total() == 5
+    assert [e.index for e in mux.since(0)] == [3, 4]
+    assert mux.requests_delivered(0) == 4  # counters survive pruning
+    assert mux.snapshot()["pruned"] == 3
+    # the stream stays gapless across the watermark
+    e = mux.ingest(0, "d0-5", seq=5, request_ids=["a5"])
+    assert e.index == 5
+    with pytest.raises(ShardStreamViolation, match="gap"):
+        mux.ingest(0, "d0-7", seq=7)
+    # un-pruned ids still dedup; pruned ids fall to the pool's history
+    with pytest.raises(ShardStreamViolation, match="duplicates"):
+        mux.ingest(1, "d1-2", seq=2, request_ids=["b1"])
+
+
+def test_mux_on_deliver_callback():
+    got = []
+    mux = DeliveryMux([0], on_deliver=got.append)
+    mux.ingest(0, "d", seq=1, request_ids=["x"])
+    assert len(got) == 1 and got[0].seq == 1 and got[0].request_ids == ("x",)
+
+
+# --------------------------------------------------------- network namespacing
+
+class Sink:
+    def __init__(self):
+        self.messages = []
+
+    def handle_message(self, sender, msg):
+        self.messages.append((sender, msg))
+
+    def handle_message_batch(self, items):
+        self.messages.extend(items)
+
+    async def handle_request(self, sender, req):
+        self.messages.append((sender, req))
+
+
+def _two_group_mesh(n=3):
+    net = Network(seed=5)
+    sinks = {}
+    for gid in (0, 1):
+        g = net.group(gid)
+        for i in range(1, n + 1):
+            node = g.add_node(i)
+            node.consensus = sinks[(gid, i)] = Sink()
+    net.start()
+    return net, sinks
+
+
+async def _settle(net):
+    for _ in range(20):
+        await asyncio.sleep(0.001)
+
+
+def test_group_namespacing_no_inbox_collisions():
+    """Two shards reuse node ids 1..3 on one mesh; traffic stays inside
+    its group in both directions."""
+
+    async def run():
+        from smartbft_tpu.messages import Prepare
+
+        net, sinks = _two_group_mesh()
+        msg = Prepare(view=0, seq=1, digest="g0-only")
+        net.group(0).broadcast_consensus(1, msg)
+        net.group(1).send_consensus(2, 3, Prepare(view=0, seq=2, digest="g1"))
+        await _settle(net)
+        assert len(sinks[(0, 2)].messages) == 1
+        assert len(sinks[(0, 3)].messages) == 1
+        assert sinks[(0, 2)].messages[0][1].digest == "g0-only"
+        # group 1's same-id nodes saw NOTHING of group 0's broadcast
+        assert all(m[1].digest != "g0-only"
+                   for m in sinks[(1, 2)].messages)
+        assert len(sinks[(1, 3)].messages) == 1
+        assert sinks[(1, 3)].messages[0][1].digest == "g1"
+        await net.stop()
+
+    asyncio.run(run())
+
+
+def test_shard_scoped_mute_and_partition():
+    """mute/partition take the shard scope: faulting node 1 of group 1
+    never touches group 0's node 1, and heal(shard=) undoes only that
+    group's cuts."""
+
+    async def run():
+        from smartbft_tpu.messages import Prepare
+
+        net, sinks = _two_group_mesh()
+        net.mute(1, group=1)
+        net.group(0).broadcast_consensus(1, Prepare(view=0, seq=1, digest="a"))
+        net.group(1).broadcast_consensus(1, Prepare(view=0, seq=1, digest="b"))
+        await _settle(net)
+        assert len(sinks[(0, 2)].messages) == 1  # group 0's node 1 not muted
+        assert len(sinks[(1, 2)].messages) == 0  # group 1's IS
+        net.unmute(1, group=1)
+
+        # partition group 1 into {1} vs rest; group 0 stays whole
+        net.group(1).partition([1])
+        net.group(0).broadcast_consensus(2, Prepare(view=0, seq=2, digest="c"))
+        net.group(1).broadcast_consensus(2, Prepare(view=0, seq=2, digest="d"))
+        await _settle(net)
+        assert any(m[1].digest == "c" for m in sinks[(0, 1)].messages)
+        assert not any(m[1].digest == "d" for m in sinks[(1, 1)].messages)
+        # heal only group 1
+        net.group(1).heal()
+        net.group(1).broadcast_consensus(2, Prepare(view=0, seq=3, digest="e"))
+        await _settle(net)
+        assert any(m[1].digest == "e" for m in sinks[(1, 1)].messages)
+        await net.stop()
+
+    asyncio.run(run())
+
+
+# ------------------------------------------------- sharded cluster end to end
+
+def test_two_shards_commit_combined_stream(tmp_path):
+    """S=2 front-door run: routing lands on the router's shard, both
+    shards drain, the combined stream is per-shard gapless, and the
+    roll-up block carries per-shard planes + the shared-plane blocks."""
+
+    async def run():
+        c = ShardedCluster(tmp_path, shards=2, n=4, depth=4)
+        await c.start()
+        try:
+            per_shard = 8
+            for s in range(2):
+                for j in range(per_shard):
+                    cid = c.client_for_shard(s, j % 2)
+                    landed = await c.submit(cid, f"r{s}-{j}")
+                    assert landed == s  # the router owns placement
+            await wait_for(
+                lambda: all(sh.committed() >= per_shard for sh in c.shard_list),
+                c.scheduler, 90.0,
+            )
+            c.check_invariants()
+            blk = c.stats_block()
+            assert blk["aggregate"]["shards"] == 2
+            assert blk["aggregate"]["committed_requests"] == 2 * per_shard
+            assert blk["aggregate"]["submitted"] == 2 * per_shard
+            for s in range(2):
+                sb = blk["per_shard"][s]
+                assert sb["committed_requests"] == per_shard
+                assert sb["plane"]["broadcasts"] > 0
+                assert sb["pool"]["capacity"] > 0
+            # the shared plane blocks ride along
+            assert "coalescer" in blk["aggregate"]
+            assert blk["aggregate"]["breaker"]["open"] is False
+            # combined occupancy surface
+            occ = c.set.occupancy()
+            assert set(occ["per_shard"]) == {0, 1}
+            assert occ["total_waiters"] == 0
+        finally:
+            await c.stop()
+
+    asyncio.run(run())
+
+
+def test_cross_shard_coalescing_gate(tmp_path):
+    """THE tentpole gate (count-based): S=4, k=16, trivial crypto — one
+    shared coalescer serves every shard, so (a) at least one launch mixes
+    verify items from >= 2 shards and (b) total launches stay FAR below
+    S x decisions (cross-shard fill, not per-shard launch trains)."""
+
+    async def run():
+        c = ShardedCluster(tmp_path, shards=4, n=4, depth=16, window=0.02)
+        await c.start()
+        try:
+            per_shard = 16  # 8 decisions each at batch 2
+            for j in range(per_shard):
+                for s in range(4):
+                    cid = c.client_for_shard(s, j % 4)
+                    await c.submit(cid, f"r{s}-{j}")
+            await wait_for(
+                lambda: all(sh.committed() >= per_shard for sh in c.shard_list),
+                c.scheduler, 240.0,
+            )
+            c.check_invariants()
+            decisions = sum(sh.height() for sh in c.shard_list)
+            launches = c.engine.stats.launches
+            snap = c.coalescer.shard_snapshot()
+            # (a) cross-shard mixing happened at least once, measured at
+            # the wave-composition level
+            assert snap["mixed_waves"] >= 1, snap
+            assert snap["max_tags_in_wave"] >= 2, snap
+            assert set(snap["per_tag"]) == {"0", "1", "2", "3"}, snap
+            # (b) launches << S x decisions: the shared plane coalesces
+            # across shards AND across the deep window (k=16); a quarter is
+            # generous slack against host preemption splitting waves
+            assert decisions >= 24, decisions
+            assert launches <= max(1, decisions // 4), (launches, decisions)
+        finally:
+            await c.stop()
+
+    asyncio.run(run())
+
+
+def test_shard_isolation_leader_mute(tmp_path):
+    """Satellite gate: mute shard 0's leader mid-burst.  Shards 1/2 keep
+    committing within bounded logical time (their drains finish while
+    shard 0 is still headless), shard 0 view-changes to a new leader and
+    catches up, and the combined stream stays per-shard gapless (the mux
+    raises on any gap/dup, checked throughout)."""
+
+    async def run():
+        c = ShardedCluster(tmp_path, shards=3, n=4, depth=4, seed=23)
+        await c.start()
+        try:
+            per_shard = 8
+            # phase 1: everyone commits a first quota (no faults)
+            for s in range(3):
+                for j in range(per_shard // 2):
+                    await c.submit(c.client_for_shard(s, j % 2), f"p1-{s}-{j}")
+            await wait_for(
+                lambda: all(sh.committed() >= per_shard // 2
+                            for sh in c.shard_list),
+                c.scheduler, 90.0,
+            )
+            c.check_invariants()
+
+            # phase 2: shard 0's leader goes mute mid-burst
+            muted = c.shard(0).mute_leader()
+            stalled_height = c.shard(0).height()
+            for s in (1, 2):
+                for j in range(per_shard // 2, per_shard):
+                    await c.submit(c.client_for_shard(s, j % 2), f"p2-{s}-{j}")
+            await wait_for(
+                lambda: all(c.shard(s).committed() >= per_shard
+                            for s in (1, 2)),
+                c.scheduler, 90.0,
+            )
+            # healthy shards drained while shard 0 was still headless:
+            # its heartbeat timeout alone exceeds the drain time above
+            assert c.shard(0).height() <= stalled_height + 1
+            c.check_invariants()
+
+            # phase 3: shard 0 view-changes away from the muted leader...
+            await wait_for(
+                lambda: c.shard(0).leader_id() not in (0, muted),
+                c.scheduler, 120.0,
+            )
+            # ...and catches up: new submissions commit through the new
+            # leader (the muted node stays mute — 3 of 4 are a quorum)
+            for j in range(per_shard // 2, per_shard):
+                await c.submit(c.client_for_shard(0, j % 2), f"p2-0-{j}")
+            await wait_for(
+                lambda: c.shard(0).committed() >= per_shard,
+                c.scheduler, 120.0,
+            )
+            c.check_invariants()
+            blk = c.stats_block()
+            for s in range(3):
+                assert blk["per_shard"][s]["committed_requests"] == per_shard
+        finally:
+            await c.stop()
+
+    asyncio.run(run())
+
+
+# ----------------------------------------------------- per-shard attribution
+
+def test_per_shard_plane_attribution_sums_into_aggregate(tmp_path):
+    """Each shard's traffic lands on ITS plane (not the default), and the
+    back-compat protocol_plane_snapshot() aggregate includes it all."""
+
+    async def run():
+        c = ShardedCluster(tmp_path, shards=2, n=4, depth=1)
+        planes = [sh.plane for sh in c.shard_list]
+        await c.start()
+        try:
+            for s in range(2):
+                await c.submit(c.client_for_shard(s), f"only-{s}")
+                await c.submit(c.client_for_shard(s, 1), f"also-{s}")
+            await wait_for(
+                lambda: all(sh.committed() >= 2 for sh in c.shard_list),
+                c.scheduler, 60.0,
+            )
+            for plane in planes:
+                snap = plane.snapshot()
+                assert snap["broadcasts"] > 0, snap
+                assert snap["batch_ingests"] > 0, snap
+                assert snap["ingest_us"] > 0.0, snap
+                # the vote-registration seam attributes per shard even on
+                # the classic (depth=1) View, whose _drain_inbox runs in
+                # the view's OWN task: the plane is latched at intake
+                assert snap["vote_reg_us"] > 0.0, snap
+            # back-compat contract: the process aggregate includes every
+            # live plane, so it covers at least these shards' counters
+            agg = protocol_plane_snapshot()
+            shard_sum = sum(p.snapshot()["broadcasts"] for p in planes)
+            assert agg["broadcasts"] >= shard_sum > 0
+        finally:
+            await c.stop()
+
+    asyncio.run(run())
+
+
+def test_plane_registry_prunes_dead_instances():
+    """The aggregate registry holds planes weakly: a cluster's planes die
+    with it instead of polluting protocol_plane_snapshot() forever."""
+    import gc
+
+    from smartbft_tpu.metrics import protocol_plane_instances
+
+    gc.collect()  # flush earlier tests' dead planes out of the baseline
+    base = len(protocol_plane_instances())
+    planes = [ProtocolPlaneTimers(name=f"tmp-{i}") for i in range(5)]
+    assert len(protocol_plane_instances()) == base + 5
+    keep = planes[0]
+    del planes
+    gc.collect()
+    alive = protocol_plane_instances()
+    assert len(alive) == base + 1
+    assert keep in alive
+
+
+def test_tpu_counters_aggregate_rolls_up_per_shard_providers():
+    from smartbft_tpu.metrics import (
+        InMemoryProvider,
+        TPUCryptoMetrics,
+        tpu_counters_aggregate,
+    )
+
+    providers = []
+    for open_state in (1.0, 0.0):
+        p = InMemoryProvider()
+        m = TPUCryptoMetrics(p)
+        m.count_sigs_verified.add(10)
+        m.count_batches.add(2)
+        m.breaker_state.set(open_state)
+        m.batch_fill_percent.observe(50.0)
+        providers.append(p)
+    agg = tpu_counters_aggregate(providers)
+    assert agg["consensus.tpu.count_sigs_verified"] == 20
+    assert agg["consensus.tpu.count_batches"] == 4
+    # 0/1 gauges aggregate to "how many providers are degraded"
+    assert agg["consensus.tpu.verify_breaker_open"] == 1.0
+    assert agg["consensus.tpu.batch_fill_percent_count"] == 2
+    # non-TPU metrics stay out of the block
+    assert all(".tpu." in k for k in agg)
+
+
+# ------------------------------------------------------- shared-plane faults
+
+@pytest.mark.slow
+def test_sharded_chaos_soak():
+    """The --shards soak entry point (CI runs it behind -m slow; the CLI
+    form is `python -m smartbft_tpu.testing.chaos --soak --shards 2`)."""
+    from smartbft_tpu.testing.chaos import sharded_soak
+
+    asyncio.run(sharded_soak(rounds=2, shards=2, requests=6, verbose=False))
+
+
+def test_breaker_cycle_affects_all_shards_coherently(tmp_path):
+    """The verify plane is ONE plane: an engine hang trips the breaker
+    once, EVERY shard keeps committing on the host fallback through the
+    outage, and the post-heal close restores them all together."""
+
+    async def run():
+        cfg = lambda s, i: sharded_config(
+            i, depth=4,
+            # device-plane outages stall verification for wall-clock spans
+            # the logical clock races past — keep deposition machinery out
+            # of the picture (same rationale as ChaosCluster engine_faults)
+            request_forward_timeout=120.0,
+            request_complain_timeout=240.0,
+            request_auto_remove_timeout=480.0,
+            leader_heartbeat_timeout=30.0,
+            view_change_resend_interval=15.0,
+            view_change_timeout=60.0,
+            verify_launch_timeout=0.15, verify_launch_retries=2,
+            verify_breaker_threshold=3, verify_probe_interval=0.05,
+        )
+        c = ShardedCluster(
+            tmp_path, shards=2, n=4, depth=4, engine_faults=True,
+            config_fn=cfg, seed=31,
+        )
+        await c.start()
+        try:
+            # healthy warm-up: one decision per shard on the device
+            for s in range(2):
+                await c.submit(c.client_for_shard(s), f"warm-{s}a")
+                await c.submit(c.client_for_shard(s, 1), f"warm-{s}b")
+            await wait_for(
+                lambda: all(sh.committed() >= 2 for sh in c.shard_list),
+                c.scheduler, 60.0,
+            )
+
+            c.engine.hang()  # the shared device wedges for EVERY shard
+            for s in range(2):
+                for j in range(4):
+                    await c.submit(c.client_for_shard(s, j % 2), f"out-{s}-{j}")
+            # both shards commit THROUGH the outage (deadline abandons the
+            # waves, breaker opens, host fallback serves)
+            await wait_for(
+                lambda: all(sh.committed() >= 6 for sh in c.shard_list),
+                c.scheduler, 120.0,
+            )
+            snap = c.coalescer.fault_snapshot()
+            assert snap["opens"] >= 1, snap
+            assert snap["host_fallback_batches"] >= 1, snap
+            # one plane, one breaker: both shards rode the same open cycle
+            tag_snap = c.coalescer.shard_snapshot()
+            assert set(tag_snap["per_tag"]) == {"0", "1"}, tag_snap
+
+            c.engine.heal()
+            import time as _time
+
+            deadline = _time.monotonic() + 8.0
+            while c.coalescer.breaker_open and _time.monotonic() < deadline:
+                await asyncio.sleep(0.02)
+            assert not c.coalescer.breaker_open
+            snap = c.coalescer.fault_snapshot()
+            assert snap["closes"] >= 1, snap
+            c.check_invariants()
+            # breaker transitions visible through the aggregate TPU metrics
+            counters = c.verify_metrics_provider.counters
+            assert counters["consensus.tpu.count_breaker_open"] >= 1
+            assert counters["consensus.tpu.count_breaker_close"] >= 1
+        finally:
+            await c.stop()
+
+    asyncio.run(run())
