@@ -37,6 +37,14 @@ runs the benchmarks/sharded.py sweep — S consensus groups over ONE shared
 verify plane — and prints a second JSON line whose ``shard`` block
 carries the per-shard + aggregate numbers (tx/s, launch fill, cross-shard
 wave mix) plus the S=top-vs-S=1 scaling ratio.
+
+Transport mode: ``--transport {inproc,tcp,uds}`` (or
+SMARTBFT_BENCH_TRANSPORT) additionally runs benchmarks/transport.py —
+the SAME workload through the in-process Network and through real
+sockets on localhost (the ``smartbft_tpu.net`` subsystem) — and prints a
+JSON line whose ``transport`` block carries bytes on the wire, frames
+per flush (write coalescing), reconnects, and drops, paired against the
+in-process tx/s.
 """
 
 from __future__ import annotations
@@ -329,6 +337,48 @@ def sharded_bench(shards: str, cpu_mode: bool) -> None:
     }), flush=True)
 
 
+def transport_bench(flavor: str) -> None:
+    """Run benchmarks/transport.py paired (inproc + the chosen socket
+    flavor, SAME workload/protocol stack, only the Comm seam differs) and
+    print ONE JSON line whose ``transport`` block carries both rows —
+    bytes on the wire, frames per flush (write coalescing), reconnects —
+    next to the usual ``protocol_plane`` block."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    flavors = "inproc" if flavor == "inproc" else f"inproc,{flavor}"
+    nodes = os.environ.get("SMARTBFT_BENCH_TRANSPORT_NODES", "4")
+    requests = os.environ.get("SMARTBFT_BENCH_TRANSPORT_REQUESTS", "120")
+    cmd = [sys.executable, os.path.join(here, "benchmarks", "transport.py"),
+           "--flavors", flavors, "--nodes", nodes, "--requests", requests]
+    timeout = float(os.environ.get("SMARTBFT_BENCH_TRANSPORT_TIMEOUT", "560"))
+    proc = subprocess.run(
+        cmd, timeout=timeout, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),  # no device in this bench
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"transport bench failed: "
+            f"{proc.stderr.decode(errors='replace')[-400:]}"
+        )
+    rows = [json.loads(l) for l in proc.stdout.decode().splitlines() if l.strip()]
+    by_flavor = {r["flavor"]: r for r in rows if r.get("bench") == "transport"}
+    paired = next((r for r in rows if r.get("metric") == "transport_paired"), {})
+    main_row = by_flavor.get(flavor) or next(iter(by_flavor.values()))
+    inproc = by_flavor.get("inproc", {})
+    print(json.dumps({
+        "metric": "transport_committed_tx_per_sec",
+        "value": main_row["tx_per_sec"],
+        "unit": "tx/s",
+        "vs_baseline": (paired.get("pairs") or [{}])[0].get("vs_inproc", 1.0),
+        "flavor": flavor,
+        "nodes": main_row["nodes"],
+        "requests": main_row["requests"],
+        "transport": main_row["transport"],
+        "inproc_tx_per_sec": inproc.get("tx_per_sec"),
+        "protocol_plane": main_row.get("protocol_plane"),
+        "inproc_protocol_plane": inproc.get("protocol_plane"),
+    }), flush=True)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument(
@@ -336,6 +386,15 @@ def main() -> None:
         help="comma-separated shard counts: additionally run the sharded "
              "sweep (benchmarks/sharded.py) and emit its JSON row with the "
              "per-shard + aggregate `shard` block",
+    )
+    ap.add_argument(
+        "--transport", default=os.environ.get("SMARTBFT_BENCH_TRANSPORT", ""),
+        choices=("", "inproc", "tcp", "uds"),
+        help="additionally run the paired transport bench (benchmarks/"
+             "transport.py): the SAME workload through the in-process "
+             "Network and through real sockets on localhost, emitting a "
+             "`transport` block (bytes on the wire, frames/flush, "
+             "reconnects) in the JSON row",
     )
     args, _unknown = ap.parse_known_args()
 
@@ -355,6 +414,12 @@ def main() -> None:
             sharded_bench(args.shards, cpu_mode)
         except Exception as exc:  # noqa: BLE001 — sharded row is additive
             _log(f"bench: sharded sweep failed ({type(exc).__name__}: {exc})")
+
+    if args.transport:
+        try:
+            transport_bench(args.transport)
+        except Exception as exc:  # noqa: BLE001 — transport row is additive
+            _log(f"bench: transport bench failed ({type(exc).__name__}: {exc})")
 
     if os.environ.get("SMARTBFT_BENCH_E2E", "1") == "1":
         try:

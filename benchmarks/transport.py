@@ -1,0 +1,207 @@
+"""Transport paired bench: the SAME workload through the in-process
+Network and through real sockets on localhost.
+
+Every flavor drives an identical cluster — same ``testing.app.App``
+protocol stack, same Scheduler, same crypto (trivial), same request
+stream — and only the Comm seam differs:
+
+* ``inproc``: the PR 4 vectorized in-process Network (encode-once wire
+  bytes, interned decode, wave-batched ingest) — the A side;
+* ``uds`` / ``tcp``: one ``smartbft_tpu.net.SocketComm`` per node, all
+  in one asyncio loop, frames crossing REAL kernel sockets on localhost
+  (length-prefixed framing, per-wave write coalescing, reconnect
+  machinery armed) — the B side.
+
+The socket rows additionally carry the ``transport`` block — bytes on
+the wire, frames per flush (the write-coalescing factor), reconnects,
+drops — summed across the n nodes' ``TransportMetrics``, next to the
+``protocol_plane`` block every bench row already carries.
+
+Run:  python benchmarks/transport.py [--flavors inproc,uds,tcp]
+      [--nodes 4] [--requests 120] [--payload 256]
+Prints one JSON line per flavor plus a ``transport_paired`` comparison
+line (socket vs inproc tx/s) — the PERF.md round-10 numbers.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from smartbft_tpu.metrics import protocol_plane_snapshot
+from smartbft_tpu.net.cluster import _free_port
+from smartbft_tpu.net.transport import SocketComm, TransportMetrics
+from smartbft_tpu.testing.app import App, SharedLedgers, fast_config, wait_for
+from smartbft_tpu.testing.network import Network
+from smartbft_tpu.utils.clock import Scheduler
+
+
+def _socket_addrs(n: int, flavor: str, root: str) -> dict[int, str]:
+    if flavor == "uds":
+        # sockets live under the run's own tempdir (short /tmp path, well
+        # inside the ~107-byte UDS limit) so run_flavor's cleanup takes
+        # them along instead of leaking a dir per bench invocation
+        return {i: f"uds://{root}/n{i}.sock" for i in range(1, n + 1)}
+    return {i: f"tcp://127.0.0.1:{_free_port()}" for i in range(1, n + 1)}
+
+
+def _build_apps(flavor: str, n: int, wal_root: str):
+    scheduler = Scheduler()
+    shared = SharedLedgers()
+    apps: list[App] = []
+    if flavor == "inproc":
+        network = Network(scheduler)
+        for i in range(1, n + 1):
+            apps.append(App(i, network, shared, scheduler,
+                            wal_dir=os.path.join(wal_root, f"wal-{i}"),
+                            config=fast_config(i)))
+    else:
+        addrs = _socket_addrs(n, flavor, wal_root)
+        for i in range(1, n + 1):
+            comm = SocketComm(
+                i, addrs[i], {j: a for j, a in addrs.items() if j != i},
+                cluster_key=b"bench", backoff_base=0.01, backoff_max=0.2,
+            )
+            apps.append(App(i, None, shared, scheduler,
+                            wal_dir=os.path.join(wal_root, f"wal-{i}"),
+                            config=fast_config(i), comm=comm))
+    return apps, scheduler
+
+
+def _aggregate_transport(apps: list[App], flavor: str) -> dict:
+    agg = TransportMetrics()
+    connected = backlog = 0
+    for app in apps:
+        if app.comm is None:
+            continue
+        snap = app.comm.transport_snapshot()
+        for name in TransportMetrics.__slots__:
+            setattr(agg, name, getattr(agg, name) + snap[name])
+        connected += snap["peers_connected"]
+        backlog += snap["outbox_backlog"]
+    out = agg.snapshot()
+    out["flavor"] = flavor
+    out["peers_connected"] = connected
+    out["outbox_backlog"] = backlog
+    return out
+
+
+async def _drive(apps: list[App], scheduler: Scheduler, requests: int,
+                 payload: int, timeout: float) -> tuple[float, int]:
+    for app in apps:
+        await app.start()
+    n = len(apps)
+
+    def all_committed(total: int) -> bool:
+        return all(
+            sum(len(a.requests_from_proposal(d.proposal)) for d in a.ledger())
+            >= total
+            for a in apps
+        )
+
+    # settle: every node sees an elected leader before the clock starts —
+    # heartbeats only flow once the socket links are up, so this also
+    # absorbs the dial/handshake phase the inproc flavor never pays
+    await wait_for(
+        lambda: all(
+            a.consensus is not None and a.consensus.get_leader_id() != 0
+            for a in apps
+        ),
+        scheduler, 30.0,
+    )
+    blob = b"x" * payload
+    t0 = time.perf_counter()
+    for k in range(requests):
+        await apps[0].submit("bench", f"req-{k}", blob)
+        if (k + 1) % 50 == 0:  # let the pipeline drain; pool stays bounded
+            await wait_for(lambda t=k + 1 - 40: all_committed(max(t, 0)),
+                           scheduler, timeout)
+    await wait_for(lambda: all_committed(requests), scheduler, timeout)
+    elapsed = time.perf_counter() - t0
+    decisions = apps[0].height()
+    return elapsed, decisions
+
+
+def run_flavor(flavor: str, n: int, requests: int, payload: int,
+               timeout: float) -> dict:
+    with tempfile.TemporaryDirectory(prefix=f"sbft-tb-{flavor}-") as root:
+        apps, scheduler = _build_apps(flavor, n, root)
+        plane0 = protocol_plane_snapshot()
+
+        async def run():
+            try:
+                return await _drive(apps, scheduler, requests, payload, timeout)
+            finally:
+                for a in apps:
+                    await a.stop()
+
+        elapsed, decisions = asyncio.run(run())
+        plane1 = protocol_plane_snapshot()
+        row = {
+            "bench": "transport",
+            "flavor": flavor,
+            "nodes": n,
+            "requests": requests,
+            "payload_bytes": payload,
+            "decisions": decisions,
+            "elapsed_s": round(elapsed, 3),
+            "tx_per_sec": round(requests / elapsed, 1) if elapsed else 0.0,
+            "transport": _aggregate_transport(apps, flavor),
+            "protocol_plane": {
+                k: round(plane1[k] - plane0[k], 1)
+                for k in plane1 if isinstance(plane1[k], (int, float))
+            },
+        }
+        return row
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--flavors", default="inproc,uds",
+                    help="comma list of inproc/uds/tcp")
+    ap.add_argument("--nodes", type=int, default=4)
+    ap.add_argument("--requests", type=int, default=120)
+    ap.add_argument("--payload", type=int, default=256)
+    ap.add_argument("--timeout", type=float, default=120.0)
+    args = ap.parse_args(argv)
+
+    flavors = [f.strip() for f in args.flavors.split(",") if f.strip()]
+    for f in flavors:
+        if f not in ("inproc", "uds", "tcp"):
+            ap.error(f"unknown flavor {f!r}")
+    rows = {}
+    for flavor in flavors:
+        row = run_flavor(flavor, args.nodes, args.requests, args.payload,
+                         args.timeout)
+        rows[flavor] = row
+        print(json.dumps(row), flush=True)
+    socket_rows = [rows[f] for f in flavors if f != "inproc"]
+    if "inproc" in rows and socket_rows:
+        base = rows["inproc"]["tx_per_sec"]
+        print(json.dumps({
+            "metric": "transport_paired",
+            "inproc_tx_per_sec": base,
+            "pairs": [
+                {
+                    "flavor": r["flavor"],
+                    "tx_per_sec": r["tx_per_sec"],
+                    "vs_inproc": round(r["tx_per_sec"] / base, 3)
+                    if base else 0.0,
+                    "frames_per_flush": r["transport"]["frames_per_flush"],
+                    "bytes_sent": r["transport"]["bytes_sent"],
+                }
+                for r in socket_rows
+            ],
+        }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

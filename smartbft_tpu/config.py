@@ -145,6 +145,33 @@ class Configuration:
     verify_breaker_threshold: int = 3
     verify_probe_interval: float = 2.0
 
+    # Real-socket transport (smartbft_tpu/net/ — no reference counterpart:
+    # the reference is a library whose embedder supplies Comm; these knobs
+    # configure the transport we ship).  Consumed by SocketComm.from_config
+    # and round-tripped by testing.reconfig.ConfigMirror like every other
+    # knob, so a reconfiguration cannot silently reset the transport —
+    # EXCEPT transport_listen, which is per-node like self_id (each
+    # replica binds its OWN address) and is therefore restored from the
+    # local config on receipt (with_node_locals), never mirrored.
+    # - transport_listen: this node's own listen address ("tcp://host:port",
+    #   port 0 for ephemeral, or "uds:///path"); empty = in-process Comm,
+    #   no socket transport.
+    # - transport_outbox_cap: max frames buffered per peer while its link
+    #   is down/slow; beyond it the OLDEST frame is dropped and counted
+    #   (loud-but-bounded — a dead peer must never grow a live replica's
+    #   memory without bound).
+    # - transport_reconnect_backoff_base/_max: exponential redial backoff
+    #   bounds (seconds, wall-clock; each sleep gets ±25% jitter so n
+    #   replicas redialing a restarted peer do not thundering-herd it).
+    # - transport_max_frame_bytes: frame-length sanity cap; a length
+    #   prefix above it poisons the connection (dropped, counted) before
+    #   any allocation happens.
+    transport_listen: str = ""
+    transport_outbox_cap: int = 4096
+    transport_reconnect_backoff_base: float = 0.05
+    transport_reconnect_backoff_max: float = 2.0
+    transport_max_frame_bytes: int = 16 * 1024 * 1024
+
     def validate(self) -> None:
         def positive(name: str) -> None:
             v = getattr(self, name)
@@ -173,10 +200,28 @@ class Configuration:
             "verify_launch_timeout",
             "verify_breaker_threshold",
             "verify_probe_interval",
+            "transport_outbox_cap",
+            "transport_reconnect_backoff_base",
+            "transport_reconnect_backoff_max",
+            "transport_max_frame_bytes",
         ):
             positive(field)
         if self.verify_launch_retries < 0:
             raise ConfigError("verify_launch_retries should not be negative")
+        if self.transport_reconnect_backoff_base > self.transport_reconnect_backoff_max:
+            raise ConfigError(
+                "transport_reconnect_backoff_base is bigger than "
+                "transport_reconnect_backoff_max"
+            )
+        # a frame must be able to carry a maximum-size proposal plus its
+        # metadata/signature envelope, or every send of a full batch
+        # poisons the receiving connection and the cluster loops on
+        # reconnect without ever committing
+        if self.transport_max_frame_bytes < self.request_batch_max_bytes + 65536:
+            raise ConfigError(
+                "transport_max_frame_bytes must exceed request_batch_max_bytes "
+                "by at least 64 KiB of proposal envelope headroom"
+            )
         if self.request_batch_max_count > self.request_batch_max_bytes:
             raise ConfigError("request_batch_max_count is bigger than request_batch_max_bytes")
         if self.request_forward_timeout > self.request_complain_timeout:
@@ -233,6 +278,17 @@ class Configuration:
 
     def with_self_id(self, self_id: int) -> "Configuration":
         return replace(self, self_id=self_id)
+
+    def with_node_locals(self, prev: "Configuration") -> "Configuration":
+        """Restore the per-node fields a cluster-wide reconfiguration must
+        never overwrite: ``self_id`` and this node's own listen address
+        (each replica binds its OWN ``transport_listen``; a committed
+        config carries the proposer's)."""
+        return replace(
+            self,
+            self_id=prev.self_id,
+            transport_listen=prev.transport_listen,
+        )
 
 
 #: Reasonable defaults for a ~10ms-RTT cluster (config.go:92-113).

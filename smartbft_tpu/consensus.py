@@ -234,7 +234,7 @@ class Consensus:
             return
 
         if reconfig.current_config is not None:
-            self.config = reconfig.current_config.with_self_id(self.config.self_id)
+            self.config = reconfig.current_config.with_node_locals(self.config)
         try:
             self.validate_configuration(list(reconfig.current_nodes))
         except ValueError as e:

@@ -733,6 +733,21 @@ class Controller(RequestTimeoutHandler):
             self.verification_sequence = latest_decision.proposal.verification_sequence
             new_proposal_sequence = latest_seq + 1
             new_decisions_in_view = latest_dec + 1
+        elif (
+            latest_md is not None
+            and latest_seq == controller_sequence
+            and latest_view >= controller_view_num
+        ):
+            # Caught-up sync: the synchronizer's latest decision is one we
+            # already have, and it belongs to the view being (re)entered —
+            # so the NEXT decision in that view is latest_dec + 1, exactly
+            # as in the learned-something branch above.  Leaving 0 here
+            # restarts the live view with decisions_in_view=0, after which
+            # this node rejects the leader's correct dec=latest_dec+1
+            # proposals forever ("invalid decisions in view") — a wedge the
+            # socket kill-rejoin soak hit when a wall-clock straggler sync
+            # fired on the restarted ex-leader right after it caught up.
+            new_decisions_in_view = latest_dec + 1
 
         if latest_view > controller_view_num:
             new_view_num = latest_view

@@ -1,0 +1,42 @@
+"""Real-socket transport + multi-process replica cluster.
+
+The reference is a LIBRARY whose embedder supplies transport (PAPER.md
+layer map, L4 ``Comm`` in pkg/api/dependencies.go) — it never ships one.
+This package is the transport it never had: an asyncio TCP / Unix-domain-
+socket implementation of the :class:`smartbft_tpu.api.Comm` SPI, plus a
+process-per-replica launcher, so the engine that PRs 1–5 grew inside one
+Python process escapes the single-process box.
+
+Layout:
+
+* :mod:`framing`   — length-prefixed frame format over the canonical
+  ``messages.wire_of`` encoding, incremental :class:`FrameDecoder`,
+  handshake / sync wire messages;
+* :mod:`transport` — :class:`SocketComm`: encode-once broadcast,
+  per-wave write coalescing (one flush per outbox drain), wave-batched
+  ingest (one ``handle_message_batch`` per read), reconnect with
+  exponential backoff + jitter, bounded outboxes with counted drops;
+* :mod:`cluster`   — :class:`SocketCluster`: spawns one OS process per
+  replica (``python -m smartbft_tpu.net.launch``) sharing only key
+  material and a peer address map; control-channel client; socket-level
+  chaos runner speaking the ``testing.chaos.ChaosEvent`` vocabulary
+  (SIGKILL, link drop, slow link);
+* :mod:`launch`    — the replica process entry point.
+"""
+
+from .framing import (
+    FrameDecoder,
+    FrameError,
+    encode_frame,
+    parse_addr,
+)
+from .transport import SocketComm, TransportMetrics
+
+__all__ = [
+    "FrameDecoder",
+    "FrameError",
+    "encode_frame",
+    "parse_addr",
+    "SocketComm",
+    "TransportMetrics",
+]

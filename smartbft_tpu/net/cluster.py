@@ -1,0 +1,610 @@
+"""Process-per-replica cluster manager + socket-level chaos runner.
+
+:class:`SocketCluster` spawns one OS process per replica
+(``python -m smartbft_tpu.net.launch``), sharing ONLY key material and
+the peer address map — the processes find each other over real TCP or
+Unix-domain sockets, commit through the ``smartbft_tpu.net`` transport,
+and persist ledgers/WALs on disk.  The parent talks to each replica over
+a line-JSON control channel (submit / height / digest / stats / fault /
+stop) that never touches the consensus transport.
+
+:func:`run_socket_schedule` replays the SAME declarative
+``testing.chaos.ChaosEvent`` vocabulary against the live processes, but
+the faults are now *physical*:
+
+====================  ====================================================
+chaos action          socket-level meaning
+====================  ====================================================
+``crash``             SIGKILL the replica process (kill -9)
+``restart``           respawn it — WAL + ledger-file recovery, then
+                      wire-sync catch-up from the peers
+``mute``/``unmute``   transport outbound silence (control fault)
+``disconnect``        blackhole every link of the node, both directions
+``partition``/``heal``  drop_link on each cross-group pair, both endpoints
+``slow_link``         per-flush delay on every link of the node
+====================  ====================================================
+
+(Framing poison — garbage bytes on a live connection — is exercised by
+the frame-robustness tests in ``tests/test_net_framing.py``, where the
+blast radius of one corrupted stream is pinned to that connection.)
+
+Offsets are WALL-CLOCK seconds (real processes have no logical clock).
+``socket_soak`` is the ``python -m smartbft_tpu.testing.chaos --soak
+--sockets`` entry point: SIGKILL-and-rejoin and slow-link rounds over a
+UDS cluster, invariant-checked (all committed, fork-free).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..testing.chaos import ChaosEvent
+
+
+class ControlError(RuntimeError):
+    pass
+
+
+class ControlClient:
+    """Line-JSON client for one replica's control channel.  Connects per
+    call: a replica that was SIGKILLed and respawned is reachable again
+    with zero client-side state to repair."""
+
+    def __init__(self, addr: str, timeout: float = 10.0):
+        self.addr = addr
+        self.timeout = timeout
+
+    def call(self, **req) -> dict:
+        from .framing import parse_addr
+
+        scheme, hostpath, port = parse_addr(self.addr)
+        if scheme == "tcp":
+            sock = socket.create_connection((hostpath, port), self.timeout)
+        else:
+            sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            sock.settimeout(self.timeout)
+            sock.connect(hostpath)
+        try:
+            sock.sendall((json.dumps(req) + "\n").encode())
+            buf = b""
+            while not buf.endswith(b"\n"):
+                chunk = sock.recv(65536)
+                if not chunk:
+                    raise ControlError(f"control channel EOF from {self.addr}")
+                buf += chunk
+            resp = json.loads(buf)
+        finally:
+            sock.close()
+        if not resp.get("ok"):
+            raise ControlError(resp.get("error", "control command failed"))
+        return resp
+
+
+@dataclass
+class ReplicaHandle:
+    node_id: int
+    spec_path: str
+    control: ControlClient
+    listen: str
+    proc: Optional[subprocess.Popen] = None
+
+
+class SocketCluster:
+    """n replica processes over real sockets on this host.
+
+    ``transport``: ``"uds"`` (default; sockets live in a short private
+    tempdir — UDS paths are capped at ~107 bytes, pytest tmp dirs are
+    not) or ``"tcp"`` (127.0.0.1, ephemeral ports reserved up front).
+    ``config_overrides``: JSON-safe Configuration field overrides applied
+    on top of ``launch.proc_config`` in every replica.
+    """
+
+    def __init__(
+        self,
+        root,
+        *,
+        n: int = 4,
+        transport: str = "uds",
+        config_overrides: Optional[dict] = None,
+        cluster_key: bytes = b"smartbft-cluster-key",
+        env: Optional[dict] = None,
+    ):
+        if transport not in ("uds", "tcp"):
+            raise ValueError(f"transport must be 'uds' or 'tcp', got {transport!r}")
+        self.root = str(root)
+        os.makedirs(self.root, exist_ok=True)
+        self.n = n
+        self.transport = transport
+        self.cluster_key = cluster_key
+        self.env = dict(os.environ, JAX_PLATFORMS="cpu", **(env or {}))
+        self._sockdir = (
+            tempfile.mkdtemp(prefix="sbft-", dir="/tmp")
+            if transport == "uds" else None
+        )
+        if transport == "uds":
+            listen = {i: f"uds://{self._sockdir}/n{i}.sock" for i in self._ids}
+            control = {i: f"uds://{self._sockdir}/c{i}.sock" for i in self._ids}
+        else:
+            listen = {i: f"tcp://127.0.0.1:{_free_port()}" for i in self._ids}
+            control = {i: f"tcp://127.0.0.1:{_free_port()}" for i in self._ids}
+        self.replicas: dict[int, ReplicaHandle] = {}
+        for i in self._ids:
+            spec = {
+                "node_id": i,
+                "listen": listen[i],
+                "control": control[i],
+                "peers": {str(j): listen[j] for j in self._ids if j != i},
+                "cluster_key": cluster_key.hex(),
+                "wal_dir": os.path.join(self.root, f"wal-{i}"),
+                "ledger_path": os.path.join(self.root, f"ledger-{i}.bin"),
+                "config": dict(config_overrides or {}),
+            }
+            spec_path = os.path.join(self.root, f"spec-{i}.json")
+            with open(spec_path, "w") as fh:
+                json.dump(spec, fh)
+            self.replicas[i] = ReplicaHandle(
+                node_id=i, spec_path=spec_path,
+                control=ControlClient(control[i]), listen=listen[i],
+            )
+        self.down: set[int] = set()
+
+    @property
+    def _ids(self) -> list[int]:
+        return list(range(1, self.n + 1))
+
+    # ------------------------------------------------------------ lifecycle
+
+    def spawn(self, node_id: int) -> None:
+        h = self.replicas[node_id]
+        # Popen dups the log fd into the child; close the parent's handle
+        # so restart-heavy soaks don't accumulate one fd per spawn
+        with open(os.path.join(self.root, f"replica-{node_id}.log"), "ab") as log:
+            h.proc = subprocess.Popen(
+                [sys.executable, "-m", "smartbft_tpu.net.launch",
+                 "--spec-file", h.spec_path],
+                env=self.env,
+                stdout=subprocess.DEVNULL,
+                stderr=log,
+            )
+        self.down.discard(node_id)
+
+    def start(self, *, ready_timeout: float = 30.0) -> None:
+        for i in self._ids:
+            self.spawn(i)
+        for i in self._ids:
+            self.wait_ready(i, timeout=ready_timeout)
+
+    def wait_ready(self, node_id: int, timeout: float = 30.0) -> None:
+        h = self.replicas[node_id]
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if h.proc is not None and h.proc.poll() is not None:
+                raise RuntimeError(
+                    f"replica {node_id} exited rc={h.proc.returncode} "
+                    f"(see {self.root}/replica-{node_id}.log)"
+                )
+            try:
+                if h.control.call(cmd="ping")["running"]:
+                    return
+            except (OSError, ControlError, json.JSONDecodeError):
+                pass
+            time.sleep(0.05)
+        raise TimeoutError(f"replica {node_id} not ready within {timeout}s")
+
+    def kill(self, node_id: int) -> None:
+        """kill -9: the SIGKILL chaos fault — no shutdown path runs."""
+        h = self.replicas[node_id]
+        if h.proc is not None and h.proc.poll() is None:
+            h.proc.send_signal(signal.SIGKILL)
+            h.proc.wait()
+        self.down.add(node_id)
+
+    def restart(self, node_id: int, *, ready_timeout: float = 30.0) -> None:
+        self.spawn(node_id)
+        self.wait_ready(node_id, timeout=ready_timeout)
+
+    def stop(self) -> None:
+        """Graceful where possible, forceful where not; always reaps."""
+        for i, h in self.replicas.items():
+            if h.proc is None or h.proc.poll() is not None:
+                continue
+            try:
+                h.control.call(cmd="stop")
+            except (OSError, ControlError, json.JSONDecodeError):
+                pass
+        deadline = time.monotonic() + 10.0
+        for h in self.replicas.values():
+            if h.proc is None:
+                continue
+            while h.proc.poll() is None and time.monotonic() < deadline:
+                time.sleep(0.05)
+            if h.proc.poll() is None:
+                h.proc.kill()
+                h.proc.wait()
+        if self._sockdir is not None:
+            import shutil
+
+            shutil.rmtree(self._sockdir, ignore_errors=True)
+
+    def __enter__(self) -> "SocketCluster":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ------------------------------------------------------------ operations
+
+    def live_ids(self) -> list[int]:
+        return [i for i in self._ids if i not in self.down]
+
+    def control(self, node_id: int) -> ControlClient:
+        return self.replicas[node_id].control
+
+    def leader_of(self) -> int:
+        for i in self.live_ids():
+            try:
+                lead = self.control(i).call(cmd="leader")["leader"]
+                if lead:
+                    return lead
+            except (OSError, ControlError):
+                continue
+        return 0
+
+    def wait_leader(self, timeout: float = 20.0) -> int:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            lead = self.leader_of()
+            if lead:
+                return lead
+            time.sleep(0.05)
+        raise TimeoutError("no leader elected")
+
+    def submit(self, via: int, client: str, rid: str, payload: bytes = b"") -> None:
+        self.control(via).call(cmd="submit", client=client, rid=rid,
+                               payload=payload.hex())
+
+    def committed(self, node_id: int) -> int:
+        return self.control(node_id).call(cmd="committed")["committed"]
+
+    def heights(self) -> dict[int, int]:
+        return {i: h for i, (h, _p) in self.heights_and_pools().items()}
+
+    def heights_and_pools(self) -> dict[int, tuple[int, int]]:
+        """node -> (ledger height, request-pool size); (-1, -1) when down."""
+        out = {}
+        for i in self.live_ids():
+            try:
+                resp = self.control(i).call(cmd="height")
+                out[i] = (resp["height"], resp.get("pool", 0))
+            except (OSError, ControlError):
+                out[i] = (-1, -1)
+        return out
+
+    def wait_committed(self, total: int, timeout: float = 60.0,
+                       nodes: Optional[list[int]] = None) -> None:
+        """Block until every targeted replica committed >= total requests."""
+        targets = nodes if nodes is not None else self.live_ids()
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                if all(self.committed(i) >= total for i in targets):
+                    return
+            except (OSError, ControlError):
+                pass
+            time.sleep(0.1)
+        raise TimeoutError(
+            f"cluster did not commit {total} requests within {timeout}s: "
+            f"{[(i, self._committed_or(i)) for i in targets]}"
+        )
+
+    def _committed_or(self, i: int) -> object:
+        try:
+            return self.committed(i)
+        except (OSError, ControlError) as e:
+            return f"down({type(e).__name__})"
+
+    def check_fork_free(self) -> None:
+        """Pairwise-identical ledger prefixes via control-channel digests."""
+        heights = self.heights()
+        live = [i for i, h in heights.items() if h >= 0]
+        if len(live) < 2:
+            return
+        m = min(heights[i] for i in live)
+        digests = {
+            i: self.control(i).call(cmd="ledger_digest", upto=m)["digest"]
+            for i in live
+        }
+        ref = digests[live[0]]
+        for i in live[1:]:
+            assert digests[i] == ref, (
+                f"ledger fork: node {live[0]} and node {i} diverge within "
+                f"the first {m} decisions"
+            )
+
+    def committed_ids(self, node_id: int) -> list[str]:
+        return self.control(node_id).call(cmd="committed_ids")["ids"]
+
+    def wait_quiescent(self, *, quiet: float = 2.0, timeout: float = 60.0,
+                       nodes: Optional[list[int]] = None) -> None:
+        """Block until the targeted replicas' heights are equal, their
+        request pools are EMPTY, and both have held for ``quiet`` seconds.
+
+        The pool condition is what makes the honest-client resubmission
+        contract exactly-once-safe: "heights stable" alone can be reached
+        mid-view-change while uncommitted requests still sit in follower
+        pools waiting to be forwarded to the next leader — resubmitting
+        one of those races its original copy into a second decision (the
+        forwarded copy reaches the new leader after the resubmission
+        committed and cleared the pools, so dedup never sees the pair).
+        Pools empty + heights equal means every submitted request either
+        committed or died with a killed process's volatile pool."""
+        targets = nodes if nodes is not None else self.live_ids()
+        deadline = time.monotonic() + timeout
+        last: Optional[tuple] = None
+        stable_since = time.monotonic()
+        while time.monotonic() < deadline:
+            hp = self.heights_and_pools()
+            hs = tuple(sorted(hp.get(i, (-1, -1))[0] for i in targets))
+            drained = all(hp.get(i, (-1, -1))[1] == 0 for i in targets)
+            if hs != last or len(set(hs)) != 1 or not drained:
+                last = hs
+                stable_since = time.monotonic()
+            elif time.monotonic() - stable_since >= quiet:
+                return
+            time.sleep(0.1)
+        raise TimeoutError(
+            f"cluster never quiesced: heights/pools {self.heights_and_pools()}"
+        )
+
+    def transport_stats(self) -> dict[int, dict]:
+        out = {}
+        for i in self.live_ids():
+            try:
+                out[i] = self.control(i).call(cmd="stats")["transport"]
+            except (OSError, ControlError):
+                pass
+        return out
+
+    def fault(self, node_id: int, action: str, peer: int = 0,
+              delay: float = 0.0) -> None:
+        self.control(node_id).call(cmd="fault", action=action, peer=peer,
+                                   delay=delay)
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# --------------------------------------------------------------------------
+# socket-level chaos: the ChaosEvent vocabulary against live processes
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class SocketChaosReport:
+    submitted: int = 0
+    final_committed: int = 0
+    heights: dict = field(default_factory=dict)
+    events_fired: list = field(default_factory=list)
+
+
+def run_socket_schedule(
+    cluster: SocketCluster,
+    schedule: list[ChaosEvent],
+    *,
+    requests: int = 16,
+    submit_every: float = 0.15,
+    settle_timeout: float = 90.0,
+) -> SocketChaosReport:
+    """Replay a ``testing.chaos`` schedule against real processes.
+
+    Same dynamic-target semantics as the in-process harness: ``"leader"``
+    resolves to the live leader when the event fires, ``"faulty"`` to the
+    run's first leader resolution.  ``at`` offsets are wall-clock seconds
+    from the start of the run.  After the last event and submission, the
+    run blocks until every LIVE replica has committed every request, then
+    fork-checks the ledgers.
+    """
+    report = SocketChaosReport()
+    pending = sorted(schedule, key=lambda e: e.at)
+    faulted: set[int] = set()
+    faulty_node: Optional[int] = None
+    start = time.monotonic()
+    submitted = 0
+    next_submit = 0.0
+
+    def resolve(spec) -> Optional[int]:
+        nonlocal faulty_node
+        if spec == "leader":
+            node = cluster.wait_leader()
+            if faulty_node is None:
+                faulty_node = node
+            return node
+        if spec == "faulty":
+            if faulty_node is None:
+                raise RuntimeError('"faulty" used before any "leader" resolution')
+            return faulty_node
+        return spec
+
+    def fire(evt: ChaosEvent) -> None:
+        node = resolve(evt.node) if evt.node is not None else None
+        if evt.action == "crash":
+            cluster.kill(node)
+            faulted.add(node)
+        elif evt.action == "restart":
+            cluster.restart(node)
+            faulted.discard(node)
+        elif evt.action == "mute":
+            cluster.fault(node, "mute")
+            faulted.add(node)
+        elif evt.action == "unmute":
+            cluster.fault(node, "unmute")
+            faulted.discard(node)
+        elif evt.action == "disconnect":
+            cluster.fault(node, "drop_link")  # peer=0: every link
+            for other in cluster.live_ids():
+                if other != node:
+                    cluster.fault(other, "drop_link", peer=node)
+            faulted.add(node)
+        elif evt.action == "reconnect":
+            cluster.fault(node, "heal_links")
+            for other in cluster.live_ids():
+                if other != node:
+                    cluster.fault(other, "restore_link", peer=node)
+            faulted.discard(node)
+        elif evt.action == "partition":
+            groups = [[resolve(m) for m in g] for g in evt.groups]
+            named = {m for g in groups for m in g}
+            rest = [i for i in cluster._ids if i not in named]
+            allg = groups + ([rest] if rest else [])
+            side = {m: gi for gi, g in enumerate(allg) for m in g}
+            for a in cluster.live_ids():
+                for b in cluster.live_ids():
+                    if a < b and side.get(a) != side.get(b):
+                        cluster.fault(a, "drop_link", peer=b)
+                        cluster.fault(b, "drop_link", peer=a)
+            from ..core.util import compute_quorum
+
+            q, _ = compute_quorum(cluster.n)
+            for g in allg:
+                if len(g) < q:
+                    faulted.update(g)
+        elif evt.action == "heal":
+            for i in cluster.live_ids():
+                cluster.fault(i, "heal_links")
+            faulted.clear()
+        elif evt.action == "slow_link":
+            cluster.fault(node, "slow_link", delay=evt.fraction)
+        elif evt.action == "unslow_link":
+            cluster.fault(node, "slow_link", delay=0.0)
+        else:
+            raise ValueError(f"unsupported socket chaos action: {evt.action}")
+        report.events_fired.append((evt.action, node))
+
+    while True:
+        now = time.monotonic() - start
+        while pending and pending[0].at <= now:
+            fire(pending.pop(0))
+        if submitted < requests and now >= next_submit:
+            healthy = [i for i in cluster.live_ids() if i not in faulted]
+            if healthy:
+                via = healthy[submitted % len(healthy)]
+                try:
+                    cluster.submit(via, "chaos", f"chaos-{submitted}")
+                    submitted += 1
+                except (OSError, ControlError):
+                    pass  # no leader yet / pool full: retry next tick
+            next_submit = now + submit_every
+        report.submitted = submitted
+        if not pending and submitted >= requests:
+            break
+        time.sleep(0.02)
+
+    # drain to quiescence, then act as an honest BFT client: a request
+    # whose only copy sat in a SIGKILLed replica's (volatile) pool is gone
+    # — after the heights stop moving, anything absent from the ledgers is
+    # absent from every live pool too, so resubmitting it through another
+    # replica is exactly-once-safe (and exactly what the reference's
+    # client contract prescribes on request timeout)
+    expected = {f"chaos:chaos-{k}" for k in range(submitted)}
+    deadline = time.monotonic() + settle_timeout
+    while True:
+        cluster.wait_quiescent(
+            timeout=max(deadline - time.monotonic(), 1.0),
+            nodes=[i for i in cluster.live_ids() if i not in faulted],
+        )
+        probe = [i for i in cluster.live_ids() if i not in faulted][0]
+        missing = sorted(expected - set(cluster.committed_ids(probe)))
+        if not missing:
+            break
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"requests never committed after resubmission: {missing}"
+            )
+        healthy = [i for i in cluster.live_ids() if i not in faulted]
+        for j, rid in enumerate(missing):
+            cluster.submit(healthy[j % len(healthy)], "chaos",
+                           rid.split(":", 1)[1])
+        time.sleep(0.5)
+    cluster.wait_committed(submitted, timeout=settle_timeout,
+                           nodes=[i for i in cluster.live_ids()
+                                  if i not in faulted])
+    # stragglers that healed late (e.g. a restarted replica) get a
+    # bounded grace window to catch up before the invariant checks
+    try:
+        cluster.wait_committed(submitted, timeout=settle_timeout / 2)
+    except TimeoutError:
+        pass
+    cluster.check_fork_free()
+    live = cluster.live_ids()
+    # exactly-once: resubmission must never double-deliver
+    ids = cluster.committed_ids(live[0])
+    dupes = {i for i in ids if ids.count(i) > 1}
+    assert not dupes, f"duplicate deliveries after resubmission: {sorted(dupes)}"
+    report.final_committed = cluster.committed(live[0]) if live else 0
+    report.heights = cluster.heights()
+    return report
+
+
+def kill_rejoin_schedule(*, crash_at: float = 2.0,
+                         restart_at: float = 5.0) -> list[ChaosEvent]:
+    """SIGKILL the current leader mid-burst; respawn it; it must recover
+    from WAL + ledger file, wire-sync the gap, and rejoin as a follower."""
+    return [
+        ChaosEvent(at=crash_at, action="crash", node="leader"),
+        ChaosEvent(at=restart_at, action="restart", node="faulty"),
+    ]
+
+
+def slow_link_schedule(*, slow_at: float = 1.0, heal_at: float = 6.0,
+                       delay: float = 0.05) -> list[ChaosEvent]:
+    """Throttle every link of one non-leader replica (per-flush delay) —
+    the cluster must keep committing at quorum speed, and the slow node
+    must still converge once healed."""
+    return [
+        ChaosEvent(at=slow_at, action="slow_link", node=2, fraction=delay),
+        ChaosEvent(at=heal_at, action="unslow_link", node=2),
+    ]
+
+
+def socket_soak(*, rounds: int = 2, n: int = 4, transport: str = "uds",
+                requests: int = 16, verbose: bool = True) -> None:
+    """``chaos --soak --sockets``: the socket-fault matrix end-to-end.
+    Each round runs SIGKILL-and-rejoin then slow-link against a fresh
+    multi-process cluster, checking commit + fork-free invariants."""
+    for r in range(rounds):
+        for name, schedule in (
+            ("kill-rejoin", kill_rejoin_schedule()),
+            ("slow-link", slow_link_schedule()),
+        ):
+            with tempfile.TemporaryDirectory(prefix="sbft-soak-") as root:
+                cluster = SocketCluster(root, n=n, transport=transport)
+                try:
+                    cluster.start()
+                    cluster.wait_leader()
+                    report = run_socket_schedule(
+                        cluster, schedule, requests=requests
+                    )
+                finally:
+                    cluster.stop()
+                if verbose:
+                    print(
+                        f"socket round {r} [{name}]: events="
+                        f"{report.events_fired} committed="
+                        f"{report.final_committed} heights={report.heights}"
+                        " — OK"
+                    )

@@ -1,0 +1,196 @@
+"""Length-prefixed framing over the canonical wire encoding.
+
+One frame on the socket is::
+
+    u32 big-endian length L  |  1-byte frame type  |  payload (L-1 bytes)
+
+``L`` counts the type byte plus the payload, so ``L >= 1`` always; a
+length of zero, a length above the negotiated cap, or an unknown frame
+type is a :class:`FrameError` — the transport treats any of them as a
+poisoned stream and drops THAT connection loudly (counted in its
+metrics) without crashing the replica.  TCP gives no other framing
+recovery point: once a length prefix is wrong, every later byte is
+garbage, so closing and letting the peer reconnect is the only sound
+move.
+
+Frame types:
+
+* ``FT_HELLO``      — first frame on every connection: identifies the
+  dialing node and carries the shared cluster key (replicas share ONLY
+  key material and the peer address map);
+* ``FT_CONSENSUS``  — one consensus message in the canonical tagged
+  encoding (exactly the bytes ``messages.wire_of`` produces; the
+  receive side decodes through ``messages.unmarshal_interned``);
+* ``FT_REQUEST``    — a raw client request (the ``send_transaction``
+  SPI surface, also how the pool forwards requests to the leader);
+* ``FT_SYNC_REQ`` / ``FT_SYNC_RESP`` — ledger catch-up for the
+  multi-process cluster (a restarted replica has no in-process shared
+  ledger to sync from), correlated by nonce.
+
+The handshake / sync payloads are encoded with the UNTAGGED canonical
+codec (``codec.encode`` / ``codec.decode``): the frame type already
+names the class, and keeping them out of the tagged-union registry
+means their registration order can never perturb the consensus tag
+space that every replica must agree on byte-exactly.
+"""
+
+from __future__ import annotations
+
+import struct
+
+from ..codec import wiremsg
+from ..messages import Proposal, Signature
+
+_U32 = struct.Struct(">I")
+
+#: hard cap on one frame (length prefix included payload), matching the
+#: Configuration default ``transport_max_frame_bytes``.  A proposal is
+#: bounded by request_batch_max_bytes (default 10 MiB) plus headers, so
+#: 16 MiB passes every legitimate frame while a hostile/corrupt length
+#: prefix (e.g. 4 GiB) is rejected before any allocation.
+DEFAULT_MAX_FRAME_BYTES = 16 * 1024 * 1024
+
+FT_HELLO = 1
+FT_CONSENSUS = 2
+FT_REQUEST = 3
+FT_SYNC_REQ = 4
+FT_SYNC_RESP = 5
+
+_KNOWN_TYPES = frozenset(
+    (FT_HELLO, FT_CONSENSUS, FT_REQUEST, FT_SYNC_REQ, FT_SYNC_RESP)
+)
+
+
+class FrameError(Exception):
+    """Unrecoverable stream corruption: the connection must be dropped."""
+
+
+def encode_frame(ftype: int, payload: bytes) -> bytes:
+    """``u32 length | type | payload`` — the only writer of the format."""
+    return _U32.pack(1 + len(payload)) + bytes([ftype]) + payload
+
+
+class FrameDecoder:
+    """Incremental frame extraction over arbitrary read() chunk boundaries.
+
+    ``feed`` accepts ANY split of the byte stream — one byte at a time,
+    half a length prefix, three frames in one chunk — and returns every
+    complete ``(type, payload)`` it can; partial frames wait in the
+    buffer for more bytes.  Raises :class:`FrameError` on a zero /
+    oversized length prefix or an unknown frame type, leaving the caller
+    exactly one sound option: drop the connection.
+    """
+
+    __slots__ = ("_buf", "_max_frame")
+
+    def __init__(self, max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES):
+        self._buf = bytearray()
+        self._max_frame = max_frame_bytes
+
+    def __len__(self) -> int:
+        return len(self._buf)
+
+    def feed(self, data: bytes) -> list[tuple[int, bytes]]:
+        buf = self._buf
+        buf += data
+        frames: list[tuple[int, bytes]] = []
+        off = 0
+        try:
+            while len(buf) - off >= 4:
+                length = _U32.unpack_from(buf, off)[0]
+                if length == 0:
+                    raise FrameError("zero-length frame")
+                if length > self._max_frame:
+                    raise FrameError(
+                        f"frame length {length} exceeds cap {self._max_frame}"
+                    )
+                if len(buf) - off - 4 < length:
+                    break  # partial frame: wait for more bytes
+                ftype = buf[off + 4]
+                if ftype not in _KNOWN_TYPES:
+                    raise FrameError(f"unknown frame type {ftype}")
+                payload = bytes(buf[off + 5 : off + 4 + length])
+                frames.append((ftype, payload))
+                off += 4 + length
+        finally:
+            # consume what we parsed even when raising: diagnostics read
+            # cleaner when the poisoned prefix is at offset 0
+            del buf[:off]
+        return frames
+
+
+# --------------------------------------------------------------------------
+# handshake / sync wire messages (untagged encoding; see module docstring)
+# --------------------------------------------------------------------------
+
+
+@wiremsg
+class Hello:
+    """First frame on every connection (both directions are dialed
+    separately: each node's outbound connection carries only its sends)."""
+
+    node_id: int = 0
+    group: int = 0
+    key: bytes = b""
+
+
+@wiremsg
+class SyncRequest:
+    """Fetch committed decisions from ``from_height`` (0-based) onward."""
+
+    nonce: int = 0
+    from_height: int = 0
+
+
+@wiremsg
+class WireDecision:
+    """One committed decision (types.Decision) in wire form."""
+
+    proposal: Proposal = None  # type: ignore[assignment]
+    signatures: list[Signature] = None  # type: ignore[assignment]
+
+    def __post_init__(self):
+        if self.proposal is None:
+            object.__setattr__(self, "proposal", Proposal())
+        if self.signatures is None:
+            object.__setattr__(self, "signatures", [])
+
+
+@wiremsg
+class SyncBatch:
+    """Response to :class:`SyncRequest` — the responder's ledger tail,
+    capped at ``max_sync_decisions`` per round trip (the requester loops)."""
+
+    nonce: int = 0
+    from_height: int = 0
+    total_height: int = 0
+    decisions: list[WireDecision] = None  # type: ignore[assignment]
+
+    def __post_init__(self):
+        if self.decisions is None:
+            object.__setattr__(self, "decisions", [])
+
+
+# --------------------------------------------------------------------------
+# addresses
+# --------------------------------------------------------------------------
+
+
+def parse_addr(addr: str) -> tuple[str, str, int]:
+    """``tcp://host:port`` or ``uds:///path`` -> (scheme, host_or_path, port).
+
+    Raises ValueError on anything else — addresses come from operator
+    config and must fail loudly, not fall back.
+    """
+    if addr.startswith("tcp://"):
+        rest = addr[len("tcp://") :]
+        host, sep, port = rest.rpartition(":")
+        if not sep or not host or not port.isdigit():
+            raise ValueError(f"malformed tcp address: {addr!r}")
+        return "tcp", host, int(port)
+    if addr.startswith("uds://"):
+        path = addr[len("uds://") :]
+        if not path:
+            raise ValueError(f"malformed uds address: {addr!r}")
+        return "uds", path, 0
+    raise ValueError(f"unsupported transport address scheme: {addr!r}")

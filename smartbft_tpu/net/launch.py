@@ -1,0 +1,614 @@
+"""One-replica process entry point: ``python -m smartbft_tpu.net.launch``.
+
+A replica process is a :class:`ReplicaApp` (every SPI interface,
+implemented for a process that shares NOTHING in memory with its peers)
+wired to a :class:`~smartbft_tpu.net.transport.SocketComm` and a
+Consensus facade running on its own wall-clock driver.  Processes share
+only key material and the peer address map — exactly the deployment
+contract of the paper's embedder.
+
+What replaces the in-process harness's shared state:
+
+* **Ledger** — each committed decision is appended (length-prefixed
+  frame, ``framing.WireDecision``) to a per-replica ledger file.  On
+  restart the file is replayed with torn-tail tolerance (a SIGKILL
+  mid-append loses at most the partial tail record; the replica then
+  catches up over the wire like any lagging peer).
+* **Synchronizer** — ``sync()`` asks every peer for its ledger tail over
+  the transport's SYNC_REQ/SYNC_RESP frames (nonce-correlated, batched
+  at ``MAX_SYNC_DECISIONS`` per round trip) and applies the longest
+  consistent extension.  This is what makes SIGKILL-and-rejoin a real
+  scenario instead of a shared-memory illusion.
+* **Control channel** — a tiny line-JSON server (its own UDS/TCP
+  listener, NOT the consensus transport) the parent cluster manager
+  uses to submit requests, read heights/digests/transport stats, inject
+  socket-level faults, and request graceful shutdown.
+
+Crypto is trivial (signature = node id), matching the in-process
+harness's default: this subsystem proves the TRANSPORT, the crypto
+planes are proven elsewhere and plug in through the same SPI.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import hashlib
+import json
+import os
+import threading
+from typing import Optional
+
+from .. import wal as walmod
+from ..api import (
+    Application,
+    Assembler,
+    Comm,
+    MembershipNotifier,
+    RequestInspector,
+    Signer,
+    Synchronizer,
+    Verifier,
+)
+from ..codec import decode, encode
+from ..config import Configuration
+from ..consensus import Consensus
+from ..messages import Proposal, Signature, ViewMetadata
+from ..types import Decision, Reconfig, RequestInfo, SyncResponse
+from ..utils.logging import StdLogger
+from ..utils.memo import BoundedMemo
+from .framing import FrameDecoder, FrameError, WireDecision, encode_frame, parse_addr
+from .transport import SocketComm
+
+#: ledger-file frame type (framing reserves 1..5 for the socket protocol;
+#: the ledger file is a private on-disk format, any tag works as long as
+#: the reader and writer agree — but reusing FrameDecoder keeps torn-tail
+#: handling in one place, so the tag must be a known one)
+from .framing import FT_SYNC_RESP as _FT_LEDGER  # noqa: E402
+
+
+def proc_config(self_id: int) -> Configuration:
+    """Wall-clock configuration for a localhost multi-process cluster:
+    the socket twin of ``testing.app.fast_config`` — timeouts sized for
+    real time on one machine (RTT ~50 us), snappy enough that the smoke
+    gate's kill/rejoin cycles finish inside the tier-1 budget."""
+    return Configuration(
+        self_id=self_id,
+        request_batch_max_count=10,
+        request_batch_max_bytes=10 * 1024 * 1024,
+        request_batch_max_interval=0.02,
+        incoming_message_buffer_size=400,
+        request_pool_size=800,
+        request_forward_timeout=1.0,
+        request_complain_timeout=4.0,
+        request_auto_remove_timeout=60.0,
+        view_change_resend_interval=1.0,
+        view_change_timeout=6.0,
+        leader_heartbeat_timeout=3.0,
+        leader_heartbeat_count=10,
+        num_of_ticks_behind_before_syncing=10,
+        collect_timeout=0.5,
+        # off, like the in-process fast_config: a fresh replica starts at
+        # its recovered height and catches up through the behind-by-
+        # heartbeat sync path; sync_on_start=True measurably destabilizes
+        # the first seconds of a wall-clock cluster (start-time syncs
+        # contend with the first commit waves for the sync lock)
+        sync_on_start=False,
+        speed_up_view_change=False,
+        leader_rotation=False,
+        decisions_per_leader=0,
+        transport_outbox_cap=4096,
+        transport_reconnect_backoff_base=0.02,
+        transport_reconnect_backoff_max=0.5,
+    )
+
+
+class LedgerFile:
+    """Append-only committed-decision log with torn-tail-tolerant replay.
+
+    Frames are ``framing`` frames; a truncated/corrupt tail record (the
+    SIGKILL case) ends the replay instead of raising — the replica simply
+    restarts a few decisions behind and syncs the rest from its peers."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._fh = None
+
+    def read_all(self) -> list[Decision]:
+        decisions: list[Decision] = []
+        if not os.path.exists(self.path):
+            return decisions
+        decoder = FrameDecoder()
+        with open(self.path, "rb") as fh:
+            data = fh.read()
+        try:
+            frames = decoder.feed(data)
+        except FrameError:
+            frames = []  # poisoned mid-file: at worst we resync everything
+        for _ftype, payload in frames:
+            try:
+                wd = decode(WireDecision, payload)
+            except Exception:
+                break  # torn tail
+            decisions.append(
+                Decision(proposal=wd.proposal, signatures=tuple(wd.signatures))
+            )
+        return decisions
+
+    def open_append(self) -> None:
+        self._fh = open(self.path, "ab")
+
+    def append(self, decision: Decision) -> None:
+        wd = WireDecision(
+            proposal=decision.proposal, signatures=list(decision.signatures)
+        )
+        self._fh.write(encode_frame(_FT_LEDGER, encode(wd)))
+        self._fh.flush()
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+
+class ReplicaApp(Application, Assembler, Comm, Signer, Verifier,
+                 RequestInspector, Synchronizer, MembershipNotifier):
+    """The multi-process embedder: one OS process, no shared memory."""
+
+    #: ledger appends are a buffered write + flush — cheap enough to run
+    #: inline on the event loop instead of paying an executor round-trip
+    blocking_deliver = False
+
+    def __init__(self, spec: dict):
+        self.spec = spec
+        self.id = int(spec["node_id"])
+        self.logger = StdLogger(f"replica-{self.id}")
+        self.config = _config_from_spec(spec)
+        self.peers = {int(k): v for k, v in spec["peers"].items()}
+        self.transport = SocketComm.from_config(
+            self.config,
+            self.peers,
+            listen=spec["listen"],
+            cluster_key=bytes.fromhex(spec.get("cluster_key", "")),
+            logger=self.logger,
+        )
+        self.transport.sync_server = self._serve_sync
+        self.ledger_file = LedgerFile(spec["ledger_path"])
+        self.lock = threading.Lock()
+        self.ledger: list[Decision] = []
+        self.verification_seq = 0
+        self.membership_changed = False
+        self.consensus: Optional[Consensus] = None
+        self._wal = None
+        self._request_id_cache: BoundedMemo[bytes, RequestInfo] = BoundedMemo()
+
+    # ------------------------------------------------------------ app SPI
+
+    def deliver(self, proposal: Proposal, signatures) -> Reconfig:
+        decision = Decision(proposal=proposal, signatures=tuple(signatures))
+        with self.lock:
+            self.ledger.append(decision)
+            self.ledger_file.append(decision)
+        return self._reconfig_in(proposal)
+
+    def _reconfig_in(self, proposal: Proposal) -> Reconfig:
+        from ..testing.app import BatchPayload, TestRequest
+        from ..testing.reconfig import RECONFIG_MAGIC, detect_reconfig
+
+        found = Reconfig(in_latest_decision=False)
+        if not proposal.payload or RECONFIG_MAGIC not in proposal.payload:
+            return found
+        try:
+            batch = decode(BatchPayload, proposal.payload)
+        except Exception:
+            return found
+        for raw in batch.requests:
+            try:
+                req = decode(TestRequest, raw)
+            except Exception:
+                continue
+            reconfig = detect_reconfig(req.payload)
+            if reconfig is not None:
+                found = reconfig
+        return found
+
+    def assemble_proposal(self, metadata: bytes, requests) -> Proposal:
+        from ..testing.app import BatchPayload
+
+        return Proposal(
+            header=b"",
+            payload=encode(BatchPayload(requests=list(requests))),
+            metadata=metadata,
+            verification_sequence=self.verification_seq,
+        )
+
+    # ------------------------------------------------------------ Comm
+
+    def send_consensus(self, target_id: int, msg) -> None:
+        self.transport.send_consensus(target_id, msg)
+
+    def broadcast_consensus(self, msg, targets=None) -> None:
+        self.transport.broadcast_consensus(msg, targets)
+
+    def send_transaction(self, target_id: int, request: bytes) -> None:
+        self.transport.send_transaction(target_id, request)
+
+    def nodes(self) -> list[int]:
+        return self.transport.nodes()
+
+    # ------------------------------------------------------------ crypto (trivial)
+
+    def sign(self, data: bytes) -> bytes:
+        return b"sig-%d" % self.id
+
+    def sign_proposal(self, proposal: Proposal, auxiliary_input: bytes) -> Signature:
+        return Signature(signer=self.id, value=b"sig-%d" % self.id,
+                         msg=auxiliary_input)
+
+    def verify_proposal(self, proposal: Proposal) -> list[RequestInfo]:
+        return self.requests_from_proposal(proposal)
+
+    def verify_request(self, raw_request: bytes) -> RequestInfo:
+        return self.request_id(raw_request)
+
+    def verify_consenter_sig(self, signature: Signature, proposal: Proposal) -> bytes:
+        return signature.msg
+
+    def verify_signature(self, signature: Signature) -> None:
+        return None
+
+    def verification_sequence(self) -> int:
+        return self.verification_seq
+
+    def requests_from_proposal(self, proposal: Proposal) -> list[RequestInfo]:
+        from ..testing.app import BatchPayload
+
+        if not proposal.payload:
+            return []
+        batch = decode(BatchPayload, proposal.payload)
+        return [self.request_id(r) for r in batch.requests]
+
+    def auxiliary_data(self, msg: bytes) -> bytes:
+        return msg
+
+    def request_id(self, raw_request: bytes) -> RequestInfo:
+        from ..testing.app import TestRequest
+
+        def compute() -> RequestInfo:
+            req = decode(TestRequest, raw_request)
+            return RequestInfo(client_id=req.client_id, request_id=req.request_id)
+
+        return self._request_id_cache.get_or(raw_request, compute)
+
+    def membership_change(self) -> bool:
+        return self.membership_changed
+
+    # ------------------------------------------------------------ sync (over the wire)
+
+    def _serve_sync(self, from_height: int) -> tuple[list, int]:
+        """Transport sync-server hook (runs on the event loop)."""
+        with self.lock:
+            tail = self.ledger[from_height:]
+            total = len(self.ledger)
+        return (
+            [WireDecision(proposal=d.proposal, signatures=list(d.signatures))
+             for d in tail],
+            total,
+        )
+
+    def sync(self) -> SyncResponse:
+        """Synchronizer SPI — called on an executor thread; the socket
+        round trips run on the event loop via run_coroutine_threadsafe."""
+        try:
+            fut = asyncio.run_coroutine_threadsafe(self._sync_over_wire(),
+                                                   self._loop)
+            fut.result(timeout=30.0)
+        except Exception as e:  # noqa: BLE001 — sync must not kill the caller
+            self.logger.warnf("wire sync failed: %r", e)
+        with self.lock:
+            mine = list(self.ledger)
+        latest = mine[-1] if mine else Decision(proposal=Proposal())
+        reconfig = (
+            self._reconfig_in(latest.proposal) if mine
+            else Reconfig(in_latest_decision=False)
+        )
+        return SyncResponse(latest=latest, reconfig=reconfig)
+
+    async def _sync_over_wire(self) -> None:
+        """Pull our peers' ledger tails until no peer is ahead of us."""
+        for _round in range(64):  # bound: 64 * MAX_SYNC_DECISIONS decisions
+            with self.lock:
+                my_height = len(self.ledger)
+            results = await asyncio.gather(*[
+                self.transport.request_sync(p, my_height, timeout=1.0)
+                for p in self.peers
+            ])
+            batches = [r for r in results if r is not None and r.decisions]
+            if not batches:
+                return
+            best = max(batches, key=lambda b: len(b.decisions))
+            applied = 0
+            for wd in best.decisions:
+                md = (decode(ViewMetadata, wd.proposal.metadata)
+                      if wd.proposal.metadata else ViewMetadata())
+                with self.lock:
+                    expect = len(self.ledger) + 1
+                if md.latest_sequence != expect:
+                    break  # stale/overlapping batch: re-request from new height
+                self.deliver(wd.proposal, list(wd.signatures))
+                self._drop_synced_from_pool(wd.proposal)
+                applied += 1
+            if applied == 0:
+                return
+
+    def _drop_synced_from_pool(self, proposal: Proposal) -> None:
+        """Remove a wire-synced decision's requests from the local pool.
+
+        Wire sync delivers around consensus (the decisions never pass
+        through Controller._decide), so without this a request that sat in
+        OUR pool while the cluster committed it stays pooled forever: the
+        pool keeps forwarding it, the leader keeps rejecting it as already
+        processed, the forward-timeout keeps complaining — observed as the
+        restarted kill-rejoin replica complaining about a healthy leader
+        until request_auto_remove_timeout (60 s) finally fired."""
+        if self.consensus is None or self.consensus.pool is None:
+            return
+        from ..core.pool import remove_delivered_requests
+
+        try:
+            infos = self.requests_from_proposal(proposal)
+        except Exception:  # noqa: BLE001 — foreign payload: nothing pooled
+            return
+        remove_delivered_requests(self.consensus.pool, infos, self.logger)
+
+    # ------------------------------------------------------------ lifecycle
+
+    async def start(self) -> None:
+        self._loop = asyncio.get_running_loop()
+        kw = {}
+        if self.spec.get("wal_file_size_bytes"):
+            kw["file_size_bytes"] = int(self.spec["wal_file_size_bytes"])
+        self._wal, entries = walmod.initialize_and_read_all(
+            self.spec["wal_dir"], self.logger, **kw
+        )
+        self.ledger = self.ledger_file.read_all()
+        self.ledger_file.open_append()
+        if self.ledger:
+            last = self.ledger[-1]
+            md = decode(ViewMetadata, last.proposal.metadata)
+            last_proposal, last_sigs = last.proposal, list(last.signatures)
+        else:
+            md, last_proposal, last_sigs = ViewMetadata(), Proposal(), []
+        self.consensus = Consensus(
+            config=self.config,
+            application=self,
+            assembler=self,
+            wal=self._wal,
+            wal_initial_content=entries,
+            comm=self,
+            signer=self,
+            verifier=self,
+            membership_notifier=self,
+            request_inspector=self,
+            synchronizer=self,
+            logger=self.logger,
+            metadata=md,
+            last_proposal=last_proposal,
+            last_signatures=last_sigs,
+            scheduler=None,  # own wall-clock driver: this is production mode
+            viewchanger_tick_interval=0.1,
+            heartbeat_tick_interval=0.1,
+        )
+        self.transport.attach(self.consensus)
+        await self.transport.start()
+        await self.consensus.start()
+
+    async def stop(self) -> None:
+        if self.consensus is not None:
+            await self.consensus.stop()
+        await self.transport.close()
+        if self._wal is not None and hasattr(self._wal, "close"):
+            self._wal.close()
+        self.ledger_file.close()
+
+    # ------------------------------------------------------------ control queries
+
+    def height(self) -> int:
+        with self.lock:
+            return len(self.ledger)
+
+    def committed_requests(self) -> int:
+        with self.lock:
+            ledger = list(self.ledger)
+        return sum(len(self.requests_from_proposal(d.proposal)) for d in ledger)
+
+    def committed_ids(self) -> list[str]:
+        """Every committed request as "client:rid", in ledger order — the
+        chaos runner's exactly-once oracle and the client-resubmission
+        check (a request in NO live ledger after quiescence died with a
+        killed replica's pool and must be resubmitted, like any BFT
+        client would)."""
+        with self.lock:
+            ledger = list(self.ledger)
+        return [
+            str(info)
+            for d in ledger
+            for info in self.requests_from_proposal(d.proposal)
+        ]
+
+    def ledger_digest(self, upto: int) -> str:
+        """Fork detector: hash of the (payload, metadata) prefix."""
+        with self.lock:
+            prefix = self.ledger[:upto] if upto else list(self.ledger)
+        h = hashlib.sha256()
+        for d in prefix:
+            h.update(d.proposal.payload)
+            h.update(d.proposal.metadata)
+        return h.hexdigest()
+
+
+def _config_from_spec(spec: dict) -> Configuration:
+    import dataclasses
+
+    cfg = proc_config(int(spec["node_id"]))
+    overrides = spec.get("config") or {}
+    if overrides:
+        cfg = dataclasses.replace(cfg, **overrides)
+    return cfg
+
+
+# --------------------------------------------------------------------------
+# control channel (line JSON; parent-facing, never part of consensus)
+# --------------------------------------------------------------------------
+
+
+class ControlServer:
+    def __init__(self, replica: ReplicaApp, addr: str, stop_evt: asyncio.Event):
+        self.replica = replica
+        self.addr = addr
+        self.stop_evt = stop_evt
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    async def start(self) -> None:
+        scheme, hostpath, port = parse_addr(self.addr)
+        if scheme == "tcp":
+            self._server = await asyncio.start_server(
+                self._serve, host=hostpath, port=port
+            )
+        else:
+            self._server = await asyncio.start_unix_server(
+                self._serve, path=hostpath
+            )
+
+    async def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+            scheme, hostpath, _ = parse_addr(self.addr)
+            if scheme == "uds":
+                import contextlib
+
+                with contextlib.suppress(OSError):
+                    os.unlink(hostpath)
+
+    async def _serve(self, reader: asyncio.StreamReader,
+                     writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    return
+                try:
+                    req = json.loads(line)
+                    resp = await self._handle(req)
+                except Exception as e:  # noqa: BLE001 — control must answer
+                    resp = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+                writer.write((json.dumps(resp) + "\n").encode())
+                await writer.drain()
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            writer.close()
+
+    async def _handle(self, req: dict) -> dict:
+        r = self.replica
+        cmd = req.get("cmd")
+        if cmd == "ping":
+            running = r.consensus is not None and r.consensus._running
+            return {"ok": True, "running": running, "node_id": r.id}
+        if cmd == "leader":
+            lead = r.consensus.get_leader_id() if r.consensus else 0
+            return {"ok": True, "leader": lead}
+        if cmd == "submit":
+            from ..testing.app import TestRequest
+
+            raw = encode(TestRequest(
+                client_id=req["client"],
+                request_id=req["rid"],
+                payload=bytes.fromhex(req.get("payload", "")),
+            ))
+            await r.consensus.submit_request(raw)
+            return {"ok": True}
+        if cmd == "height":
+            pool = r.consensus.pool_occupancy() if r.consensus else {}
+            return {"ok": True, "height": r.height(),
+                    "pool": pool.get("size", 0)}
+        if cmd == "committed":
+            return {"ok": True, "committed": r.committed_requests(),
+                    "height": r.height()}
+        if cmd == "committed_ids":
+            return {"ok": True, "ids": r.committed_ids()}
+        if cmd == "ledger_digest":
+            upto = int(req.get("upto", 0))
+            return {"ok": True, "digest": r.ledger_digest(upto),
+                    "height": r.height()}
+        if cmd == "stats":
+            return {"ok": True, "transport": r.transport.transport_snapshot(),
+                    "height": r.height(),
+                    "committed": r.committed_requests()}
+        if cmd == "fault":
+            return self._fault(req)
+        if cmd == "stop":
+            self.stop_evt.set()
+            return {"ok": True}
+        return {"ok": False, "error": f"unknown cmd {cmd!r}"}
+
+    def _fault(self, req: dict) -> dict:
+        """Socket-level chaos: the same fault vocabulary the in-process
+        network exposes, applied at the transport."""
+        t = self.replica.transport
+        action = req.get("action")
+        peer = int(req.get("peer", 0))
+        peers = [peer] if peer else list(t._peers)
+        if action == "mute":
+            t.mute()
+        elif action == "unmute":
+            t.unmute()
+        elif action == "drop_link":
+            for p in peers:
+                t.drop_link(p)
+        elif action == "restore_link":
+            for p in peers:
+                t.restore_link(p)
+        elif action == "heal_links":
+            for p in list(t._dropped_links):
+                t.restore_link(p)
+            for p in list(t._slow_links):
+                t.slow_link(p, 0.0)
+            t.unmute()
+        elif action == "slow_link":
+            delay = float(req.get("delay", 0.0))
+            for p in peers:
+                t.slow_link(p, delay)
+        else:
+            return {"ok": False, "error": f"unknown fault {action!r}"}
+        return {"ok": True}
+
+
+async def run_replica(spec: dict) -> None:
+    replica = ReplicaApp(spec)
+    stop_evt = asyncio.Event()
+    control = ControlServer(replica, spec["control"], stop_evt)
+    await control.start()  # control first: the parent polls it for readiness
+    await replica.start()
+    try:
+        await stop_evt.wait()
+    finally:
+        await replica.stop()
+        await control.close()
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description="SmartBFT socket replica process")
+    ap.add_argument("--spec-file", required=True,
+                    help="path to the JSON ReplicaSpec")
+    args = ap.parse_args(argv)
+    with open(args.spec_file) as fh:
+        spec = json.load(fh)
+    asyncio.run(run_replica(spec))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,662 @@
+"""SocketComm: the asyncio TCP/UDS implementation of the Comm SPI.
+
+The in-process ``testing.network.Network`` and this transport sit behind
+the SAME seam (``api.Comm`` + the optional ``broadcast_consensus``
+vectorization hook), so every protocol component is transport-blind.
+PR 4 made the message plane carry canonical wire BYTES with encode-once
+broadcast — the serialization work a real network needs was already
+paid; this module adds the sockets:
+
+* **Encode-once broadcast** — ``broadcast_consensus`` computes the
+  canonical encoding once (``messages.wire_of``, memoized on the frozen
+  instance), frames it once, and enqueues the SAME bytes object on every
+  peer's outbox;
+* **Per-wave write coalescing** — each peer has one sender task that
+  drains the WHOLE outbox per wakeup and writes it as one
+  ``writev``-style batch (one ``write`` + one ``drain`` per wave),
+  mirroring PR 4's wave-batched ingest on the send side.  A depth-k
+  window's k pre-prepares leave in one flush instead of k;
+* **Wave-batched ingest** — one ``reader.read()`` returns whatever the
+  peer's last flush carried; every complete frame in it is decoded
+  (``messages.unmarshal_interned``) and handed to
+  ``Consensus.handle_message_batch`` in ONE call, so a quorum wave
+  registers in one scheduler tick — identical to the in-process plane;
+* **Reconnect with exponential backoff + jitter** — the same retry
+  idiom as the PR 3 verify plane: base doubles to a cap, each sleep is
+  multiplied by ``1 ± jitter`` so n replicas redialing a restarted peer
+  do not thundering-herd it;
+* **Loud-but-bounded peer death** — outboxes are capped deques: when a
+  peer is down past its cap the OLDEST frame is dropped and counted
+  (protocol recovery — re-sends, view changes, sync — is built for loss;
+  unbounded queues are how one dead peer OOMs a live replica);
+* **Malformed frames drop the connection, loudly** — a bad length
+  prefix, unknown frame type, or undecodable consensus payload counts
+  in metrics and closes THAT connection; the replica and the intern LRU
+  (which only caches successful decodes) are untouched.
+
+Connections are DIRECTED: each node dials every peer and uses that
+connection only for its own sends; inbound connections only receive.
+Two simplex links per pair cost one extra fd but remove all tie-break
+complexity (simultaneous dial, connection reuse races), and a link
+fault maps 1:1 onto a socket: dropping my outbound link to you is
+exactly "my sends stop reaching you".
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import random
+from collections import deque
+from time import perf_counter
+from typing import Callable, Optional
+
+from ..api import Comm
+from ..codec import CodecError, decode, encode
+from ..messages import Message, unmarshal_interned, wire_of
+from ..metrics import PROTOCOL_PLANE, install_plane, reset_plane
+from ..utils.logging import StdLogger
+from ..utils.tasks import create_logged_task
+from .framing import (
+    DEFAULT_MAX_FRAME_BYTES,
+    FT_CONSENSUS,
+    FT_HELLO,
+    FT_REQUEST,
+    FT_SYNC_REQ,
+    FT_SYNC_RESP,
+    FrameDecoder,
+    FrameError,
+    Hello,
+    SyncBatch,
+    SyncRequest,
+    encode_frame,
+    parse_addr,
+)
+
+#: read-buffer size per reader.read() call; one sender flush usually fits
+READ_CHUNK = 256 * 1024
+
+#: per-connection-attempt timeout (a dead TCP peer can otherwise park the
+#: dial in SYN-retry for minutes; UDS fails instantly either way)
+CONNECT_TIMEOUT = 3.0
+
+#: a connection whose first frame is not a valid HELLO within this window
+#: is rejected (handshake_rejected) — garbage dialers cannot hold fds open
+HANDSHAKE_TIMEOUT = 5.0
+
+#: SyncBatch responses are capped at this many decisions per round trip;
+#: the requester loops until caught up
+MAX_SYNC_DECISIONS = 256
+
+
+class TransportMetrics:
+    """Per-transport counters, exported as the ``transport`` block in
+    bench rows and readable over the replica control channel.  Separate
+    from ProtocolPlaneTimers: the plane accounts protocol-core cost
+    (codec/ingest/route/vote-reg), this accounts the SOCKET layer —
+    bytes, frames, flushes, reconnects, drops."""
+
+    __slots__ = (
+        "bytes_sent", "bytes_received", "frames_sent", "frames_received",
+        "flush_batches", "ingest_batches", "connects", "reconnects",
+        "connect_failures", "outbox_dropped", "link_dropped",
+        "malformed_frames", "connections_dropped", "handshake_rejected",
+        "sync_requests", "sync_responses",
+    )
+
+    def __init__(self) -> None:
+        for name in self.__slots__:
+            setattr(self, name, 0)
+
+    def snapshot(self) -> dict:
+        snap = {name: getattr(self, name) for name in self.__slots__}
+        snap["frames_per_flush"] = (
+            round(self.frames_sent / self.flush_batches, 2)
+            if self.flush_batches else 0.0
+        )
+        return snap
+
+
+class _Peer:
+    """Sender-side state for one outbound (directed) link."""
+
+    __slots__ = ("id", "addr", "outbox", "wake", "task", "connected")
+
+    def __init__(self, peer_id: int, addr: str):
+        self.id = peer_id
+        self.addr = addr
+        self.outbox: deque = deque()
+        self.wake: Optional[asyncio.Event] = None  # created on start()
+        self.task: Optional[asyncio.Task] = None
+        self.connected = False
+
+
+class SocketComm(Comm):
+    """Asyncio TCP/UDS node-to-node transport (see module docstring).
+
+    ``peers`` maps node id -> address string for every OTHER replica;
+    ``listen`` is this node's own address (``tcp://host:port`` with port
+    0 for ephemeral, or ``uds:///path``).  ``consensus`` must be
+    attached (:meth:`attach`) before traffic flows; frames arriving
+    before that are dropped and counted.
+    """
+
+    def __init__(
+        self,
+        self_id: int,
+        listen: str,
+        peers: dict[int, str],
+        *,
+        cluster_key: bytes = b"",
+        group: int = 0,
+        outbox_cap: int = 4096,
+        backoff_base: float = 0.05,
+        backoff_max: float = 2.0,
+        backoff_jitter: float = 0.25,
+        max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES,
+        logger=None,
+        plane=None,
+        rng: Optional[random.Random] = None,
+    ):
+        if self_id in peers:
+            raise ValueError(f"peers must not contain self_id {self_id}")
+        self.self_id = self_id
+        self.listen = listen
+        self.group = group
+        self.cluster_key = bytes(cluster_key)
+        self.outbox_cap = outbox_cap
+        self.backoff_base = backoff_base
+        self.backoff_max = backoff_max
+        self.backoff_jitter = backoff_jitter
+        self.max_frame_bytes = max_frame_bytes
+        self.logger = logger or StdLogger(f"smartbft.net.{self_id}")
+        self.plane = PROTOCOL_PLANE if plane is None else plane
+        self.metrics = TransportMetrics()
+        self.consensus = None
+        #: multi-process sync server hook: (from_height) -> (decisions,
+        #: total_height) with decisions a list[framing.WireDecision]
+        self.sync_server: Optional[Callable[[int], tuple[list, int]]] = None
+        self._rng = rng or random.Random(self_id * 7919 + 17)
+        self._peers: dict[int, _Peer] = {
+            pid: _Peer(pid, addr) for pid, addr in peers.items()
+        }
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._bound_addr: Optional[str] = None
+        self._reader_tasks: set[asyncio.Task] = set()
+        self._inbound_writers: set[asyncio.StreamWriter] = set()
+        self._sync_waiters: dict[int, asyncio.Future] = {}
+        self._sync_nonce = 0
+        self._started = False
+        self._closing = False
+        self._closed_evt: Optional[asyncio.Event] = None
+        # fault injection (socket-level chaos)
+        self.muted = False
+        self._dropped_links: set[int] = set()
+        self._slow_links: dict[int, float] = {}
+
+    @classmethod
+    def from_config(cls, config, peers: dict[int, str], *,
+                    listen: Optional[str] = None, **kw) -> "SocketComm":
+        """Build from the Configuration transport knobs (the same fields
+        ConfigMirror round-trips through a reconfiguration)."""
+        return cls(
+            config.self_id,
+            listen if listen is not None else config.transport_listen,
+            peers,
+            outbox_cap=config.transport_outbox_cap,
+            backoff_base=config.transport_reconnect_backoff_base,
+            backoff_max=config.transport_reconnect_backoff_max,
+            max_frame_bytes=config.transport_max_frame_bytes,
+            **kw,
+        )
+
+    # ------------------------------------------------------------ lifecycle
+
+    def attach(self, consensus) -> None:
+        """Point ingest at the consensus intake (any object exposing the
+        handle_message_batch / handle_request surface)."""
+        self.consensus = consensus
+
+    @property
+    def bound_addr(self) -> str:
+        """The address actually bound (resolves tcp port 0); valid after
+        :meth:`start`."""
+        return self._bound_addr or self.listen
+
+    async def start(self) -> None:
+        if self._started:
+            return
+        self._started = True
+        self._closing = False
+        self._closed_evt = asyncio.Event()
+        scheme, hostpath, port = parse_addr(self.listen)
+        if scheme == "tcp":
+            self._server = await asyncio.start_server(
+                self._on_connection, host=hostpath, port=port
+            )
+            bound = self._server.sockets[0].getsockname()
+            self._bound_addr = f"tcp://{bound[0]}:{bound[1]}"
+        else:
+            self._server = await asyncio.start_unix_server(
+                self._on_connection, path=hostpath
+            )
+            self._bound_addr = self.listen
+        for peer in self._peers.values():
+            peer.wake = asyncio.Event()
+            if peer.outbox:
+                peer.wake.set()
+            peer.task = create_logged_task(
+                self._peer_sender(peer),
+                name=f"net-send-{self.self_id}->{peer.id}",
+                logger=self.logger,
+            )
+
+    async def close(self) -> None:
+        """Graceful shutdown contract: stop accepting, drain + close every
+        sender, cancel every reader, close every inbound connection — the
+        transport leaves ZERO background tasks and zero open sockets."""
+        if not self._started or self._closing:
+            return
+        self._closing = True
+        self._closed_evt.set()
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+        # senders: wake them so each drains its outbox once and exits
+        for peer in self._peers.values():
+            if peer.wake is not None:
+                peer.wake.set()
+        sender_tasks = [p.task for p in self._peers.values() if p.task]
+        if sender_tasks:
+            await asyncio.gather(*sender_tasks, return_exceptions=True)
+        for peer in self._peers.values():
+            peer.task = None
+        # readers: nothing to drain on the receive side — cancel
+        for task in list(self._reader_tasks):
+            task.cancel()
+        if self._reader_tasks:
+            await asyncio.gather(*self._reader_tasks, return_exceptions=True)
+        self._reader_tasks.clear()
+        for writer in list(self._inbound_writers):
+            writer.close()
+            with contextlib.suppress(Exception):
+                await writer.wait_closed()
+        self._inbound_writers.clear()
+        for fut in self._sync_waiters.values():
+            if not fut.done():
+                fut.cancel()
+        self._sync_waiters.clear()
+        scheme, hostpath, _ = parse_addr(self.listen)
+        if scheme == "uds":
+            import os
+
+            with contextlib.suppress(OSError):
+                os.unlink(hostpath)
+        self._started = False
+
+    # ------------------------------------------------------------ Comm SPI
+
+    def nodes(self) -> list[int]:
+        return sorted([self.self_id, *self._peers.keys()])
+
+    def send_consensus(self, target_id: int, msg: Message) -> None:
+        if self.muted:
+            return
+        self.plane.sends += 1
+        wire = wire_of(msg, self.plane)
+        self._enqueue(target_id, encode_frame(FT_CONSENSUS, wire))
+
+    def broadcast_consensus(self, msg: Message,
+                            targets: Optional[list[int]] = None) -> None:
+        """Encode-once fan-out: ONE canonical encoding, ONE frame object,
+        shared by reference across every peer outbox."""
+        self.plane.broadcasts += 1
+        if self.muted:
+            return  # outbound silence: nothing leaves, nothing encodes
+        t0 = perf_counter()
+        codec0 = self.plane.codec_us
+        frame = encode_frame(FT_CONSENSUS, wire_of(msg, self.plane))
+        for target in (targets if targets is not None else self._peers):
+            if target == self.self_id:
+                continue
+            self._enqueue(target, frame)
+        # disjoint accounting: encode time is already in codec_us
+        self.plane.route_us += (
+            (perf_counter() - t0) * 1e6 - (self.plane.codec_us - codec0)
+        )
+
+    def send_transaction(self, target_id: int, request: bytes) -> None:
+        if self.muted:
+            return
+        self._enqueue(target_id, encode_frame(FT_REQUEST, request))
+
+    # ------------------------------------------------------------ send path
+
+    def _enqueue(self, target: int, frame: bytes) -> None:
+        peer = self._peers.get(target)
+        if peer is None:
+            return
+        if target in self._dropped_links:
+            self.metrics.link_dropped += 1
+            return
+        if len(peer.outbox) >= self.outbox_cap:
+            # loud-but-bounded: drop the OLDEST frame (the protocol's
+            # recovery paths — re-sends, view change, sync — are built for
+            # loss; what it cannot survive is unbounded memory growth)
+            peer.outbox.popleft()
+            self.metrics.outbox_dropped += 1
+            if self.metrics.outbox_dropped % 1000 == 1:
+                self.logger.warnf(
+                    "outbox to peer %d full (cap %d): dropping oldest "
+                    "(%d dropped so far)",
+                    target, self.outbox_cap, self.metrics.outbox_dropped,
+                )
+        peer.outbox.append(frame)
+        if peer.wake is not None:
+            peer.wake.set()
+
+    async def _peer_sender(self, peer: _Peer) -> None:
+        """Connect loop + per-wave flush loop for one directed link."""
+        backoff = self.backoff_base
+        first = True
+        while not self._closing:
+            try:
+                reader, writer = await asyncio.wait_for(
+                    self._dial(peer.addr), timeout=CONNECT_TIMEOUT
+                )
+            except (OSError, asyncio.TimeoutError):
+                self.metrics.connect_failures += 1
+                if self._closing:
+                    return
+                await self._backoff_sleep(backoff)
+                backoff = min(backoff * 2, self.backoff_max)
+                continue
+            self.metrics.connects += 1
+            if not first:
+                self.metrics.reconnects += 1
+            first = False
+            backoff = self.backoff_base
+            peer.connected = True
+            try:
+                hello = Hello(node_id=self.self_id, group=self.group,
+                              key=self.cluster_key)
+                writer.write(encode_frame(FT_HELLO, encode(hello)))
+                await writer.drain()
+                await self._flush_loop(peer, writer)
+                return  # clean close() exit
+            except (OSError, ConnectionError, asyncio.TimeoutError) as e:
+                self.logger.warnf(
+                    "link %d->%d broke (%r); reconnecting",
+                    self.self_id, peer.id, e,
+                )
+            finally:
+                peer.connected = False
+                writer.close()
+                with contextlib.suppress(Exception):
+                    await writer.wait_closed()
+
+    async def _dial(self, addr: str):
+        scheme, hostpath, port = parse_addr(addr)
+        if scheme == "tcp":
+            return await asyncio.open_connection(host=hostpath, port=port)
+        return await asyncio.open_unix_connection(path=hostpath)
+
+    async def _flush_loop(self, peer: _Peer, writer: asyncio.StreamWriter) -> None:
+        """Drain the whole outbox per wakeup and write it as ONE batch —
+        the send-side mirror of wave-batched ingest.  On close(), performs
+        one final drain so frames already accepted are not stranded."""
+        while True:
+            while not peer.outbox and not self._closing:
+                peer.wake.clear()
+                await peer.wake.wait()
+            delay = self._slow_links.get(peer.id)
+            if delay:
+                await asyncio.sleep(delay)
+            batch_len = len(peer.outbox)
+            if batch_len:
+                pending = [peer.outbox.popleft() for _ in range(batch_len)]
+                try:
+                    blob = b"".join(pending)
+                    writer.write(blob)
+                    await writer.drain()
+                except BaseException:
+                    # the link died mid-flush: re-queue the batch at the
+                    # front (new frames may have arrived behind it) so the
+                    # reconnect delivers it instead of silently losing it
+                    peer.outbox.extendleft(reversed(pending))
+                    raise
+                self.metrics.flush_batches += 1
+                self.metrics.frames_sent += batch_len
+                self.metrics.bytes_sent += len(blob)
+            if self._closing and not peer.outbox:
+                return
+
+    async def _backoff_sleep(self, delay: float) -> None:
+        jitter = 1.0 + self.backoff_jitter * (2 * self._rng.random() - 1.0)
+        with contextlib.suppress(asyncio.TimeoutError):
+            # close() sets the event, so a parked reconnect wakes instantly
+            await asyncio.wait_for(self._closed_evt.wait(), delay * jitter)
+
+    # ------------------------------------------------------------ recv path
+
+    async def _on_connection(self, reader: asyncio.StreamReader,
+                             writer: asyncio.StreamWriter) -> None:
+        # runs AS the server's connection task; register for cancellation
+        task = asyncio.current_task()
+        self._reader_tasks.add(task)
+        self._inbound_writers.add(writer)
+        try:
+            await self._serve_connection(reader, writer)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — one bad conn never kills the node
+            self.logger.errorf("inbound connection handler died: %r", e)
+        finally:
+            self._reader_tasks.discard(task)
+            self._inbound_writers.discard(writer)
+            writer.close()
+            with contextlib.suppress(Exception):
+                await writer.wait_closed()
+
+    async def _serve_connection(self, reader: asyncio.StreamReader,
+                                writer: asyncio.StreamWriter) -> None:
+        decoder = FrameDecoder(self.max_frame_bytes)
+        # -- handshake: first frame must be a valid HELLO with our key
+        sender: Optional[int] = None
+        try:
+            # ONE deadline for the whole handshake (not per read: a
+            # trickling dialer must not hold the fd open by sending one
+            # byte per read-timeout window)
+            deadline = asyncio.get_running_loop().time() + HANDSHAKE_TIMEOUT
+            frames: list = []
+            while not frames:
+                remaining = deadline - asyncio.get_running_loop().time()
+                if remaining <= 0:
+                    raise asyncio.TimeoutError("handshake deadline expired")
+                data = await asyncio.wait_for(reader.read(READ_CHUNK), remaining)
+                if not data:
+                    return  # dialer went away before the hello
+                frames = decoder.feed(data)
+            ftype, payload = frames[0]
+            if ftype != FT_HELLO:
+                raise FrameError(f"first frame is type {ftype}, not HELLO")
+            hello = decode(Hello, payload)
+            if hello.key != self.cluster_key:
+                raise FrameError("cluster key mismatch")
+            if hello.node_id == self.self_id or (
+                hello.node_id not in self._peers
+            ):
+                raise FrameError(f"unknown peer id {hello.node_id}")
+            sender = hello.node_id
+            frames = frames[1:]
+        except (FrameError, CodecError, asyncio.TimeoutError) as e:
+            self.metrics.handshake_rejected += 1
+            self.logger.warnf("rejecting inbound connection: %r", e)
+            return
+        # -- steady state: read -> decode frames -> batch-dispatch
+        try:
+            while True:
+                if frames:
+                    await self._dispatch(sender, frames)
+                data = await reader.read(READ_CHUNK)
+                if not data:
+                    return  # peer closed cleanly (its reconnect, our EOF)
+                frames = decoder.feed(data)
+        except (FrameError, CodecError) as e:
+            # poisoned stream: drop THIS connection loudly; the peer's
+            # sender will redial and resume from a clean framing state
+            self.metrics.malformed_frames += 1
+            self.metrics.connections_dropped += 1
+            self.plane.malformed_dropped += 1
+            self.logger.warnf(
+                "dropping connection from peer %s: malformed frame (%r)",
+                sender, e,
+            )
+
+    async def _dispatch(self, sender: int, frames: list) -> None:
+        """Decode (interned) and route one read's frames, preserving
+        arrival order across kinds — the socket twin of testing.network.
+        Node._dispatch, with the same disjoint plane accounting."""
+        if sender in self._dropped_links:
+            self.metrics.link_dropped += len(frames)
+            return
+        plane = self.plane
+        t0 = perf_counter()
+        codec0 = plane.codec_us
+        vote0 = plane.vote_reg_us
+        token = install_plane(plane)
+        poisoned: Optional[CodecError] = None
+        try:
+            run: list = []  # consecutive (sender, msg) consensus pairs
+            for ftype, payload in frames:
+                if ftype == FT_CONSENSUS:
+                    try:
+                        msg = unmarshal_interned(payload, plane)
+                    except CodecError as e:
+                        # flush what already decoded, then poison the conn
+                        poisoned = e
+                        break
+                    run.append((sender, msg))
+                elif ftype == FT_REQUEST:
+                    await self._flush_consensus(run)
+                    if self.consensus is not None:
+                        await self.consensus.handle_request(sender, payload)
+                elif ftype == FT_SYNC_REQ:
+                    await self._flush_consensus(run)
+                    self._serve_sync(sender, payload)
+                elif ftype == FT_SYNC_RESP:
+                    await self._flush_consensus(run)
+                    self._resolve_sync(payload)
+                else:  # FT_HELLO after handshake: tolerated no-op
+                    continue
+            await self._flush_consensus(run)
+        finally:
+            reset_plane(token)
+        plane.ingest_us += (
+            (perf_counter() - t0) * 1e6
+            - (plane.codec_us - codec0)
+            - (plane.vote_reg_us - vote0)
+        )
+        plane.batch_ingests += 1
+        plane.msgs_ingested += len(frames)
+        self.metrics.ingest_batches += 1
+        self.metrics.frames_received += len(frames)
+        self.metrics.bytes_received += sum(len(p) + 5 for _, p in frames)
+        if poisoned is not None:
+            raise poisoned
+
+    async def _flush_consensus(self, run: list) -> None:
+        if not run:
+            return
+        c = self.consensus
+        if c is None:
+            run.clear()
+            return
+        batch_async = getattr(c, "handle_message_batch_async", None)
+        if batch_async is not None:
+            await batch_async(list(run))
+        else:
+            batch_sync = getattr(c, "handle_message_batch", None)
+            if batch_sync is not None:
+                batch_sync(list(run))
+            else:
+                for sender, msg in run:
+                    c.handle_message(sender, msg)
+        run.clear()
+
+    # ------------------------------------------------------------ sync RPC
+
+    def _serve_sync(self, sender: int, payload: bytes) -> None:
+        req = decode(SyncRequest, payload)  # CodecError -> drop conn (caller)
+        self.metrics.sync_requests += 1
+        if self.sync_server is None:
+            return
+        decisions, total = self.sync_server(req.from_height)
+        resp = SyncBatch(
+            nonce=req.nonce,
+            from_height=req.from_height,
+            total_height=total,
+            decisions=decisions[:MAX_SYNC_DECISIONS],
+        )
+        self._enqueue(sender, encode_frame(FT_SYNC_RESP, encode(resp)))
+
+    def _resolve_sync(self, payload: bytes) -> None:
+        resp = decode(SyncBatch, payload)  # CodecError -> drop conn (caller)
+        self.metrics.sync_responses += 1
+        fut = self._sync_waiters.pop(resp.nonce, None)
+        if fut is not None and not fut.done():
+            fut.set_result(resp)
+
+    async def request_sync(self, target: int, from_height: int,
+                           timeout: float = 2.0) -> Optional[SyncBatch]:
+        """One sync round trip to ``target``; None on timeout / peer down."""
+        self._sync_nonce += 1
+        nonce = self._sync_nonce
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._sync_waiters[nonce] = fut
+        req = SyncRequest(nonce=nonce, from_height=from_height)
+        self._enqueue(target, encode_frame(FT_SYNC_REQ, encode(req)))
+        try:
+            return await asyncio.wait_for(fut, timeout)
+        except (asyncio.TimeoutError, asyncio.CancelledError):
+            return None
+        finally:
+            self._sync_waiters.pop(nonce, None)
+
+    # ------------------------------------------------------------ faults
+
+    def mute(self) -> None:
+        """Outbound-only silence (the chaos mute-leader fault)."""
+        self.muted = True
+
+    def unmute(self) -> None:
+        self.muted = False
+
+    def drop_link(self, peer_id: int) -> None:
+        """Blackhole the link with ``peer_id`` in BOTH directions at this
+        node: outbound frames stop enqueuing, inbound frames from it stop
+        dispatching.  Applied on both endpoints by the chaos runner, it is
+        a full partition cut; applied on one, an asymmetric drop."""
+        self._dropped_links.add(peer_id)
+
+    def restore_link(self, peer_id: int) -> None:
+        self._dropped_links.discard(peer_id)
+
+    def slow_link(self, peer_id: int, delay: float) -> None:
+        """Add ``delay`` seconds before every flush to ``peer_id`` (the
+        throttled-WAN-link fault); 0 clears."""
+        if delay > 0:
+            self._slow_links[peer_id] = delay
+        else:
+            self._slow_links.pop(peer_id, None)
+
+    # ------------------------------------------------------------ queries
+
+    def transport_snapshot(self) -> dict:
+        snap = self.metrics.snapshot()
+        snap["peers_connected"] = sum(
+            1 for p in self._peers.values() if p.connected
+        )
+        snap["outbox_backlog"] = sum(len(p.outbox) for p in self._peers.values())
+        return snap

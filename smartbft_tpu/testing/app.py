@@ -130,7 +130,7 @@ class App(Application, Assembler, Comm, Signer, Verifier, RequestInspector,
     def __init__(
         self,
         node_id: int,
-        network: Network,
+        network: Optional[Network],
         shared: SharedLedgers,
         scheduler: Scheduler,
         wal_dir: Optional[str] = None,
@@ -138,6 +138,7 @@ class App(Application, Assembler, Comm, Signer, Verifier, RequestInspector,
         use_metrics: bool = False,
         crypto=None,
         wal_file_size_bytes: Optional[int] = None,
+        comm=None,
     ):
         self.id = node_id
         self.network = network
@@ -157,8 +158,19 @@ class App(Application, Assembler, Comm, Signer, Verifier, RequestInspector,
         self.membership_changed = False
         self.consensus: Optional[Consensus] = None
         self._wal = None
-        self.node = network.add_node(node_id)
-        self.node.consensus = self
+        # transport seam: either the in-process Network (default) or a real
+        # socket transport (smartbft_tpu.net.SocketComm) — the SAME App runs
+        # over both, which is how the socket tests/bench pair against the
+        # in-process rows without touching the protocol stack
+        self.comm = comm
+        if comm is not None:
+            self.node = None
+            comm.attach(self)
+        else:
+            if network is None:
+                raise ValueError("App needs a Network or an explicit comm=")
+            self.node = network.add_node(node_id)
+            self.node.consensus = self
         shared.register(node_id)
         self.metrics = MetricsBundle(InMemoryProvider()) if use_metrics else None
         self.clock = scheduler
@@ -226,17 +238,29 @@ class App(Application, Assembler, Comm, Signer, Verifier, RequestInspector,
     # -- Comm --------------------------------------------------------------
 
     def send_consensus(self, target_id: int, msg) -> None:
+        if self.comm is not None:
+            self.comm.send_consensus(target_id, msg)
+            return
         self.network.send_consensus(self.id, target_id, msg)
 
     def broadcast_consensus(self, msg, targets=None) -> None:
-        # encode-once fan-out: the network marshals once and shares the
-        # wire bytes (and the interned decoded object) across recipients
+        # encode-once fan-out: the transport marshals once and shares the
+        # wire bytes (and, in-process, the interned decoded object) across
+        # recipients
+        if self.comm is not None:
+            self.comm.broadcast_consensus(msg, targets)
+            return
         self.network.broadcast_consensus(self.id, msg, targets)
 
     def send_transaction(self, target_id: int, request: bytes) -> None:
+        if self.comm is not None:
+            self.comm.send_transaction(target_id, request)
+            return
         self.network.send_transaction(self.id, target_id, request)
 
     def nodes(self) -> list[int]:
+        if self.comm is not None:
+            return self.comm.nodes()
         return self.network.node_ids()
 
     # -- Signer ------------------------------------------------------------
@@ -382,6 +406,14 @@ class App(Application, Assembler, Comm, Signer, Verifier, RequestInspector,
             viewchanger_tick_interval=0.2,
             heartbeat_tick_interval=0.2,
         )
+        if self.comm is not None:
+            # real transport: point ingest at the fresh Consensus and open
+            # the sockets; frames enqueued by consensus.start() (heartbeats,
+            # sync) sit in the bounded outboxes until the listener is up
+            self.comm.attach(self.consensus)
+            await self.comm.start()
+            await self.consensus.start()
+            return
         self.node.consensus = self.consensus
         self.node.start()
         await self.consensus.start()
@@ -389,7 +421,10 @@ class App(Application, Assembler, Comm, Signer, Verifier, RequestInspector,
     async def stop(self) -> None:
         if self.consensus is not None:
             await self.consensus.stop()
-        await self.node.stop()
+        if self.comm is not None:
+            await self.comm.close()
+        else:
+            await self.node.stop()
         if self._wal is not None and hasattr(self._wal, "close"):
             self._wal.close()
 

@@ -27,7 +27,11 @@ Soak entry point (CI, behind ``-m slow``)::
     python -m smartbft_tpu.testing.chaos --soak [--rounds N] [--depth K]
 
 runs randomized schedules against a rotation-on pipelined cluster and
-fails loudly on any invariant violation.  ``--shards S`` (with
+fails loudly on any invariant violation.  ``--sockets`` re-proves the
+fault matrix at the SOCKET level: one OS process per replica over the
+real ``smartbft_tpu.net`` transport, with SIGKILL-and-rejoin and
+slow-link rounds driven by the same :class:`ChaosEvent` vocabulary
+(see ``net.cluster.run_socket_schedule``).  ``--shards S`` (with
 ``--engine-faults``) runs the engine-fault soak against S consensus
 groups sharing ONE coalescer/engine — the sharded deployment shape — and
 asserts the breaker open/close cycle affects all shards coherently:
@@ -850,9 +854,29 @@ def main(argv: Optional[list[str]] = None) -> int:
              "one verify plane (implies --engine-faults; breaker cycle must "
              "affect all shards coherently)",
     )
+    ap.add_argument(
+        "--sockets", action="store_true",
+        help="run the fault matrix at the SOCKET level: one OS process per "
+             "replica over real UDS transport (smartbft_tpu.net), SIGKILL-"
+             "and-rejoin + slow-link rounds, wall-clock offsets",
+    )
+    ap.add_argument(
+        "--transport", default="uds", choices=("uds", "tcp"),
+        help="--sockets transport flavor",
+    )
     args = ap.parse_args(argv)
     if not args.soak:
         ap.error("nothing to do: pass --soak")
+    if args.sockets:
+        from ..net.cluster import socket_soak
+
+        socket_soak(
+            rounds=args.rounds,
+            transport=args.transport,
+            requests=args.requests,
+        )
+        print("chaos soak (sockets): all rounds passed")
+        return 0
     if args.shards > 0:
         asyncio.run(
             sharded_soak(

@@ -36,6 +36,8 @@ _MS_FIELDS = (
     "request_pool_submit_timeout",
     "verify_launch_timeout",
     "verify_probe_interval",
+    "transport_reconnect_backoff_base",
+    "transport_reconnect_backoff_max",
 )
 
 _INT_FIELDS = (
@@ -50,8 +52,15 @@ _INT_FIELDS = (
     "pipeline_depth",
     "verify_launch_retries",
     "verify_breaker_threshold",
+    "transport_outbox_cap",
+    "transport_max_frame_bytes",
 )
 
+# transport_listen is deliberately NOT mirrored: like self_id it is a
+# per-node value (each replica binds its OWN address), so carrying the
+# proposer's listen address in a cluster-wide reconfig would overwrite
+# every other replica's.  Consensus restores both per-node fields on
+# receipt via Configuration.with_node_locals.
 _STR_FIELDS = (
     "rotation_granularity",
 )
@@ -79,6 +88,8 @@ class ConfigMirror:
     pipeline_depth: int = 1
     verify_launch_retries: int = 2
     verify_breaker_threshold: int = 3
+    transport_outbox_cap: int = 4096
+    transport_max_frame_bytes: int = 16 * 1024 * 1024
     rotation_granularity: str = "decision"
     request_batch_max_interval_ms: int = 0
     request_forward_timeout_ms: int = 0
@@ -91,6 +102,8 @@ class ConfigMirror:
     request_pool_submit_timeout_ms: int = 0
     verify_launch_timeout_ms: int = 30000
     verify_probe_interval_ms: int = 2000
+    transport_reconnect_backoff_base_ms: int = 50
+    transport_reconnect_backoff_max_ms: int = 2000
     sync_on_start: bool = False
     speed_up_view_change: bool = False
     leader_rotation: bool = False

@@ -309,6 +309,53 @@ def test_sync_stale_state_response_returns_zeros():
     asyncio.run(run())
 
 
+def test_sync_caught_up_keeps_decisions_in_view():
+    """A sync that learns NOTHING new (latest == controller seq) on a node
+    whose latest decision belongs to the current view must count the next
+    decision as latest_dec + 1, not restart the view at 0 — the dec=0
+    restart makes the node reject the leader's correct next proposal
+    forever ("invalid decisions in view"), the wedge the socket
+    kill-rejoin soak hit via wall-clock straggler syncs."""
+    async def run():
+        latest = decision_with(view=1, seq=8, dec=0)
+        sync = FakeSynchronizer(SyncResponse(
+            latest=latest, reconfig=Reconfig(in_latest_decision=False),
+        ))
+        c = make_controller(
+            synchronizer=sync, collector=FakeCollector(None),
+            checkpoint_md=ViewMetadata(view_id=1, latest_sequence=8,
+                                       decisions_in_view=0),
+        )
+        c.curr_view_number = 1
+        view, seq, dec = await c._sync()
+        assert (view, seq, dec) == (1, 9, 1)
+
+    asyncio.run(run())
+
+
+def test_sync_caught_up_restarted_node_adopts_view_with_correct_dec():
+    """Same caught-up shape but the controller restarted at a stale view:
+    the ledger's last decision carries (view 1, dec 0) while the
+    controller still thinks view 0 — adopting view 1 must land at
+    dec = latest_dec + 1 so the node accepts the leader's next
+    proposal."""
+    async def run():
+        latest = decision_with(view=1, seq=8, dec=0)
+        sync = FakeSynchronizer(SyncResponse(
+            latest=latest, reconfig=Reconfig(in_latest_decision=False),
+        ))
+        c = make_controller(
+            synchronizer=sync, collector=FakeCollector(None),
+            checkpoint_md=ViewMetadata(view_id=1, latest_sequence=8,
+                                       decisions_in_view=0),
+        )
+        view, seq, dec = await c._sync()
+        assert (view, seq, dec) == (1, 9, 1)
+        assert c.view_changer.informed == [1]
+
+    asyncio.run(run())
+
+
 def test_sync_reconfig_closes_controller_and_viewchanger():
     async def run():
         sync = FakeSynchronizer(SyncResponse(

@@ -349,6 +349,24 @@ def test_blacklist_after_view_change(tmp_path):
                 scheduler,
                 timeout=240.0,
             )
+            # ...and wait until node 1's VIEW is active at the tip:
+            # reaching the height via sync is not enough — the sync
+            # delivers the ledger (satisfying the height wait above)
+            # BEFORE the controller finishes restarting the view, so
+            # pumping the next decision in that window makes node 1 miss
+            # the pre-prepare, fall one behind, and re-sync — a phase
+            # alignment that repeats every round (observed as a sync
+            # staircase: "Starting view ... sequence N" then immediately
+            # "behind the leader for the last 10 ticks", 8 rounds long)
+            def node1_view_at_tip():
+                vs = apps[0].consensus.controller.view_sequences.load()
+                return (
+                    vs is not None
+                    and vs.view_active
+                    and vs.proposal_seq > apps[1].height()
+                )
+
+            await wait_for(node1_view_at_tip, scheduler, timeout=240.0)
 
         for k in range(8):
             await drive(k)

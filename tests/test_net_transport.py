@@ -1,0 +1,282 @@
+"""Single-process clusters over REAL sockets: the SocketComm behind the
+same App/Consensus stack the in-process Network drives.
+
+Running all n replicas in one asyncio loop (one process) over localhost
+UDS/TCP gives tier-1-speed coverage of the socket plane itself — framing,
+coalesced flushes, reconnect, bounded outboxes, graceful shutdown —
+while ``tests/test_net_cluster.py`` covers the one-OS-process-per-replica
+deployment shape.
+"""
+
+import asyncio
+import gc
+import os
+import tempfile
+
+from smartbft_tpu.messages import Prepare
+from smartbft_tpu.net.cluster import _free_port
+from smartbft_tpu.net.transport import SocketComm
+from smartbft_tpu.testing.app import App, SharedLedgers, fast_config, wait_for
+from smartbft_tpu.utils.clock import Scheduler
+
+
+def _addrs(n: int, transport: str) -> dict[int, str]:
+    if transport == "uds":
+        sockdir = tempfile.mkdtemp(prefix="sbft-t-", dir="/tmp")
+        return {i: f"uds://{sockdir}/n{i}.sock" for i in range(1, n + 1)}
+    return {i: f"tcp://127.0.0.1:{_free_port()}" for i in range(1, n + 1)}
+
+
+def make_socket_apps(n, tmp_path, transport="uds", config_fn=None):
+    addrs = _addrs(n, transport)
+    scheduler = Scheduler()
+    shared = SharedLedgers()
+    apps = []
+    for i in range(1, n + 1):
+        comm = SocketComm(
+            i, addrs[i], {j: a for j, a in addrs.items() if j != i},
+            cluster_key=b"test", backoff_base=0.01, backoff_max=0.2,
+        )
+        cfg = config_fn(i) if config_fn else fast_config(i)
+        apps.append(App(i, None, shared, scheduler,
+                        wal_dir=str(tmp_path / f"wal-{i}"), config=cfg,
+                        comm=comm))
+    return apps, scheduler
+
+
+def _committed(app) -> int:
+    return sum(len(app.requests_from_proposal(d.proposal)) for d in app.ledger())
+
+
+def test_uds_cluster_commits_with_coalesced_flushes(tmp_path):
+    """n=4 over Unix sockets: commits flow, and the send side actually
+    coalesces (frames per flush above 1 — one write per wave, not per
+    frame)."""
+
+    async def run():
+        apps, scheduler = make_socket_apps(4, tmp_path, "uds")
+        for a in apps:
+            await a.start()
+        total = 21
+        for k in range(total):
+            await apps[k % 4].submit("client-a", f"req-{k}")
+        await wait_for(
+            lambda: all(_committed(a) >= total for a in apps), scheduler, 60.0
+        )
+        ref = [d.proposal for d in apps[0].ledger()]
+        for app in apps[1:]:
+            assert [d.proposal for d in app.ledger()] == ref
+        snap = apps[0].comm.transport_snapshot()
+        assert snap["frames_sent"] > 0 and snap["flush_batches"] > 0
+        assert snap["frames_per_flush"] >= 1.0
+        assert snap["frames_sent"] > snap["flush_batches"], (
+            f"no write coalescing happened at all: {snap}"
+        )
+        assert snap["malformed_frames"] == 0 and snap["outbox_dropped"] == 0
+        for a in apps:
+            await a.stop()
+
+    asyncio.run(run())
+
+
+def test_tcp_cluster_commits(tmp_path):
+    async def run():
+        apps, scheduler = make_socket_apps(4, tmp_path, "tcp")
+        for a in apps:
+            await a.start()
+        for k in range(5):
+            await apps[0].submit("client-t", f"req-{k}")
+        await wait_for(
+            lambda: all(_committed(a) >= 5 for a in apps), scheduler, 60.0
+        )
+        for a in apps:
+            await a.stop()
+
+    asyncio.run(run())
+
+
+def test_graceful_shutdown_leaks_no_tasks_or_sockets(tmp_path):
+    """The shutdown contract: close() cancels readers, drains writers,
+    closes listeners — after stop the loop holds ZERO transport tasks and
+    the transports hold zero connections; file descriptors return to the
+    pre-cluster level."""
+
+    async def run():
+        apps, scheduler = make_socket_apps(4, tmp_path, "uds")
+        for a in apps:
+            await a.start()
+        await apps[0].submit("client-a", "req-0")
+        await wait_for(lambda: all(a.height() >= 1 for a in apps),
+                       scheduler, 30.0)
+        for a in apps:
+            await a.stop()
+        # no transport (or any other) background task survived
+        leftovers = [t for t in asyncio.all_tasks()
+                     if t is not asyncio.current_task()]
+        assert not leftovers, f"leaked tasks: {[t.get_name() for t in leftovers]}"
+        for a in apps:
+            comm = a.comm
+            assert comm._server is None
+            assert not comm._reader_tasks
+            assert not comm._inbound_writers
+            assert all(p.task is None for p in comm._peers.values())
+
+    gc.collect()
+    fds_before = len(os.listdir("/proc/self/fd"))
+    asyncio.run(run())
+    gc.collect()
+    fds_after = len(os.listdir("/proc/self/fd"))
+    # the loop itself (epoll/self-pipe) is created and destroyed by
+    # asyncio.run; allow a tiny tolerance for allocator noise
+    assert fds_after <= fds_before + 2, (fds_before, fds_after)
+
+
+def test_restart_is_clean(tmp_path):
+    """App.restart over sockets: close() then start() rebinds the same
+    address and the node rejoins (WAL recovery path unchanged)."""
+
+    async def run():
+        apps, scheduler = make_socket_apps(4, tmp_path, "uds")
+        for a in apps:
+            await a.start()
+        for k in range(4):
+            await apps[0].submit("client-a", f"req-{k}")
+        await wait_for(lambda: all(_committed(a) >= 4 for a in apps),
+                       scheduler, 60.0)
+        await apps[3].restart()
+        for k in range(4, 8):
+            await apps[0].submit("client-a", f"req-{k}")
+        await wait_for(lambda: all(_committed(a) >= 8 for a in apps),
+                       scheduler, 60.0)
+        for a in apps:
+            await a.stop()
+
+    asyncio.run(run())
+
+
+class _Sink:
+    def __init__(self):
+        self.got = []
+
+    def handle_message_batch(self, items):
+        self.got.extend(items)
+
+    async def handle_request(self, sender, req):
+        pass
+
+
+def test_reconnect_with_backoff_after_peer_death():
+    """Kill the receiving endpoint, keep sending (frames buffer in the
+    bounded outbox), bring it back: the sender redials with backoff and
+    the buffered frames arrive — reconnects counted."""
+    sockdir = tempfile.mkdtemp(prefix="sbft-rc-", dir="/tmp")
+    addr_a = f"uds://{sockdir}/a.sock"
+    addr_b = f"uds://{sockdir}/b.sock"
+
+    async def run():
+        a = SocketComm(1, addr_a, {2: addr_b}, cluster_key=b"k",
+                       backoff_base=0.01, backoff_max=0.05)
+        sink = _Sink()
+        a.attach(_Sink())
+        b = SocketComm(2, addr_b, {1: addr_a}, cluster_key=b"k",
+                       backoff_base=0.01, backoff_max=0.05)
+        b.attach(sink)
+        await a.start()
+        await b.start()
+        a.send_consensus(2, Prepare(view=1, seq=1, digest="pre"))
+        deadline = asyncio.get_running_loop().time() + 5.0
+        while not sink.got:
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.01)
+        # peer death
+        await b.close()
+        for s in range(2, 6):
+            a.send_consensus(2, Prepare(view=1, seq=s, digest="buffered"))
+        await asyncio.sleep(0.1)  # sender notices the broken link, backs off
+        # rebirth on the same address
+        b2 = SocketComm(2, addr_b, {1: addr_a}, cluster_key=b"k",
+                        backoff_base=0.01, backoff_max=0.05)
+        sink2 = _Sink()
+        b2.attach(sink2)
+        await b2.start()
+        deadline = asyncio.get_running_loop().time() + 5.0
+        while len(sink2.got) < 4:
+            assert asyncio.get_running_loop().time() < deadline, sink2.got
+            await asyncio.sleep(0.01)
+        assert [m.seq for _, m in sink2.got] == [2, 3, 4, 5]
+        snap = a.transport_snapshot()
+        assert snap["connects"] >= 2, snap  # the redial happened
+        assert snap["connect_failures"] >= 1 or snap["reconnects"] >= 1, snap
+        await a.close()
+        await b2.close()
+
+    asyncio.run(run())
+
+
+def test_outbox_cap_drops_oldest_and_counts():
+    """With the peer unreachable, the outbox must stay bounded: beyond
+    the cap the oldest frame is dropped and counted — never an unbounded
+    queue."""
+    sockdir = tempfile.mkdtemp(prefix="sbft-cap-", dir="/tmp")
+
+    async def run():
+        a = SocketComm(
+            1, f"uds://{sockdir}/a.sock",
+            {2: f"uds://{sockdir}/nonexistent.sock"},
+            cluster_key=b"k", outbox_cap=8,
+            backoff_base=0.01, backoff_max=0.05,
+        )
+        a.attach(_Sink())
+        await a.start()
+        for s in range(1, 21):
+            a.send_consensus(2, Prepare(view=1, seq=s, digest=f"d{s}"))
+        snap = a.transport_snapshot()
+        assert snap["outbox_dropped"] == 12, snap
+        assert snap["outbox_backlog"] == 8, snap
+        peer = a._peers[2]
+        assert len(peer.outbox) == 8
+        await a.close()
+
+    asyncio.run(run())
+
+
+def test_mute_and_drop_link_faults():
+    """The socket twins of the in-process fault primitives, used by the
+    chaos runner: mute silences egress, drop_link blackholes one link in
+    both directions at this endpoint."""
+    sockdir = tempfile.mkdtemp(prefix="sbft-mute-", dir="/tmp")
+    addr_a = f"uds://{sockdir}/a.sock"
+    addr_b = f"uds://{sockdir}/b.sock"
+
+    async def run():
+        a = SocketComm(1, addr_a, {2: addr_b}, cluster_key=b"k",
+                       backoff_base=0.01, backoff_max=0.05)
+        b = SocketComm(2, addr_b, {1: addr_a}, cluster_key=b"k",
+                       backoff_base=0.01, backoff_max=0.05)
+        sink = _Sink()
+        b.attach(sink)
+        a.attach(_Sink())
+        await a.start()
+        await b.start()
+        a.mute()
+        a.broadcast_consensus(Prepare(view=1, seq=1, digest="muted"))
+        a.send_consensus(2, Prepare(view=1, seq=2, digest="muted"))
+        await asyncio.sleep(0.1)
+        assert not sink.got
+        a.unmute()
+        a.drop_link(2)
+        a.send_consensus(2, Prepare(view=1, seq=3, digest="dropped"))
+        await asyncio.sleep(0.1)
+        assert not sink.got
+        assert a.metrics.link_dropped >= 1
+        a.restore_link(2)
+        a.send_consensus(2, Prepare(view=1, seq=4, digest="through"))
+        deadline = asyncio.get_running_loop().time() + 5.0
+        while not sink.got:
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.01)
+        assert sink.got[0][1].seq == 4
+        await a.close()
+        await b.close()
+
+    asyncio.run(run())

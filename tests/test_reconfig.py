@@ -328,3 +328,62 @@ def test_config_mirror_round_trips_pipelined_rotation_fields():
     # self_id is per-node and deliberately not mirrored (consensus applies
     # with_self_id on receipt)
     rt.with_self_id(1).validate()
+
+
+def test_config_mirror_round_trips_transport_fields():
+    """A config-bearing reconfig must carry the socket-transport knobs
+    (outbox cap, reconnect backoff bounds, frame cap) the same way it
+    carries the verify-plane and rotation knobs — dropping them on the
+    wire would silently reset a socket cluster's transport to defaults
+    mid-run.  transport_listen is the exception: it is per-node like
+    self_id (each replica binds its OWN address), so it must NOT travel
+    in the cluster-wide mirror and is restored from the local config on
+    receipt instead."""
+    import dataclasses
+
+    from smartbft_tpu.testing.app import fast_config
+    from smartbft_tpu.testing.reconfig import mirror_config, unmirror_config
+
+    cfg = dataclasses.replace(
+        fast_config(1),
+        transport_listen="tcp://127.0.0.1:9310",
+        transport_outbox_cap=512,
+        transport_reconnect_backoff_base=0.125,
+        transport_reconnect_backoff_max=3.5,
+        transport_max_frame_bytes=64 * 1024 * 1024,
+    )
+    rt = unmirror_config(mirror_config(cfg))
+    assert rt.transport_outbox_cap == 512
+    assert rt.transport_reconnect_backoff_base == 0.125
+    assert rt.transport_reconnect_backoff_max == 3.5
+    assert rt.transport_max_frame_bytes == 64 * 1024 * 1024
+    # the proposer's listen address must not reach other replicas...
+    assert rt.transport_listen == ""
+    assert not hasattr(mirror_config(cfg), "transport_listen")
+    # ...and the consensus-side application restores the LOCAL one
+    # (consensus.py applies current_config.with_node_locals(self.config))
+    applied = rt.with_node_locals(
+        dataclasses.replace(fast_config(3), transport_listen="uds:///n3.sock")
+    )
+    assert applied.self_id == 3
+    assert applied.transport_listen == "uds:///n3.sock"
+    applied.validate()
+
+
+def test_config_validate_rejects_frame_cap_below_batch_bytes():
+    """A frame cap that cannot carry a full proposal wedges the cluster
+    (every full-batch send poisons the receiving connection), so
+    validate() must reject it up front."""
+    import dataclasses
+
+    import pytest
+
+    from smartbft_tpu.config import ConfigError
+    from smartbft_tpu.testing.app import fast_config
+
+    bad = dataclasses.replace(
+        fast_config(1),
+        transport_max_frame_bytes=fast_config(1).request_batch_max_bytes,
+    )
+    with pytest.raises(ConfigError, match="transport_max_frame_bytes"):
+        bad.validate()

@@ -36,6 +36,20 @@ def test_every_create_task_site_is_logged():
     )
 
 
+def test_audit_covers_net_package():
+    """The socket transport's background tasks (per-peer senders, inbound
+    readers) are exactly the kind whose silent death looks like a network
+    partition from outside — pin that smartbft_tpu/net/ is inside the
+    sweep above and actually uses the logged-task helper."""
+    net_files = sorted((PKG / "net").rglob("*.py"))
+    assert net_files, "smartbft_tpu/net/ vanished from the audit sweep"
+    transport = (PKG / "net" / "transport.py").read_text()
+    assert "create_logged_task(" in transport, (
+        "SocketComm must spawn its background tasks via "
+        "utils.tasks.create_logged_task"
+    )
+
+
 def test_create_logged_task_logs_background_death():
     from smartbft_tpu.utils.tasks import create_logged_task
 
