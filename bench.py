@@ -36,7 +36,10 @@ Sharded mode: ``--shards 1,2,4`` (or SMARTBFT_BENCH_SHARDS) additionally
 runs the benchmarks/sharded.py sweep — S consensus groups over ONE shared
 verify plane — and prints a second JSON line whose ``shard`` block
 carries the per-shard + aggregate numbers (tx/s, launch fill, cross-shard
-wave mix) plus the S=top-vs-S=1 scaling ratio.
+wave mix) plus the S=top-vs-S=1 scaling ratio, and whose ``reshard``
+block carries the LIVE-resize walk (epoch transitions under load:
+per-phase tx/s tracking S, moved-key fraction, drain ms, paused-submit
+window — PERF.md round 11).
 
 Transport mode: ``--transport {inproc,tcp,uds}`` (or
 SMARTBFT_BENCH_TRANSPORT) additionally runs benchmarks/transport.py —
@@ -300,8 +303,13 @@ def sharded_bench(shards: str, cpu_mode: bool) -> None:
     points = max(1, len([s for s in shards.split(",") if s.strip()]))
     point_timeout = float(os.environ.get(
         "SMARTBFT_BENCH_SHARD_POINT_TIMEOUT", "120"))
+    # + the live-resize walk (3 phases x worst case of a full drain
+    # deadline PLUS a full settle wait each) so a stuck transition
+    # degrades inside the child (which salvages the sweep rows) instead
+    # of this parent SIGKILLing the whole shard block
     timeout = float(os.environ.get(
-        "SMARTBFT_BENCH_SHARD_TIMEOUT", str(3 * points * point_timeout + 120)))
+        "SMARTBFT_BENCH_SHARD_TIMEOUT",
+        str((3 * points + 6) * point_timeout + 120)))
     proc = subprocess.run(
         cmd, timeout=timeout, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
     )
@@ -312,6 +320,7 @@ def sharded_bench(shards: str, cpu_mode: bool) -> None:
     rows = [json.loads(l) for l in proc.stdout.decode().splitlines() if l.strip()]
     points = [r for r in rows if "shards" in r and "tx_per_sec" in r]
     scaling = next((r for r in rows if r.get("metric") == "sharded_scaling"), {})
+    resize = next((r for r in rows if r.get("metric") == "live_resize"), {})
     if not points:
         raise RuntimeError("sharded sweep produced no rows")
     peak = max(points, key=lambda r: r["shards"])
@@ -334,6 +343,15 @@ def sharded_bench(shards: str, cpu_mode: bool) -> None:
             # deltas, pool, decisions) + the shared-plane aggregate
             "top": peak.get("shard"),
         },
+        # the elastic-shards contract (ISSUE 7): aggregate tx/s tracking S
+        # across a LIVE resize, plus the epoch-transition costs (moved
+        # keys, drain ms, paused-submit window) per reshard
+        "reshard": {
+            "path": resize.get("path"),
+            "phases": resize.get("phases"),
+            "tracking_vs_first": resize.get("tracking_vs_first"),
+            **(resize.get("reshard") or {}),
+        } if resize else None,
     }), flush=True)
 
 

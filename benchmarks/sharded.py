@@ -281,10 +281,140 @@ async def run_sweep_point(S: int, args, pad_sizes) -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+async def run_live_resize(args, pad_sizes) -> dict:
+    """Aggregate tx/s tracking S across a LIVE resize (ISSUE 7).
+
+    One cluster walks ``--resize-path`` (default 2 -> 4 -> 3) WITHOUT ever
+    stopping: each phase pumps a load burst through the routed front door
+    with a small worker pool, and every resize runs the full epoch
+    protocol (barrier -> drain -> flip) mid-burst — moved clients park at
+    the barrier, unmoved ones never notice.  The row carries per-phase
+    tx/s (the resize transition INSIDE the measured window — downtime
+    would show up here) and the ``reshard`` block: epochs, moved-key
+    fraction, drain ms, and the paused-submit window per transition."""
+    import itertools
+
+    from smartbft_tpu.utils.clock import WallClockDriver
+
+    path = [int(x) for x in args.resize_path.split(",")]
+    tmp = tempfile.mkdtemp(prefix="bench-live-resize-")
+    cluster = build_cluster(
+        tmp, shards=path[0], nodes=args.nodes, depth=args.pipeline,
+        batch=args.batch, requests=args.decisions * args.batch,
+        engine_kind=args.engine, crypto=args.crypto, window=args.window,
+        launch_cost=args.launch_cost, pad_sizes=pad_sizes,
+    )
+    # the transition's bounded drain shares the per-phase salvage budget
+    cluster.set.drain_deadline = POINT_TIMEOUT
+    driver = WallClockDriver(cluster.scheduler, tick_interval=0.01)
+    phases = []
+    transitions = []
+    try:
+        driver.start()
+        await cluster.start()
+        for phase_no, target in enumerate(path):
+            total = args.decisions * args.batch * target
+            counter = itertools.count()
+            base = cluster.committed_requests()  # polls shards into the mux
+            old_s = cluster.set.num_shards
+
+            async def worker():
+                while True:
+                    k = next(counter)
+                    if k >= total:
+                        return
+                    # route over the ACTIVE epoch's shard count (mid-flip
+                    # the set may already hold the new groups)
+                    s_active = cluster.set.router.shards_at(cluster.set.epoch)
+                    cid = cluster.client_for_shard(k % s_active, k % 4)
+                    await cluster.submit(cid, f"lr-{phase_no}-{k}")
+
+            t0 = time.perf_counter()
+            pump = asyncio.gather(*(worker() for _ in range(6)))
+            summary = None
+            try:
+                if target != old_s:
+                    # the burst is underway: resize NOW
+                    await asyncio.sleep(0.2)
+                    summary = await cluster.reshard(target)
+                    transitions.append(summary)
+                await pump
+            except BaseException:
+                # a failed transition must not leave 6 workers submitting
+                # into a cluster the finally block is about to tear down
+                pump.cancel()
+                try:
+                    await pump
+                except Exception:
+                    pass
+                raise
+            # barrier commands ride the old shards' streams as ordinary
+            # requests — they count toward the committed-id delta
+            expect = total + (old_s if summary else 0)
+            deadline = time.perf_counter() + POINT_TIMEOUT
+            while time.perf_counter() < deadline:
+                if cluster.committed_requests() - base >= expect:
+                    break
+                await asyncio.sleep(0.02)
+            else:
+                raise TimeoutError(
+                    f"live-resize phase S={target}: committed "
+                    f"{cluster.committed_requests() - base} of {expect}"
+                )
+            elapsed = time.perf_counter() - t0
+            cluster.check_invariants()
+            phase = {
+                "shards": target,
+                "epoch": cluster.set.epoch,
+                "tx_per_sec": round(total / elapsed, 1),
+                "requests": total,
+                "elapsed_s": round(elapsed, 2),
+            }
+            if summary is not None:
+                phase["resize"] = {
+                    "from": summary["old"], "to": summary["new"],
+                    "epoch": summary["epoch"],
+                    "moved_fraction": summary["moved_fraction"],
+                    "drain_ms": summary["drain_ms"],
+                    "paused_submit_ms": summary["paused_submit_ms"],
+                    "parked_submits_peak": summary["parked_submits_peak"],
+                }
+            phases.append(phase)
+            _log(f"live-resize[{target}]: {phase['tx_per_sec']} tx/s"
+                 + (f" (epoch {summary['epoch']}, drain "
+                    f"{summary['drain_ms']}ms, paused "
+                    f"{summary['paused_submit_ms']}ms)" if summary else ""))
+        reshard_block = cluster.set.stats_block()["reshard"]
+        return {
+            "metric": "live_resize",
+            "path": path,
+            "engine": args.engine,
+            "phases": phases,
+            # tx/s tracking S: per-phase throughput ratio vs the first phase
+            "tracking_vs_first": [
+                round(p["tx_per_sec"] / phases[0]["tx_per_sec"], 3)
+                if phases[0]["tx_per_sec"] else 0.0
+                for p in phases
+            ],
+            "reshard": dict(reshard_block, transitions_detail=transitions),
+        }
+    finally:
+        try:
+            await cluster.stop()
+        except Exception:
+            pass
+        await driver.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--shards", default="1,2,4,8",
                     help="comma-separated shard counts to sweep")
+    ap.add_argument("--resize-path", default="2,4,3",
+                    help="shard counts a LIVE resize walks under load "
+                         "(one cluster, epoch protocol mid-burst); '' "
+                         "skips the live_resize row")
     ap.add_argument("--nodes", type=int, default=4, help="replicas per shard")
     ap.add_argument("--batch", type=int, default=50)
     ap.add_argument("--decisions", type=int, default=12,
@@ -379,6 +509,14 @@ def main() -> None:
                 by_s[4]["tx_per_sec"] / base["tx_per_sec"], 3
             ) if base["tx_per_sec"] else 0.0
         print(json.dumps(line), flush=True)
+
+    if args.resize_path.strip():
+        try:
+            print(json.dumps(asyncio.run(run_live_resize(args, pad_sizes))),
+                  flush=True)
+        except Exception as exc:  # noqa: BLE001 — the live-resize row is
+            # additive; a stuck phase must not cost the sweep rows above
+            _log(f"live-resize: FAILED — {exc!r}")
 
 
 if __name__ == "__main__":

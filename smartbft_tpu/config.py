@@ -172,6 +172,29 @@ class Configuration:
     transport_reconnect_backoff_max: float = 2.0
     transport_max_frame_bytes: int = 16 * 1024 * 1024
 
+    # Elastic shards (smartbft_tpu/shard/ — no reference counterpart: the
+    # reference is one consensus instance; sharding and live resharding are
+    # this codebase's scale story).  Consumed by ShardSet.reshard and
+    # shard.autoscale.OccupancyAutoscaler.from_config; round-tripped by
+    # testing.reconfig.ConfigMirror so a reconfiguration cannot silently
+    # reset the elasticity envelope.
+    # - reshard_drain_deadline: wall-clock seconds a live reshard may
+    #   spend waiting for barrier commits + moved-key-range drain before
+    #   the transition aborts and parked moved-client submits raise
+    #   ShardEpochError (unmoved clients are never delayed).
+    # - autoscale_high_occupancy / autoscale_low_occupancy: combined pool
+    #   fill fractions (ShardSet.occupancy()['fill']) above which the
+    #   autoscaler scales OUT / below which it scales IN.
+    # - autoscale_cooldown: seconds after any reshard (executed or failed)
+    #   before the autoscaler decides again — the anti-flap gate.
+    # - autoscale_min_shards / autoscale_max_shards: the elasticity bounds.
+    reshard_drain_deadline: float = 30.0
+    autoscale_high_occupancy: float = 0.85
+    autoscale_low_occupancy: float = 0.15
+    autoscale_cooldown: float = 60.0
+    autoscale_min_shards: int = 1
+    autoscale_max_shards: int = 8
+
     def validate(self) -> None:
         def positive(name: str) -> None:
             v = getattr(self, name)
@@ -204,8 +227,23 @@ class Configuration:
             "transport_reconnect_backoff_base",
             "transport_reconnect_backoff_max",
             "transport_max_frame_bytes",
+            "reshard_drain_deadline",
+            "autoscale_cooldown",
         ):
             positive(field)
+        if not (0.0 < self.autoscale_low_occupancy
+                < self.autoscale_high_occupancy <= 1.0):
+            raise ConfigError(
+                "autoscale occupancy thresholds must satisfy "
+                "0 < low < high <= 1, got "
+                f"low={self.autoscale_low_occupancy} "
+                f"high={self.autoscale_high_occupancy}"
+            )
+        if not (1 <= self.autoscale_min_shards <= self.autoscale_max_shards):
+            raise ConfigError(
+                "autoscale shard bounds must satisfy 1 <= min <= max, got "
+                f"{self.autoscale_min_shards}..{self.autoscale_max_shards}"
+            )
         if self.verify_launch_retries < 0:
             raise ConfigError("verify_launch_retries should not be negative")
         if self.transport_reconnect_backoff_base > self.transport_reconnect_backoff_max:

@@ -348,6 +348,15 @@ class Consensus:
             return {}
         return self.pool.occupancy()
 
+    def pool_pending_infos(self) -> list:
+        """RequestInfos still pooled on this node (empty before start) —
+        the per-shard drain probe of a live reshard (shard front doors
+        union this over a shard's replicas to decide when a moved
+        key-range has fully drained)."""
+        if self.pool is None:
+            return []
+        return self.pool.pending_infos()
+
     # ------------------------------------------------------------------ wiring
 
     def validate_configuration(self, nodes: list[int]) -> None:

@@ -233,6 +233,14 @@ class Pool:
             "waiters": len(self._space_waiters),
         }
 
+    def pending_infos(self) -> list[RequestInfo]:
+        """Every request still pooled (including in-flight reservations),
+        FIFO order.  The live-reshard drain barrier reads this: a moved
+        key-range has drained exactly when no pool in the old shard still
+        holds one of its clients' requests — committing past the epoch
+        flip on the wrong side would double-deliver."""
+        return list(self._items.keys())
+
     def next_requests(
         self, max_count: int, max_size_bytes: int, check: bool
     ) -> tuple[list[bytes], bool]:

@@ -273,6 +273,46 @@ class SocketCluster:
         self.control(via).call(cmd="submit", client=client, rid=rid,
                                payload=payload.hex())
 
+    def trigger_reshard(self, epoch: int, old_shards: int, new_shards: int,
+                        *, via: Optional[int] = None,
+                        timeout: float = 30.0) -> dict:
+        """Control-plane reshard trigger for a multi-process group: order
+        epoch ``epoch``'s barrier command through the (leader's) ordered
+        stream, then wait until EVERY live replica's ledger carries it —
+        the resize decision is then durable cluster-wide, and the manager
+        of S such groups can proceed with drain + flip exactly like the
+        in-process ShardSet.  Returns ``{"epoch": e, "barriers": {node:
+        ledger seq}}``; raises TimeoutError if any replica fails to order
+        it in time (re-triggering is idempotent — pool client dedup)."""
+        deadline = time.monotonic() + timeout
+        barriers: dict[int, int] = {}
+        while time.monotonic() < deadline:
+            # (re-)issue the trigger every tick — idempotent under pool
+            # client dedup, and exactly what survives the ordering replica
+            # dying with the command still pooled (the in-process
+            # _barrier_step re-submits on every poll for the same reason)
+            try:
+                target = via if via is not None else self.wait_leader(
+                    timeout=2.0)
+                self.control(target).call(cmd="reshard", epoch=epoch,
+                                          old=old_shards, new=new_shards)
+            except (OSError, ControlError, TimeoutError):
+                pass  # leaderless interregnum / target down: retry next tick
+            barriers = {}
+            for i in self.live_ids():
+                try:
+                    resp = self.control(i).call(cmd="barrier", epoch=epoch)
+                    barriers[i] = int(resp.get("barrier_seq", 0))
+                except (OSError, ControlError):
+                    barriers[i] = 0
+            if barriers and all(v > 0 for v in barriers.values()):
+                return {"epoch": epoch, "barriers": barriers}
+            time.sleep(0.1)
+        raise TimeoutError(
+            f"epoch {epoch} barrier not committed on every replica within "
+            f"{timeout}s: {barriers}"
+        )
+
     def committed(self, node_id: int) -> int:
         return self.control(node_id).call(cmd="committed")["committed"]
 

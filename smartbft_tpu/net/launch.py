@@ -181,6 +181,12 @@ class ReplicaApp(Application, Assembler, Comm, Signer, Verifier,
         self.consensus: Optional[Consensus] = None
         self._wal = None
         self._request_id_cache: BoundedMemo[bytes, RequestInfo] = BoundedMemo()
+        #: epoch -> committed barrier ledger seq (immutable once found) and
+        #: epoch -> ledger index already scanned without finding it — the
+        #: reshard manager polls barrier_seq every ~100 ms, so each poll
+        #: must cost O(new entries), not O(ledger)
+        self._barrier_seqs: dict[int, int] = {}
+        self._barrier_scan: dict[int, int] = {}
 
     # ------------------------------------------------------------ app SPI
 
@@ -446,6 +452,31 @@ class ReplicaApp(Application, Assembler, Comm, Signer, Verifier,
             h.update(d.proposal.metadata)
         return h.hexdigest()
 
+    def barrier_seq(self, epoch: int) -> int:
+        """Ledger position (1-based) of epoch ``epoch``'s committed
+        reshard barrier command, 0 while it has not committed here.  The
+        cluster manager polls this on every replica after a control-plane
+        ``reshard`` trigger: once non-zero everywhere, the resize decision
+        is ordered — it rode the stream, not a side channel.  Memoized
+        (the position never changes once committed) and incrementally
+        scanned, so the manager's poll loop costs O(new entries) per call
+        instead of re-decoding the whole ledger on every tick."""
+        from ..shard.epoch import barrier_marker
+
+        found = self._barrier_seqs.get(epoch)
+        if found:
+            return found
+        marker = barrier_marker(epoch)
+        with self.lock:
+            ledger = list(self.ledger)
+        for idx in range(self._barrier_scan.get(epoch, 0), len(ledger)):
+            infos = self.requests_from_proposal(ledger[idx].proposal)
+            if any(str(i) == marker for i in infos):
+                self._barrier_seqs[epoch] = idx + 1
+                return idx + 1
+        self._barrier_scan[epoch] = len(ledger)
+        return 0
+
 
 def _config_from_spec(spec: dict) -> Configuration:
     import dataclasses
@@ -534,6 +565,32 @@ class ControlServer:
             pool = r.consensus.pool_occupancy() if r.consensus else {}
             return {"ok": True, "height": r.height(),
                     "pool": pool.get("size", 0)}
+        if cmd == "occupancy":
+            # the autoscaler's saturation signal, per replica — a manager
+            # of S socket groups sums these into the ShardSet.occupancy
+            # shape and feeds shard.autoscale.OccupancyAutoscaler
+            occ = r.consensus.pool_occupancy() if r.consensus else {}
+            return {"ok": True, "occupancy": occ}
+        if cmd == "reshard":
+            # control-plane reshard trigger: order epoch `epoch`'s barrier
+            # command through THIS replica's consensus stream (Vertical
+            # Paxos rule — the resize decision must ride the ordered
+            # stream).  Idempotent: the pool's client dedup absorbs
+            # re-triggers after a manager crash.  Construction shared with
+            # the in-process harness (testing.app.submit_barrier_request)
+            # so the barrier marker can never drift between the two.
+            from ..testing.app import submit_barrier_request
+
+            epoch = int(req["epoch"])
+            await submit_barrier_request(
+                r.consensus, epoch, int(req.get("old", 1)), int(req["new"])
+            )
+            return {"ok": True, "epoch": epoch,
+                    "barrier_seq": r.barrier_seq(epoch)}
+        if cmd == "barrier":
+            epoch = int(req["epoch"])
+            return {"ok": True, "epoch": epoch,
+                    "barrier_seq": r.barrier_seq(epoch)}
         if cmd == "committed":
             return {"ok": True, "committed": r.committed_requests(),
                     "height": r.height()}

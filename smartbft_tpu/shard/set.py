@@ -10,11 +10,29 @@ totally-ordered chain — and the ShardSet owns everything that spans them:
   applies; ``occupancy`` exposes the combined surface);
 * the **delivery multiplexer**: ``poll_committed`` drains each shard's
   newly committed decisions into one :class:`~smartbft_tpu.shard.mux.
-  DeliveryMux` stream, enforcing per-shard exactly-once/gapless;
+  DeliveryMux` stream, enforcing per-shard exactly-once/gapless, and
+  prunes applied entries automatically behind a bounded retention window;
+* the **epoch state machine**: ``reshard`` grows or shrinks the set UNDER
+  LIVE TRAFFIC — the resize decision commits through each old shard's own
+  ordered stream as a barrier command, moved key-ranges drain behind the
+  barrier, the router flips atomically to the new epoch, and the mux
+  stays gapless/exactly-once across the transition.  Every transition
+  edge is journaled (:class:`~smartbft_tpu.shard.epoch.EpochJournal`) so
+  a coordinator crash mid-drain, mid-handoff, or mid-flip recovers into
+  the correct epoch;
 * **metrics roll-up**: ``stats_block`` emits per-shard blocks (decisions,
   committed requests, pool occupancy, protocol-plane delta) plus the
   aggregate, including the shared verify plane's cross-shard wave
-  attribution when a coalescer is attached.
+  attribution when a coalescer is attached, and a ``reshard`` block
+  (epoch, transition count, last transition's barriers/drain/pause).
+
+The live-reshard contract at the front door: submits for UNMOVED clients
+never notice a transition; submits for MOVED clients park until the flip
+(they then route to their new shard) and raise the single loud
+:class:`~smartbft_tpu.shard.epoch.ShardEpochError` only when the bounded
+drain deadline expires first.  There are still NO cross-shard
+transactions — resharding moves key-ranges between groups, it does not
+order across them.
 
 The ShardSet is deliberately generic over a small shard-handle protocol
 (duck-typed; see :class:`ShardHandle`) so the same front door drives the
@@ -33,10 +51,21 @@ shards coherently because it IS one plane.
 from __future__ import annotations
 
 import abc
-from typing import Optional, Sequence
+import asyncio
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional, Sequence
 
+from .epoch import (
+    RESHARD_CLIENT,
+    EpochJournal,
+    ShardEpochError,
+    barrier_marker,
+    recover_epochs,
+)
 from .mux import DeliveryMux, ShardStreamViolation
 from .router import ShardRouter
+from ..utils.tasks import create_logged_task
 
 __all__ = ["ShardHandle", "ShardSet"]
 
@@ -74,18 +103,95 @@ class ShardHandle(abc.ABC):
         """Optional per-shard extras merged into the roll-up."""
         return {}
 
+    # -- live-reshard surface (optional; reshard() requires them) ----------
+
+    async def submit_barrier(self, epoch: int, old_shards: int,
+                             new_shards: int) -> None:
+        """Submit epoch ``epoch``'s barrier command into this shard's
+        ordered stream (client ``epoch.RESHARD_CLIENT``, request id
+        ``epoch.barrier_request_id(epoch)``, payload
+        ``epoch.reshard_command_payload(...)`` in the embedder's request
+        envelope).  MUST swallow the embedder's already-exists /
+        already-processed dedup errors: a recovered coordinator
+        re-submits, and the pool's client dedup makes that exactly-once."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support live reshard"
+        )
+
+    def pending_client_ids(self) -> Optional[set]:
+        """Client ids with requests still pooled (un-committed) anywhere
+        in this shard — the drain predicate's input.  None means the
+        handle cannot report, and the drain falls back to barrier-only."""
+        return None
+
+    def ready(self) -> bool:
+        """Can this shard serve submits (e.g. a leader is elected)?  The
+        flip waits for every NEW group's readiness so released moved-key
+        submitters land on a shard that can actually order them."""
+        return True
+
+    def space_waiters(self) -> int:
+        """Submitters blocked in this shard's pool space-wait (their
+        requests are in NO pool yet, so ``pending_client_ids`` cannot see
+        them).  The drain must wait these out too: a waiter admitted
+        after the flip would commit its request on the OLD shard — the
+        wrong side.  Default reads the occupancy block."""
+        occ = self.pool_occupancy() or {}
+        return int(occ.get("waiters", 0))
+
+
+@dataclass
+class _Transition:
+    """One in-flight epoch transition (the reshard coordinator's state)."""
+
+    epoch: int
+    old_s: int
+    new_s: int
+    deadline: float                       # wall-clock (time.monotonic)
+    phase: str = "prepare"                # prepare|barrier|drain|flip
+    barriers: dict = field(default_factory=dict)   # shard -> barrier seq
+    barrier_submitted_at: dict = field(default_factory=dict)  # shard -> mono
+    flip_event: asyncio.Event = field(default_factory=asyncio.Event)
+    failed: Optional[str] = None
+    parked: int = 0
+    parked_peak: int = 0
+    moved_cache: dict = field(default_factory=dict)
+    started: float = field(default_factory=time.monotonic)
+    drain_ms: float = 0.0
+
+    def moved(self, router: ShardRouter, client_id) -> bool:
+        key = str(client_id)
+        if key == RESHARD_CLIENT:
+            return False
+        hit = self.moved_cache.get(key)
+        if hit is None:
+            hit = router.moved(client_id, self.old_s, self.new_s)
+            self.moved_cache[key] = hit
+        return hit
+
 
 class ShardSet:
-    """S shard handles + router + delivery mux behind one surface."""
+    """S shard handles + router + delivery mux + epoch machine behind one
+    surface."""
 
     def __init__(self, shards: Sequence, router: Optional[ShardRouter] = None,
-                 coalescer=None):
+                 coalescer=None, *, journal: Optional[EpochJournal] = None,
+                 drain_deadline: float = 30.0, retention: int = 4096,
+                 on_deliver: Optional[Callable] = None):
         """``shards``: shard handles, one per group; their ``shard_id``
         must be 0..S-1 (the router's bucket space).  ``coalescer``: the
         SHARED AsyncBatchCoalescer all shards verify through — optional,
         but without it the set is just S processes glued together; with it
         ``stats_block`` reports the cross-shard wave mix and breaker
-        state.  ``router`` defaults to a seed-0 ShardRouter over S."""
+        state.  ``router`` defaults to a seed-0 ShardRouter over S.
+
+        ``journal``: the epoch journal (None = transitions are not
+        durable; fine for tests, not for a deployment that reshards).
+        ``drain_deadline``: wall-clock seconds a transition may spend
+        waiting for barriers + moved-range drain before it aborts and
+        parked submits raise ShardEpochError.  ``retention``: max
+        combined entries the mux keeps after they have been handed to the
+        embedder (the automatic prune watermark); <= 0 disables pruning."""
         self.shards = {int(s.shard_id): s for s in shards}
         if sorted(self.shards) != list(range(len(shards))):
             raise ValueError(
@@ -99,14 +205,98 @@ class ShardSet:
                 f"set has {len(shards)}"
             )
         self.coalescer = coalescer
-        self.mux = DeliveryMux(sorted(self.shards))
+        self.journal = journal
+        self.drain_deadline = drain_deadline
+        self.retention = retention
+        self.mux = DeliveryMux(sorted(self.shards), on_deliver=on_deliver)
         #: per-shard chain cursor for poll_committed
         self._chain_pos: dict[int, int] = {s: 0 for s in self.shards}
+        #: shards retired by scale-in flips (stopped, history in the mux)
+        self.retired: dict[int, object] = {}
         self.submitted = 0
+        self._epoch = self.router.epoch
+        self._next_epoch = self._epoch + 1
+        self._transition: Optional[_Transition] = None
+        self.reshard_stats: dict = {"transitions": 0, "aborts": 0,
+                                    "last": None}
+        self._recovered: Optional[dict] = None
+        if journal is not None:
+            self._recover(journal)
+
+    # -- journal recovery --------------------------------------------------
+
+    def _recover(self, journal: EpochJournal) -> None:
+        """Fold a journal replay into this (re)constructed set.
+
+        Completed epochs re-anchor the epoch counter.  An incomplete
+        transition that already journaled its FLIP took effect — the
+        caller must have rebuilt the set with the new epoch's handles (we
+        verify the count) and we complete it with a ``done``.  One that
+        never flipped is aborted (its barrier markers, if any committed,
+        are inert history; its epoch number stays burned)."""
+        facts = recover_epochs(journal.replay())
+        self._recovered = facts
+        epoch = facts["epoch"]
+        self._next_epoch = max(self._next_epoch, facts["next_epoch"])
+        inc = facts["incomplete"]
+        if not (inc is not None and inc["flipped"]) and epoch > 0 \
+                and facts["shards"] is not None \
+                and len(self.shards) != facts["shards"]:
+            # a COMPLETED epoch pins the shard count just as hard as a
+            # flipped-incomplete one: rebuilding with a stale count would
+            # install a mapping that never existed as this epoch, letting
+            # a moved client's pre-crash commit recommit elsewhere.  A
+            # trailing UNFLIPPED prepare does not relax this — it aborts
+            # below and the completed epoch's count still governs.
+            raise ShardEpochError(
+                f"journal says epoch {epoch} completed with "
+                f"{facts['shards']} shards but the set was rebuilt with "
+                f"{len(self.shards)} — recover with that epoch's handles"
+            )
+        if inc is not None:
+            if inc["flipped"]:
+                epoch = max(epoch, inc["epoch"])
+                if len(self.shards) != inc["new"]:
+                    raise ShardEpochError(
+                        f"journal says epoch {inc['epoch']} flipped to "
+                        f"{inc['new']} shards but the set was rebuilt with "
+                        f"{len(self.shards)} — recover with the new epoch's "
+                        f"handles"
+                    )
+                journal.append({"t": "done", "epoch": inc["epoch"]})
+            else:
+                journal.append({
+                    "t": "abort", "epoch": inc["epoch"],
+                    "reason": "coordinator recovery before flip",
+                })
+                self.reshard_stats["aborts"] += 1
+        if epoch > self._epoch:
+            # re-install the recovered epoch so route(epoch=...) history
+            # has the correct anchor (mapping = current handle count)
+            self.router.reshard(len(self.shards), epoch=epoch)
+            self._epoch = epoch
+            self.mux.begin_epoch(epoch, sorted(self.shards))
+        self._next_epoch = max(self._next_epoch, self._epoch + 1)
+
+    # -- basics ------------------------------------------------------------
 
     @property
     def num_shards(self) -> int:
         return len(self.shards)
+
+    @property
+    def epoch(self) -> int:
+        """The ACTIVE epoch this set routes in (the router may know newer
+        installed epochs only transiently, mid-flip)."""
+        return self._epoch
+
+    @property
+    def reshard_in_progress(self) -> bool:
+        return self._transition is not None
+
+    @property
+    def reshard_phase(self) -> Optional[str]:
+        return self._transition.phase if self._transition else None
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -117,54 +307,102 @@ class ShardSet:
     async def stop(self) -> None:
         for s in sorted(self.shards):
             await self.shards[s].stop()
+        if self.journal is not None:
+            self.journal.close()
 
     # -- the front door ----------------------------------------------------
 
     def route(self, client_id) -> int:
-        return self.router.route(client_id)
+        return self.router.route(client_id, epoch=self._epoch)
 
     async def submit(self, client_id, raw_request: bytes) -> int:
-        """Route ``client_id``'s request to its owning shard and forward
-        into that shard's pool.  Returns the shard id it landed on.
+        """Route ``client_id``'s request to its owning shard (in the
+        ACTIVE epoch) and forward into that shard's pool.  Returns the
+        shard id it landed on.
 
         Backpressure is PER SHARD and real: a full pool parks this
         submitter exactly as a single-group deployment would (Pool.submit
         waits up to submit_timeout, then raises), and other shards'
-        intake is unaffected — one hot shard cannot stall the set."""
-        sid = self.router.route(client_id)
+        intake is unaffected — one hot shard cannot stall the set.
+
+        During a live reshard, a client whose key-range is MOVING parks
+        here until the epoch flips (then lands on its new shard); if the
+        bounded drain deadline expires first, it gets ShardEpochError.
+        Unmoved clients submit straight through the whole transition."""
+        tr = self._transition
+        if tr is not None and tr.moved(self.router, client_id):
+            tr.parked += 1
+            tr.parked_peak = max(tr.parked_peak, tr.parked)
+            try:
+                await self._wait_for_flip(tr)
+            finally:
+                tr.parked -= 1
+        sid = self.router.route(client_id, epoch=self._epoch)
         shard = self.shards.get(sid)
         if shard is None:
-            raise ValueError(
-                f"client {client_id!r} routes to shard {sid}, but this set "
-                f"has shards 0..{self.num_shards - 1} — after router."
-                f"reshard() the embedder must rebuild the ShardSet with the "
-                f"new groups (and drain removed ones) before submitting"
+            raise ShardEpochError(
+                f"client {client_id!r} routes to shard {sid} in epoch "
+                f"{self._epoch}, but this set has shards "
+                f"{sorted(self.shards)} — the router was re-pointed "
+                f"outside ShardSet.reshard(); use the epoch protocol"
             )
         await shard.submit(raw_request)
         self.submitted += 1
         return sid
 
+    async def _wait_for_flip(self, tr: _Transition) -> None:
+        remaining = tr.deadline - time.monotonic()
+        try:
+            await asyncio.wait_for(
+                tr.flip_event.wait(), timeout=max(remaining, 0.001)
+            )
+        except asyncio.TimeoutError:
+            raise ShardEpochError(
+                f"epoch {tr.epoch} is still draining its moved key-ranges "
+                f"and the {self.drain_deadline:.1f}s drain deadline expired "
+                f"(phase {tr.phase}, barriers {sorted(tr.barriers)})"
+            ) from None
+        if tr.failed is not None:
+            raise ShardEpochError(
+                f"epoch {tr.epoch} transition failed: {tr.failed}"
+            )
+
     def occupancy(self) -> dict:
         """Combined submit/backpressure surface over the per-shard pools."""
         per = {s: self.shards[s].pool_occupancy() for s in sorted(self.shards)}
         live = [o for o in per.values() if o]
+        total_size = sum(o.get("size", 0) for o in live)
+        total_cap = sum(o.get("capacity", 0) for o in live)
         return {
             "per_shard": per,
-            "total_size": sum(o.get("size", 0) for o in live),
+            "total_size": total_size,
             "total_free": sum(o.get("free", 0) for o in live),
+            "total_capacity": total_cap,
             "total_waiters": sum(o.get("waiters", 0) for o in live),
+            # the autoscaler's saturation signal: filled fraction of the
+            # combined pool capacity (0.0 when nothing is reporting)
+            "fill": (total_size / total_cap) if total_cap else 0.0,
         }
 
     # -- the combined committed stream -------------------------------------
 
     def poll_committed(self) -> list:
-        """Drain newly committed decisions from every shard into the mux.
+        """Drain newly committed decisions from every live shard into the
+        mux.
 
         Returns the new :class:`~smartbft_tpu.shard.mux.CommittedEntry`
         list (combined arrival order).  Raises
         :class:`~smartbft_tpu.shard.mux.ShardStreamViolation` if any
         shard's feed broke gaplessness or exactly-once — the set fails
-        loudly rather than applying a forked shard's entries."""
+        loudly rather than applying a forked shard's entries.
+
+        This is also where two pieces of epoch machinery live: barrier
+        DETECTION (an in-flight transition scans fresh entries for its
+        committed barrier commands and journals each shard's barrier
+        sequence) and the automatic PRUNE (entries handed to the embedder
+        by earlier polls are applied by contract; everything beyond the
+        ``retention`` window below that watermark is dropped, so long
+        soaks do not grow mux memory with history)."""
         start = self.mux.total()
         for sid in sorted(self.shards):
             pos = self._chain_pos[sid]
@@ -173,12 +411,284 @@ class ShardSet:
                 self.mux.ingest(sid, decision, seq=seq,
                                 request_ids=request_ids)
             self._chain_pos[sid] = pos + len(fresh)
-        return self.mux.since(start)
+        out = self.mux.since(start)
+        tr = self._transition
+        if tr is not None and len(tr.barriers) < tr.old_s:
+            marker = barrier_marker(tr.epoch)
+            for e in out:
+                if (e.shard_id < tr.old_s and e.shard_id not in tr.barriers
+                        and marker in e.request_ids):
+                    tr.barriers[e.shard_id] = e.seq
+                    self._journal({"t": "barrier", "epoch": tr.epoch,
+                                   "shard": e.shard_id, "seq": e.seq})
+        if self.retention > 0:
+            # never prune entries not yet returned: `start` IS the
+            # delivered watermark (everything below it left poll_committed
+            # in an earlier call)
+            self.mux.prune(min(start, max(0, self.mux.total()
+                                          - self.retention)))
+        return out
 
     def committed_requests(self, shard_id: Optional[int] = None) -> int:
         if shard_id is not None:
             return self.mux.requests_delivered(shard_id)
-        return sum(self.mux.requests_delivered(s) for s in self.shards)
+        # monotone across flips even when a retired id re-enters as a new
+        # generation (the dead incarnation's count is preserved)
+        return self.mux.requests_total()
+
+    # -- live reshard ------------------------------------------------------
+
+    def _journal(self, record: dict) -> None:
+        if self.journal is not None:
+            self.journal.append(record)
+
+    async def reshard(self, new_shards: int, *,
+                      make_shard: Optional[Callable] = None,
+                      drain_deadline: Optional[float] = None,
+                      poll_interval: float = 0.005) -> dict:
+        """Grow or shrink the set to ``new_shards`` groups UNDER TRAFFIC.
+
+        The epoch protocol, in order (each edge journaled):
+
+        1. **prepare** — allocate the next epoch number (aborted epochs
+           stay burned) and, for scale-out, build + start the new groups
+           via ``make_shard(shard_id, epoch)`` (they receive no client
+           traffic until the flip);
+        2. **barrier** — submit the epoch's barrier command into every
+           OLD shard's ordered stream (retrying through leader churn) and
+           wait until each shard COMMITS it: that sequence is the shard's
+           barrier.  From the moment this coroutine starts, moved-client
+           submits park at the front door;
+        3. **drain** — wait until no OLD shard still pools a moved
+           client's request (retiring shards must drain completely —
+           every key they own is moving) so nothing can commit on the
+           wrong side of the flip;
+        4. **flip** — atomically: install the new epoch in the router,
+           open the new epoch in the mux (hand-off dedup snapshot +
+           watermark), stop retiring shards, release parked submitters
+           into their new shards.
+
+        The whole wait (2+3) is bounded by ``drain_deadline`` wall-clock
+        seconds; expiry aborts the transition (journaled), raises
+        ShardEpochError here AND to every parked submitter, and leaves
+        the set serving the OLD epoch.  Returns the transition summary
+        also stored in ``reshard_stats['last']``."""
+        if self._transition is not None:
+            raise ShardEpochError(
+                f"reshard to {new_shards} refused: epoch "
+                f"{self._transition.epoch} transition already in progress"
+            )
+        s_old = len(self.shards)
+        s_new = int(new_shards)
+        if s_new <= 0:
+            raise ValueError(f"new_shards must be positive, got {s_new}")
+        if s_new == s_old:
+            return {"epoch": self._epoch, "old": s_old, "new": s_new,
+                    "noop": True}
+        if s_new > s_old and make_shard is None:
+            raise ValueError("scale-out needs make_shard(shard_id, epoch)")
+        epoch = self._next_epoch
+        self._next_epoch += 1
+        deadline = time.monotonic() + (drain_deadline or self.drain_deadline)
+        self._journal({"t": "prepare", "epoch": epoch,
+                       "old": s_old, "new": s_new})
+        tr = _Transition(epoch=epoch, old_s=s_old, new_s=s_new,
+                         deadline=deadline)
+        self._transition = tr
+        new_handles: dict[int, object] = {}
+        flipped = False
+        try:
+            for sid in range(s_old, s_new):
+                h = make_shard(sid, epoch)
+                # registered BEFORE start(): a partially started group
+                # (start raised halfway) must still be stopped by the
+                # abort cleanup, not leak its tasks/registrations
+                new_handles[sid] = h
+                await h.start()
+                # visible to polling immediately (it commits nothing until
+                # the flip routes clients to it), so the flip itself stays
+                # a pure metadata operation
+                self.shards[sid] = h
+                self._chain_pos[sid] = 0
+            tr.phase = "barrier"
+            await self._drive(tr, lambda: self._barrier_step(tr),
+                              poll_interval)
+            tr.phase = "drain"
+            drain_t0 = time.monotonic()
+            retiring = list(range(s_new, s_old))
+            await self._drive(tr, lambda: self._drain_step(tr, retiring),
+                              poll_interval)
+            tr.drain_ms = (time.monotonic() - drain_t0) * 1e3
+            # -- flip ------------------------------------------------------
+            # journaled first, then applied SYNCHRONOUSLY (no awaits) so a
+            # cancellation/crash can only land before the flip exists or
+            # after it is fully effective — never in between
+            tr.phase = "flip"
+            self._journal({"t": "flip", "epoch": epoch,
+                           "shards": list(range(s_new))})
+            flipped = True
+            self.router.reshard(s_new, epoch=epoch)
+            self.mux.begin_epoch(epoch, list(range(s_new)),
+                                 retire=retiring, barriers=tr.barriers)
+            stopping = []
+            for sid in retiring:
+                h = self.shards.pop(sid)
+                self._chain_pos.pop(sid, None)
+                self.retired[sid] = h
+                stopping.append(h)
+            self._epoch = epoch
+            tr.flip_event.set()
+            try:
+                self._journal({"t": "done", "epoch": epoch})
+            except OSError:
+                # the flip edge is durable; recovery completes an
+                # unrecorded done identically
+                pass
+            summary = {
+                "epoch": epoch,
+                "old": s_old,
+                "new": s_new,
+                "barriers": dict(sorted(tr.barriers.items())),
+                "moved_fraction": round(
+                    self.router.moved_fraction(s_old, s_new), 4
+                ),
+                "drain_ms": round(tr.drain_ms, 2),
+                # how long moved-key submits could not land (barrier start
+                # to flip) — the "paused submit window" of the bench block
+                "paused_submit_ms": round(
+                    (time.monotonic() - tr.started) * 1e3, 2
+                ),
+                "parked_submits_peak": tr.parked_peak,
+            }
+            self.reshard_stats["transitions"] += 1
+            self.reshard_stats["last"] = summary
+            self._transition = None
+            # teardown of drained, retired groups happens AFTER the
+            # transition is fully committed; noisy stops must not unwind it
+            for h in stopping:
+                try:
+                    await h.stop()
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    pass
+            return summary
+        except BaseException as exc:
+            if flipped:
+                # the transition is journaled and effective — a post-flip
+                # failure (cancelled teardown, done-edge IO error) must
+                # neither journal an abort nor un-flip live state
+                raise
+            tr.failed = f"{type(exc).__name__}: {exc}"
+            try:
+                self._journal({"t": "abort", "epoch": epoch,
+                               "reason": tr.failed})
+            except OSError:
+                # a torn-down coordinator (cancelled mid-transition, journal
+                # dir already gone) must surface the ORIGINAL failure, not
+                # an abort-bookkeeping IO error; recovery treats a missing
+                # abort edge identically (unflipped prepare => abort)
+                pass
+            self.reshard_stats["aborts"] += 1
+            # tear down never-flipped new groups; the old epoch keeps
+            # serving exactly as before
+            for sid, h in new_handles.items():
+                self.shards.pop(sid, None)
+                self._chain_pos.pop(sid, None)
+                try:
+                    await h.stop()
+                except Exception:
+                    pass
+            self._transition = None
+            tr.flip_event.set()  # parked submitters wake and see `failed`
+            raise
+
+    async def _drive(self, tr: _Transition, step: Callable[[], bool],
+                     poll_interval: float) -> None:
+        """Run one transition phase: call ``step`` (True = phase done)
+        until done or the drain deadline expires."""
+        while True:
+            if step():
+                return
+            if time.monotonic() > tr.deadline:
+                raise ShardEpochError(
+                    f"epoch {tr.epoch} drain deadline expired in phase "
+                    f"{tr.phase!r}: barriers={sorted(tr.barriers)}, "
+                    f"needed {tr.old_s}"
+                )
+            await asyncio.sleep(poll_interval)
+
+    #: wall-clock seconds after which an uncommitted barrier is submitted
+    #: AGAIN — a replica crash can lose the pooled command entirely (it
+    #: lived only in that pool), and re-submission is free under client
+    #: dedup, so the barrier phase must keep re-ordering until it COMMITS
+    BARRIER_RESUBMIT_INTERVAL = 0.5
+
+    def _barrier_step(self, tr: _Transition) -> bool:
+        """(Re)submit barrier commands and poll for their commits."""
+        now = time.monotonic()
+        for sid in range(tr.old_s):
+            if sid in tr.barriers:
+                continue
+            last = tr.barrier_submitted_at.get(sid)
+            if last is not None \
+                    and now - last < self.BARRIER_RESUBMIT_INTERVAL:
+                continue
+            h = self.shards.get(sid)
+            if h is None:
+                continue
+            # fire-and-account: _submit_barrier stamps the attempt time and
+            # swallows transient no-leader/full-pool errors so the next
+            # step retries — leader churn mid-reshard is normal, and an
+            # attempt that LANDED but died with its replica re-submits
+            # after the interval above
+            create_logged_task(
+                self._submit_barrier(h, sid, tr),
+                name=f"reshard-barrier-e{tr.epoch}-s{sid}",
+            )
+        self.poll_committed()
+        return len(tr.barriers) >= tr.old_s
+
+    async def _submit_barrier(self, handle, sid: int, tr: _Transition) -> None:
+        if sid in tr.barriers:
+            return
+        tr.barrier_submitted_at[sid] = time.monotonic()
+        try:
+            await handle.submit_barrier(tr.epoch, tr.old_s, tr.new_s)
+        except Exception:
+            # transient (no leader yet / pool full / view change): retry
+            # on a later step immediately.  Embedder dedup errors are
+            # swallowed by submit_barrier itself per the ShardHandle
+            # contract.
+            tr.barrier_submitted_at.pop(sid, None)
+
+    def _drain_step(self, tr: _Transition, retiring: list[int]) -> bool:
+        self.poll_committed()
+        for sid in range(tr.old_s, tr.new_s):
+            if not self.shards[sid].ready():
+                return False
+        # submitters parked in a pool's SPACE wait hold requests no pool
+        # (and no pending_client_ids) can see yet; one admitted after the
+        # flip would commit on the old shard — wait them out (conservative:
+        # any old shard's waiter blocks the drain, attribution is unknown)
+        for sid in range(tr.old_s):
+            h = self.shards.get(sid)
+            if h is not None and h.space_waiters():
+                return False
+        for sid in retiring:
+            pend = self.shards[sid].pending_client_ids()
+            if pend:
+                pend = {c for c in pend if c != RESHARD_CLIENT}
+                if pend:  # every key a retiring shard owns is moving
+                    return False
+        for sid in range(min(tr.old_s, tr.new_s)):
+            pend = self.shards[sid].pending_client_ids()
+            if not pend:
+                continue
+            for c in pend:
+                if tr.moved(self.router, c):
+                    return False
+        return True
 
     # -- metrics roll-up ---------------------------------------------------
 
@@ -196,6 +706,7 @@ class ShardSet:
             per_shard[sid] = block
         agg = {
             "shards": self.num_shards,
+            "epoch": self._epoch,
             "decisions": self.mux.total(),
             "committed_requests": self.committed_requests(),
             "submitted": self.submitted,
@@ -203,4 +714,8 @@ class ShardSet:
         if self.coalescer is not None:
             agg["coalescer"] = self.coalescer.shard_snapshot()
             agg["breaker"] = self.coalescer.fault_snapshot()
-        return {"per_shard": per_shard, "aggregate": agg}
+        reshard = dict(self.reshard_stats)
+        reshard["epoch"] = self._epoch
+        reshard["in_progress"] = self.reshard_phase
+        reshard["watermarks"] = self.mux.snapshot()["watermarks"]
+        return {"per_shard": per_shard, "aggregate": agg, "reshard": reshard}

@@ -58,6 +58,41 @@ class BatchPayload:
             object.__setattr__(self, "requests", [])
 
 
+def barrier_request_bytes(epoch: int, old_shards: int,
+                          new_shards: int) -> bytes:
+    """Epoch ``epoch``'s reshard barrier command in the TestRequest
+    envelope — the ONE construction both the in-process shard harness
+    (AppShard.submit_barrier) and the socket control plane (ControlServer
+    cmd=reshard) order through their streams, so the marker the mux scan
+    and ReplicaApp.barrier_seq look for can never drift between them."""
+    from ..shard.epoch import (
+        RESHARD_CLIENT,
+        barrier_request_id,
+        reshard_command_payload,
+    )
+
+    return encode(TestRequest(
+        client_id=RESHARD_CLIENT,
+        request_id=barrier_request_id(epoch),
+        payload=reshard_command_payload(epoch, old_shards, new_shards),
+    ))
+
+
+async def submit_barrier_request(consensus, epoch: int, old_shards: int,
+                                 new_shards: int) -> None:
+    """Order the barrier command through ``consensus``, treating the
+    pool's already-exists/already-processed dedup as success (a recovered
+    coordinator re-submits; client dedup makes that exactly-once)."""
+    from ..core.pool import ReqAlreadyExistsError, ReqAlreadyProcessedError
+
+    try:
+        await consensus.submit_request(
+            barrier_request_bytes(epoch, old_shards, new_shards)
+        )
+    except (ReqAlreadyExistsError, ReqAlreadyProcessedError):
+        pass
+
+
 def fast_config(self_id: int) -> Configuration:
     """test_app.go:28-46 — tight timeouts for tests."""
     return Configuration(

@@ -120,6 +120,19 @@ class ChaosEvent:
       tunnel-class errors; ``engine_slow`` (``fraction`` seconds of added
       latency); ``engine_permanent``: compile-class error, trips the
       breaker immediately; ``engine_heal``: clear all device faults.
+
+    Elastic-shard actions (consumed by :func:`run_reshard_schedule`
+    against a ``ShardedCluster``; ``shard`` scopes node-shaped actions to
+    one consensus group):
+
+    - ``reshard`` (``count`` = target S): start a live epoch transition
+      (split or merge) under the pump's traffic; held until any earlier
+      transition completes — epochs are serial by design;
+    - ``crash_during_reshard`` (``shard`` + ``node``): crash that replica
+      INSIDE the handoff window — the event holds until a transition is
+      actually in flight, so the crash always lands mid-drain/mid-flip;
+    - ``crash`` / ``restart`` with ``shard`` set: the plain pair, scoped
+      to one group.
     """
 
     at: float
@@ -127,7 +140,8 @@ class ChaosEvent:
     node: Optional[object] = None  # int | "leader" | "faulty"
     groups: tuple = ()
     fraction: float = 1.0
-    count: int = 1  # engine_fail: how many consecutive calls fail
+    count: int = 1  # engine_fail: consecutive failures; reshard: target S
+    shard: Optional[int] = None  # sharded runs: which group a node action hits
 
 
 def mute_leader_schedule(*, mute_at=2.0, heal_at=14.0) -> list[ChaosEvent]:
@@ -831,6 +845,257 @@ async def sharded_soak(
                 )
 
 
+# ---------------------------------------------------------------------- reshard
+
+@dataclass
+class ReshardReport:
+    """What a reshard schedule run observed (the oracle inputs)."""
+
+    submitted_ok: list = field(default_factory=list)   # "client:rid" acked
+    submit_failures: list = field(default_factory=list)
+    reshards: list = field(default_factory=list)       # transition summaries
+    events_fired: list = field(default_factory=list)
+    shard_counts_seen: list = field(default_factory=list)
+
+
+def reshard_schedule(
+    *, out_at=2.0, out_to=4, in_at=10.0, in_to=3,
+    crash_shard: Optional[int] = 0, crash_node: int = 2,
+    restart_at: Optional[float] = 16.0,
+) -> list[ChaosEvent]:
+    """The acceptance timeline: S -> ``out_to`` mid-burst with one replica
+    crashed inside the handoff window, then -> ``in_to``, then the crashed
+    replica rejoins.  The events are held (not dropped) when their
+    precondition is not yet true — ``reshard`` waits for the previous
+    transition to finish, ``crash_during_reshard`` waits for one to be in
+    flight."""
+    events = [ChaosEvent(at=out_at, action="reshard", count=out_to)]
+    if crash_shard is not None:
+        events.append(ChaosEvent(
+            at=out_at + 0.1, action="crash_during_reshard",
+            shard=crash_shard, node=crash_node,
+        ))
+    events.append(ChaosEvent(at=in_at, action="reshard", count=in_to))
+    if crash_shard is not None and restart_at is not None:
+        events.append(ChaosEvent(
+            at=restart_at, action="restart", shard=crash_shard,
+            node=crash_node,
+        ))
+    return events
+
+
+async def run_reshard_schedule(
+    cluster,
+    schedule: list[ChaosEvent],
+    *,
+    requests: int = 24,
+    submit_every: float = 0.2,
+    settle_timeout: float = 400.0,
+    step: float = 0.05,
+) -> ReshardReport:
+    """Drive a ``ShardedCluster`` (built with ``collect_entries=True``)
+    through a reshard timeline under continuous front-door load.
+
+    The pump submits through the routed front door as BACKGROUND tasks: a
+    moved client's submit legitimately parks at the epoch barrier until
+    the flip, and the logical clock must keep advancing underneath it.
+    Reshard transitions also run as background tasks (they poll commits
+    that only happen while the clock here advances).  After the last
+    event and submission, the run continues until every acked request is
+    visible in the combined committed stream.
+
+    Returns the report; exactly-once/gapless are enforced LIVE by the
+    delivery mux (any violation raises out of the transition or the
+    drain), and the caller typically finishes with
+    ``assert_exactly_once_across_epochs``."""
+    from ..shard.epoch import RESHARD_CLIENT
+    from ..utils.tasks import create_logged_task
+
+    assert cluster.set.mux._on_deliver is not None, (
+        "run_reshard_schedule needs ShardedCluster(collect_entries=True)"
+    )
+    report = ReshardReport()
+    pending = sorted(schedule, key=lambda e: e.at)
+    held: list[ChaosEvent] = []
+    submit_tasks: list = []
+    reshard_tasks: list = []
+    now = 0.0
+    submitted = 0
+    next_submit = 0.0
+
+    def _spawn_reshard(target: int) -> None:
+        async def _go():
+            try:
+                report.reshards.append(await cluster.reshard(target))
+            except Exception as e:  # noqa: BLE001 — recorded, checked below
+                report.reshards.append({"failed": repr(e), "target": target})
+
+        reshard_tasks.append(
+            create_logged_task(_go(), name=f"chaos-reshard-{target}")
+        )
+
+    def _spawn_submit(cid: str, rid: str) -> None:
+        async def _go():
+            try:
+                await cluster.submit(cid, rid)
+                report.submitted_ok.append(f"{cid}:{rid}")
+            except Exception as e:  # noqa: BLE001 — a parked submit may
+                # time out at the drain deadline; the oracle only counts
+                # ACKED submissions
+                report.submit_failures.append((f"{cid}:{rid}", repr(e)))
+
+        submit_tasks.append(
+            create_logged_task(_go(), name=f"chaos-submit-{rid}")
+        )
+
+    async def _fire(evt: ChaosEvent) -> bool:
+        """True = consumed; False = precondition not met, hold."""
+        if evt.action == "reshard":
+            if cluster.set.reshard_in_progress:
+                return False
+            _spawn_reshard(int(evt.count))
+        elif evt.action == "crash_during_reshard":
+            if not cluster.set.reshard_in_progress:
+                # if every reshard already finished, the window is gone —
+                # degrade to a plain crash rather than hanging the run
+                if pending or not all(t.done() for t in reshard_tasks):
+                    return False
+            await cluster.shard(evt.shard).crash(evt.node)
+        elif evt.action == "crash":
+            await cluster.shard(evt.shard).crash(evt.node)
+        elif evt.action == "restart":
+            sh = next((s for s in cluster.shard_list
+                       if s.shard_id == evt.shard), None)
+            if sh is not None:
+                await sh.restart(evt.node)
+        else:
+            raise ValueError(f"unknown reshard-schedule action {evt.action}")
+        report.events_fired.append(evt)
+        return True
+
+    deadline = None
+    while True:
+        # 1. fire due events (holding the ones whose precondition waits)
+        due = [e for e in pending if e.at <= now] + held
+        pending = [e for e in pending if e.at > now]
+        held = []
+        for evt in due:
+            if not await _fire(evt):
+                held.append(evt)
+        # 2. pump load over the ACTIVE epoch's shards
+        if submitted < requests and now >= next_submit:
+            s_active = cluster.set.router.shards_at(cluster.set.epoch)
+            sid = submitted % s_active
+            cid = cluster.client_for_shard(sid, submitted % 3)
+            _spawn_submit(cid, f"rs-{submitted}")
+            submitted += 1
+            next_submit = now + submit_every
+        if (not report.shard_counts_seen
+                or report.shard_counts_seen[-1] != cluster.set.num_shards):
+            report.shard_counts_seen.append(cluster.set.num_shards)
+        # 3. exit condition: everything fired, every transition + submit
+        # task done, and every ACKED request visible in the stream
+        idle = (not pending and not held and submitted >= requests
+                and all(t.done() for t in submit_tasks)
+                and all(t.done() for t in reshard_tasks))
+        if idle and deadline is None:
+            deadline = now + settle_timeout
+        if idle:
+            cluster.poll()
+            delivered = {
+                rid
+                for e in cluster.delivered_entries
+                for rid in e.request_ids
+                if not rid.startswith(RESHARD_CLIENT + ":")
+            }
+            if set(report.submitted_ok) <= delivered:
+                break
+        if deadline is not None and now > deadline:
+            raise TimeoutError(
+                f"reshard run did not drain within {settle_timeout}s: "
+                f"acked={len(report.submitted_ok)} "
+                f"delivered={len(cluster.delivered_entries)}"
+            )
+        if now > 3600.0:
+            raise TimeoutError("reshard run exceeded the hard 1h logical cap")
+        # 4. advance logical time in lockstep with the loop
+        await asyncio.sleep(0)
+        cluster.scheduler.advance_by(step)
+        await asyncio.sleep(0.001)
+        now += step
+    return report
+
+
+def assert_exactly_once_across_epochs(cluster, report: ReshardReport) -> None:
+    """The reshard oracle: every ACKED request appears EXACTLY once in the
+    combined committed stream across all epochs (nothing lost, nothing
+    doubled through any handoff), every live shard is fork-free, and at
+    least the scheduled transitions completed."""
+    from collections import Counter
+
+    from ..shard.epoch import RESHARD_CLIENT
+
+    counts = Counter(
+        rid
+        for e in cluster.delivered_entries
+        for rid in e.request_ids
+        if not rid.startswith(RESHARD_CLIENT + ":")
+    )
+    missing = [r for r in report.submitted_ok if counts[r] == 0]
+    dupes = {r: c for r, c in counts.items() if c > 1}
+    assert not missing, f"acked requests never committed: {missing}"
+    assert not dupes, f"requests delivered more than once: {dupes}"
+    failed = [r for r in report.reshards if "failed" in r]
+    assert not failed, f"reshard transitions failed: {failed}"
+    for shard in cluster.shard_list:
+        shard.assert_fork_free()
+
+
+async def reshard_soak(
+    *, rounds: int = 2, n: int = 4, depth: int = 2, seed: int = 1,
+    requests: int = 18, crash: bool = True, verbose: bool = True,
+) -> None:
+    """Elastic-shard soak: every round rides S=2 -> 4 -> 3 mid-burst —
+    with one replica crashed inside the handoff window when ``crash`` —
+    and must lose NOTHING: every acked request exactly once across the
+    epochs, per-shard gapless (mux-enforced live), fork-free."""
+    import tempfile
+
+    rng = random.Random(seed)
+    for r in range(rounds):
+        with tempfile.TemporaryDirectory(prefix="chaos-reshard-") as root:
+            from .sharded import ShardedCluster
+
+            cluster = ShardedCluster(
+                root, shards=2, n=n, depth=depth, seed=seed + r,
+                collect_entries=True, reshard_drain_deadline=120.0,
+            )
+            schedule = reshard_schedule(
+                crash_shard=rng.randrange(2) if crash else None,
+                crash_node=rng.randrange(2, n + 1),
+            )
+            await cluster.start()
+            try:
+                report = await run_reshard_schedule(
+                    cluster, schedule, requests=requests,
+                    settle_timeout=600.0,
+                )
+                assert_exactly_once_across_epochs(cluster, report)
+                assert cluster.set.num_shards == 3, cluster.set.num_shards
+                assert cluster.set.epoch >= 2, cluster.set.epoch
+            finally:
+                await cluster.stop()
+            if verbose:
+                print(
+                    f"reshard round {r}: epochs={cluster.set.epoch} "
+                    f"shards_seen={report.shard_counts_seen} "
+                    f"acked={len(report.submitted_ok)} "
+                    f"parked_failures={len(report.submit_failures)} "
+                    f"reshards={[x.get('epoch') for x in report.reshards]} "
+                    f"— OK"
+                )
+
+
 def main(argv: Optional[list[str]] = None) -> int:
     import argparse
 
@@ -855,6 +1120,12 @@ def main(argv: Optional[list[str]] = None) -> int:
              "affect all shards coherently)",
     )
     ap.add_argument(
+        "--reshard", action="store_true",
+        help="run the elastic-shard soak: S=2->4->3 live resharding "
+             "mid-burst with a replica crash inside the handoff window; "
+             "exactly-once across epochs + fork-free + gapless pinned",
+    )
+    ap.add_argument(
         "--sockets", action="store_true",
         help="run the fault matrix at the SOCKET level: one OS process per "
              "replica over real UDS transport (smartbft_tpu.net), SIGKILL-"
@@ -876,6 +1147,17 @@ def main(argv: Optional[list[str]] = None) -> int:
             requests=args.requests,
         )
         print("chaos soak (sockets): all rounds passed")
+        return 0
+    if args.reshard:
+        asyncio.run(
+            reshard_soak(
+                rounds=args.rounds,
+                depth=min(args.depth, 4),
+                seed=args.seed,
+                requests=args.requests,
+            )
+        )
+        print("chaos soak (reshard): all rounds passed")
         return 0
     if args.shards > 0:
         asyncio.run(

@@ -38,6 +38,16 @@ _MS_FIELDS = (
     "verify_probe_interval",
     "transport_reconnect_backoff_base",
     "transport_reconnect_backoff_max",
+    "reshard_drain_deadline",
+    "autoscale_cooldown",
+)
+
+# occupancy fractions travel as integer basis points (x/10000): the codec
+# carries ints natively and 1 bp resolution is far below any meaningful
+# autoscale threshold difference
+_BP_FIELDS = (
+    "autoscale_high_occupancy",
+    "autoscale_low_occupancy",
 )
 
 _INT_FIELDS = (
@@ -54,6 +64,8 @@ _INT_FIELDS = (
     "verify_breaker_threshold",
     "transport_outbox_cap",
     "transport_max_frame_bytes",
+    "autoscale_min_shards",
+    "autoscale_max_shards",
 )
 
 # transport_listen is deliberately NOT mirrored: like self_id it is a
@@ -90,6 +102,10 @@ class ConfigMirror:
     verify_breaker_threshold: int = 3
     transport_outbox_cap: int = 4096
     transport_max_frame_bytes: int = 16 * 1024 * 1024
+    autoscale_min_shards: int = 1
+    autoscale_max_shards: int = 8
+    autoscale_high_occupancy_bp: int = 8500
+    autoscale_low_occupancy_bp: int = 1500
     rotation_granularity: str = "decision"
     request_batch_max_interval_ms: int = 0
     request_forward_timeout_ms: int = 0
@@ -104,6 +120,8 @@ class ConfigMirror:
     verify_probe_interval_ms: int = 2000
     transport_reconnect_backoff_base_ms: int = 50
     transport_reconnect_backoff_max_ms: int = 2000
+    reshard_drain_deadline_ms: int = 30000
+    autoscale_cooldown_ms: int = 60000
     sync_on_start: bool = False
     speed_up_view_change: bool = False
     leader_rotation: bool = False
@@ -125,6 +143,7 @@ def mirror_config(config: Configuration) -> ConfigMirror:
     kwargs.update({f: getattr(config, f) for f in _STR_FIELDS})
     kwargs.update({f: getattr(config, f) for f in _BOOL_FIELDS})
     kwargs.update({f + "_ms": round(getattr(config, f) * 1000) for f in _MS_FIELDS})
+    kwargs.update({f + "_bp": round(getattr(config, f) * 10000) for f in _BP_FIELDS})
     return ConfigMirror(**kwargs)
 
 
@@ -133,6 +152,7 @@ def unmirror_config(m: ConfigMirror) -> Configuration:
     kwargs.update({f: getattr(m, f) for f in _STR_FIELDS})
     kwargs.update({f: getattr(m, f) for f in _BOOL_FIELDS})
     kwargs.update({f: getattr(m, f + "_ms") / 1000.0 for f in _MS_FIELDS})
+    kwargs.update({f: getattr(m, f + "_bp") / 10000.0 for f in _BP_FIELDS})
     return Configuration(**kwargs)
 
 

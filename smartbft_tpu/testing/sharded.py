@@ -78,22 +78,32 @@ def sharded_config(i: int, *, depth: int = 1, rotation: bool = False,
 
 
 class AppShard(ShardHandle):
-    """One shard: n test Apps over a group-scoped network slice."""
+    """One shard: n test Apps over a group-scoped network slice.
+
+    ``group_key`` decouples the network namespace from the shard id: a
+    shard id RE-CREATED after an earlier incarnation retired (scale-in
+    then scale-out through the same id) is a brand-new consensus group
+    and must not collide with the dead incarnation's node registrations
+    or WAL directories (``wal_subdir`` likewise)."""
 
     def __init__(self, shard_id: int, network: Network, scheduler: Scheduler,
                  wal_root: str, *, n: int = 4,
                  config_fn: Callable[[int], Configuration],
                  crypto_fn: Callable[[int], Optional[object]],
-                 plane: Optional[ProtocolPlaneTimers] = None):
+                 plane: Optional[ProtocolPlaneTimers] = None,
+                 group_key: Optional[int] = None,
+                 wal_subdir: Optional[str] = None):
         self.shard_id = int(shard_id)
         self.plane = plane if plane is not None \
             else ProtocolPlaneTimers(name=f"shard-{shard_id}")
-        self.net = network.group(self.shard_id, plane=self.plane)
+        gid = self.shard_id if group_key is None else int(group_key)
+        self.net = network.group(gid, plane=self.plane)
         self.shared = SharedLedgers()
         self.scheduler = scheduler
+        subdir = wal_subdir or f"shard-{shard_id}"
         self.apps = [
             App(i, self.net, self.shared, scheduler,
-                wal_dir=f"{wal_root}/shard-{shard_id}/wal-{i}",
+                wal_dir=f"{wal_root}/{subdir}/wal-{i}",
                 config=config_fn(i), crypto=crypto_fn(i))
             for i in range(1, n + 1)
         ]
@@ -141,6 +151,29 @@ class AppShard(ShardHandle):
     async def submit(self, raw_request: bytes) -> None:
         await self._submit_app().consensus.submit_request(raw_request)
 
+    async def submit_barrier(self, epoch: int, old_shards: int,
+                             new_shards: int) -> None:
+        """Order the reshard barrier command through THIS shard's stream
+        (ShardHandle live-reshard contract; shared construction + dedup
+        swallow in testing.app.submit_barrier_request)."""
+        from .app import submit_barrier_request
+
+        await submit_barrier_request(
+            self._submit_app().consensus, epoch, old_shards, new_shards
+        )
+
+    def pending_client_ids(self) -> set:
+        """Clients with requests still pooled ANYWHERE in this shard (the
+        union over live replicas: a forwarded copy on a follower is just
+        as capable of committing after the flip as the leader's)."""
+        out: set = set()
+        for a in self.live_apps():
+            if a.consensus is not None:
+                out.update(
+                    i.client_id for i in a.consensus.pool_pending_infos()
+                )
+        return out
+
     def probe_app(self) -> App:
         """The live app with the longest chain — the mux feed source (all
         chains are prefix-consistent, so the longest is a safe monotone
@@ -170,6 +203,20 @@ class AppShard(ShardHandle):
             return self._submit_app().pool_occupancy()
         except RuntimeError:
             return {}
+
+    def ready(self) -> bool:
+        """A live replica follows a leader — submits can be ordered."""
+        return self.leader_id() != 0
+
+    def space_waiters(self) -> int:
+        """Space-wait submitters summed over LIVE replicas (a waiter can
+        sit on a deposed leader's pool after a mid-transition view
+        change, not just the current submit app's)."""
+        total = 0
+        for a in self.live_apps():
+            if a.consensus is not None:
+                total += int(a.consensus.pool_occupancy().get("waiters", 0))
+        return total
 
     def stats_block(self) -> dict:
         return {
@@ -246,6 +293,10 @@ class ShardedCluster:
         router_seed: int = 0,
         config_fn: Optional[Callable[[int, int], Configuration]] = None,
         naive: bool = False,
+        reshard_drain_deadline: Optional[float] = None,
+        mux_retention: int = 4096,
+        collect_entries: bool = False,
+        journal: bool = True,
     ):
         """``crypto``: "trivial" | "p256" | "ed25519" (see module
         docstring).  ``engine``: the shared device-stand-in engine for the
@@ -313,16 +364,17 @@ class ShardedCluster:
             node_ids = list(range(1, n + 1))
             # per-shard keyrings — shard s's membership signs with its own
             # keys, so cross-shard votes can never validate even if a bug
-            # leaked a message across group namespaces
-            self._rings = {
-                s: Keyring.generate(
-                    node_ids, seed=b"shard-%d" % s, scheme=scheme
-                )
-                for s in range(shards)
-            }
+            # leaked a message across group namespaces.  Generated lazily:
+            # a live reshard mints rings for shards born after construction
+            self._rings = {}
 
             def crypto_for(s, i):
-                p = provider_cls(self._rings[s][i], coalescer=self.coalescer)
+                ring = self._rings.get(s)
+                if ring is None:
+                    ring = self._rings[s] = Keyring.generate(
+                        node_ids, seed=b"shard-%d" % s, scheme=scheme
+                    )
+                p = provider_cls(ring[i], coalescer=self.coalescer)
                 p.verify_tag = s
                 return p
         else:
@@ -331,6 +383,16 @@ class ShardedCluster:
         cfg = config_fn or (
             lambda s, i: sharded_config(i, depth=depth, rotation=rotation)
         )
+        self._config_fn = cfg
+        if reshard_drain_deadline is None:
+            # the Configuration knob is the source of truth (reconfig
+            # round-trips it); an explicit constructor arg still wins
+            reshard_drain_deadline = cfg(0, 1).reshard_drain_deadline
+        self._crypto_for = crypto_for
+        #: incarnation count per shard id — a retired-then-recreated id is
+        #: a NEW consensus group with its own network namespace + WAL dirs
+        self._incarnations: dict[int, int] = {s: 1 for s in range(shards)}
+        self.delivered_entries: list = []
         self.shard_list = [
             AppShard(
                 s, self.network, self.scheduler, self.wal_root, n=n,
@@ -339,13 +401,22 @@ class ShardedCluster:
             )
             for s in range(shards)
         ]
+        from ..shard import EpochJournal
+
         self.set = ShardSet(
             self.shard_list,
             router=ShardRouter(shards, seed=router_seed),
             coalescer=self.coalescer,
+            journal=EpochJournal(f"{self.wal_root}/epoch.journal")
+            if journal else None,
+            drain_deadline=reshard_drain_deadline,
+            retention=mux_retention,
+            on_deliver=self.delivered_entries.append
+            if collect_entries else None,
         )
         self._client_ids: dict[int, list[str]] = {}
         self._client_scan_pos: dict[int, int] = {}
+        self._client_cache_epoch = self.set.epoch
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -358,7 +429,47 @@ class ShardedCluster:
         await self.set.stop()
 
     def shard(self, sid: int) -> AppShard:
-        return self.shard_list[sid]
+        for sh in self.shard_list:
+            if sh.shard_id == sid:
+                return sh
+        # explicit: StopIteration inside a coroutine surfaces as an
+        # opaque "coroutine raised StopIteration" RuntimeError
+        raise KeyError(
+            f"shard {sid} is not live (retired by a reshard, or never "
+            f"existed); live: {[s.shard_id for s in self.shard_list]}"
+        )
+
+    # -- live reshard -------------------------------------------------------
+
+    def _make_shard(self, sid: int, epoch: int) -> AppShard:
+        """ShardSet.reshard's factory: build + register a NEW consensus
+        group for shard id ``sid`` (a fresh incarnation if the id retired
+        before)."""
+        inc = self._incarnations.get(sid, 0)
+        self._incarnations[sid] = inc + 1
+        return AppShard(
+            sid, self.network, self.scheduler, self.wal_root, n=self.n,
+            config_fn=lambda i, _s=sid: self._config_fn(_s, i),
+            crypto_fn=lambda i, _s=sid: self._crypto_for(_s, i),
+            group_key=sid if inc == 0 else (inc << 20) | sid,
+            wal_subdir=f"shard-{sid}" if inc == 0
+            else f"shard-{sid}-gen{inc}",
+            plane=ProtocolPlaneTimers(name=f"shard-{sid}-gen{inc}"),
+        )
+
+    async def reshard(self, new_shards: int, **kw) -> dict:
+        """Live split/merge to ``new_shards`` groups under traffic (the
+        full epoch protocol — see ShardSet.reshard); refreshes the
+        harness's shard list and routed-client caches afterwards."""
+        summary = await self.set.reshard(
+            new_shards, make_shard=self._make_shard, **kw
+        )
+        self._sync_shard_list()
+        return summary
+
+    def _sync_shard_list(self) -> None:
+        self.shard_list = [self.set.shards[s] for s in sorted(self.set.shards)]
+        self.num_shards = len(self.shard_list)
 
     # -- the front door -----------------------------------------------------
 
@@ -372,17 +483,24 @@ class ShardedCluster:
         return await self.set.submit(client_id, req)
 
     def client_for_shard(self, sid: int, j: int = 0) -> str:
-        """A deterministic client id that ROUTES to shard ``sid`` — lets
-        tests and benches place load evenly while still going through the
-        real router (no bypass).  Memoized: benches call this per submit,
-        and re-scanning the id space would dominate the timed window."""
+        """A deterministic client id that ROUTES to shard ``sid`` in the
+        ACTIVE epoch — lets tests and benches place load evenly while
+        still going through the real router (no bypass).  Memoized per
+        epoch (an epoch flip re-buckets the client space, so the cache is
+        dropped at the first lookup after one): benches call this per
+        submit, and re-scanning the id space would dominate the timed
+        window."""
+        if self.set.epoch != self._client_cache_epoch:
+            self._client_ids.clear()
+            self._client_scan_pos.clear()
+            self._client_cache_epoch = self.set.epoch
         cached = self._client_ids.get(sid, [])
         while len(cached) <= j:
             k = self._client_scan_pos.get(sid, 0)
             while True:
                 cid = f"s{sid}c{k}"
                 k += 1
-                if self.set.router.route(cid) == sid:
+                if self.set.route(cid) == sid:
                     cached.append(cid)
                     break
                 if k > 100_000:  # pragma: no cover — 2^-100000 miss odds

@@ -93,3 +93,23 @@ def test_n16_uds_scale(tmp_path):
             cluster.submit(cluster.live_ids()[k % 16], "scale", f"req-{k}")
         cluster.wait_committed(8, timeout=120.0)
         cluster.check_fork_free()
+
+
+@pytest.mark.slow
+def test_control_plane_reshard_trigger(tmp_path):
+    """The multi-process reshard trigger: the resize decision rides the
+    ORDERED stream (Vertical Paxos rule) — after trigger_reshard, every
+    replica's ledger carries epoch 1's barrier command at a non-zero
+    sequence, and re-triggering is idempotent (pool client dedup), so a
+    crashed manager can simply re-issue it."""
+    with SocketCluster(tmp_path, n=4, transport="uds") as cluster:
+        leader = cluster.wait_leader()
+        cluster.submit(leader, "pre", "req-0")
+        cluster.wait_committed(1, timeout=60.0)
+        out = cluster.trigger_reshard(1, 1, 2, timeout=60.0)
+        assert out["epoch"] == 1
+        assert sorted(out["barriers"]) == [1, 2, 3, 4]
+        assert all(v > 0 for v in out["barriers"].values()), out
+        again = cluster.trigger_reshard(1, 1, 2, timeout=60.0)
+        assert again["barriers"] == out["barriers"]  # deduped, not re-ordered
+        cluster.check_fork_free()

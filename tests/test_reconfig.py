@@ -387,3 +387,56 @@ def test_config_validate_rejects_frame_cap_below_batch_bytes():
     )
     with pytest.raises(ConfigError, match="transport_max_frame_bytes"):
         bad.validate()
+
+
+def test_config_mirror_round_trips_elastic_shard_fields():
+    """A config-bearing reconfig must carry the elastic-shard knobs
+    (reshard drain deadline, autoscaler occupancy thresholds, cooldown,
+    min/max shards) — dropping them on the wire would silently reset the
+    elasticity envelope mid-run.  Occupancy fractions travel as integer
+    basis points (the codec carries ints natively), so the round-trip
+    must be exact at 1bp resolution."""
+    import dataclasses
+
+    from smartbft_tpu.testing.app import fast_config
+    from smartbft_tpu.testing.reconfig import mirror_config, unmirror_config
+
+    cfg = dataclasses.replace(
+        fast_config(1),
+        reshard_drain_deadline=12.5,
+        autoscale_high_occupancy=0.7201,
+        autoscale_low_occupancy=0.0999,
+        autoscale_cooldown=7.25,
+        autoscale_min_shards=2,
+        autoscale_max_shards=6,
+    )
+    rt = unmirror_config(mirror_config(cfg))
+    assert rt.reshard_drain_deadline == 12.5
+    assert rt.autoscale_high_occupancy == 0.7201
+    assert rt.autoscale_low_occupancy == 0.0999
+    assert rt.autoscale_cooldown == 7.25
+    assert rt.autoscale_min_shards == 2
+    assert rt.autoscale_max_shards == 6
+    # the PR 6 pattern: application restores per-node locals + validates
+    rt.with_node_locals(fast_config(3)).validate()
+
+
+def test_config_validate_rejects_bad_autoscale_envelope():
+    import dataclasses
+
+    import pytest
+
+    from smartbft_tpu.config import ConfigError
+    from smartbft_tpu.testing.app import fast_config
+
+    bad = dataclasses.replace(
+        fast_config(1),
+        autoscale_low_occupancy=0.9, autoscale_high_occupancy=0.2,
+    )
+    with pytest.raises(ConfigError, match="autoscale occupancy"):
+        bad.validate()
+    bad = dataclasses.replace(
+        fast_config(1), autoscale_min_shards=5, autoscale_max_shards=2,
+    )
+    with pytest.raises(ConfigError, match="autoscale shard bounds"):
+        bad.validate()

@@ -1,0 +1,824 @@
+"""Elastic shards: live reshard, crash-safe epochs, autoscaler.
+
+Coverage map (ISSUE 7):
+
+- router property tests: minimal movement bound (~|S'-S|/max of the
+  client space), determinism across seeds AND OS processes, and
+  epoch-pinned routing that never mixes epochs for one client key;
+- mux epochs: watermark + entry tagging, retired-shard freeze, explicit
+  cross-epoch hand-off dedup (the Mir-BFT re-bucketing rule), re-entering
+  shard ids as fresh generations;
+- epoch journal: round-trip, torn-tail tolerance, burned (aborted) epoch
+  numbers, and ShardSet recovery into the correct epoch from journals
+  crashed mid-drain and mid-flip;
+- ShardSet live path over stub handles: full epoch protocol (barrier ->
+  drain -> flip) without a consensus stack, moved-client parking until
+  the flip, the single loud ShardEpochError at the drain deadline, and
+  the automatic mux prune on the poll_committed hot path;
+- autoscaler: pure decision function (scale out on saturation, in when
+  idle, clamped, cooldown prevents flapping) + the loop over a stub set;
+- live integration (tier-1 fast, logical clock): S=2->3 under a small
+  burst, and the acceptance scenario S=2->4->3 mid-burst with a replica
+  crashed inside the handoff window — every acked request exactly once
+  across epochs, fork-free, per-shard gapless (mux-enforced live);
+- slow soak: `python -m smartbft_tpu.testing.chaos --soak --reshard`.
+"""
+
+import asyncio
+import subprocess
+import sys
+
+import pytest
+
+from smartbft_tpu.shard import (
+    DeliveryMux,
+    EpochJournal,
+    OccupancyAutoscaler,
+    ShardEpochError,
+    ShardHandle,
+    ShardRouter,
+    ShardSet,
+    ShardStreamViolation,
+    run_autoscaler,
+)
+from smartbft_tpu.shard.epoch import (
+    RESHARD_CLIENT,
+    barrier_marker,
+    detect_reshard,
+    recover_epochs,
+    reshard_command_payload,
+)
+from smartbft_tpu.testing.chaos import (
+    ChaosEvent,
+    assert_exactly_once_across_epochs,
+    reshard_schedule,
+    reshard_soak,
+    run_reshard_schedule,
+)
+from smartbft_tpu.testing.sharded import ShardedCluster
+
+
+# ---------------------------------------------------------------- router props
+
+def test_router_minimal_movement_bound():
+    """Property: for many (S, S') pairs the moved fraction of a 2000-key
+    sample stays within ~1.6x of the jump-hash bound |S'-S|/max(S,S')."""
+    r = ShardRouter(1, seed=11)
+    for old_s, new_s in [(2, 3), (2, 4), (4, 3), (4, 8), (8, 5), (3, 2)]:
+        moved = sum(
+            1 for k in range(2000)
+            if r.moved(f"c{k}", old_s, new_s)
+        )
+        bound = abs(new_s - old_s) / max(new_s, old_s)
+        assert moved / 2000 <= bound * 1.6, (old_s, new_s, moved)
+        # and growing S is MONOTONE: keys only move into the new shards
+        if new_s > old_s:
+            for k in range(500):
+                cid = f"c{k}"
+                if r.moved(cid, old_s, new_s):
+                    assert r.route_with(cid, new_s) >= old_s, cid
+    # moved_fraction reports the same property on its own probe sample
+    assert r.moved_fraction(2, 4) <= 0.5 * 1.6
+    with pytest.raises(ValueError):
+        r.moved_fraction(2, 4, sample=0)
+
+
+def test_router_determinism_across_processes():
+    """The mapping is a pure function of (seed, client_id, S): a fresh OS
+    process computes byte-identical routes — reshard decisions taken on
+    one coordinator are reproducible on any recovered one."""
+    seed, shards = 42, 5
+    local = [ShardRouter(shards, seed=seed).route(f"c{k}") for k in range(64)]
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "from smartbft_tpu.shard import ShardRouter\n"
+         f"r = ShardRouter({shards}, seed={seed})\n"
+         f"print(','.join(str(r.route(f'c{{k}}')) for k in range(64)))"],
+        capture_output=True, text=True, check=True, timeout=120,
+    )
+    remote = [int(x) for x in out.stdout.strip().splitlines()[-1].split(",")]
+    assert remote == local
+
+
+def test_router_epoch_pinned_routing_never_mixes():
+    """One client key never mixes epochs: route(cid, epoch=e) is constant
+    for every installed epoch e, stays answerable after later installs,
+    and equals the pure mapping at that epoch's shard count."""
+    r = ShardRouter(2, seed=5)
+    cids = [f"c{k}" for k in range(200)]
+    at0 = {c: r.route(c) for c in cids}
+    r.reshard(4)          # epoch 1
+    r.reshard(3, epoch=4)  # epochs 2-3 burned (aborted transitions)
+    assert r.epochs() == [(0, 2), (1, 4), (4, 3)]
+    for c in cids:
+        assert r.route(c, epoch=0) == at0[c] == r.route_with(c, 2)
+        assert r.route(c, epoch=1) == r.route_with(c, 4)
+        # burned numbers never changed the mapping: epoch 2/3 routes as 1
+        assert r.route(c, epoch=2) == r.route(c, epoch=1)
+        assert r.route(c, epoch=4) == r.route_with(c, 3) == r.route(c)
+    assert r.shards_at(0) == 2 and r.shards_at(3) == 4 and r.shards_at(9) == 3
+    with pytest.raises(ValueError):
+        r.shards_at(-1)
+
+
+def test_router_epoch_allocation_rules():
+    r = ShardRouter(2)
+    assert r.epoch == 0
+    with pytest.raises(ValueError):
+        r.reshard(3, epoch=0)  # must strictly increase
+    info = r.reshard(3)
+    assert info["epoch"] == 1 and r.num_shards == 3
+    with pytest.raises(ValueError):
+        r.reshard(0)
+
+
+# ------------------------------------------------------------------ mux epochs
+
+def test_mux_epoch_watermark_and_tagging():
+    mux = DeliveryMux([0, 1])
+    mux.ingest(0, "d0-1", seq=1, request_ids=["a"])
+    mux.ingest(1, "d1-1", seq=1, request_ids=["b"])
+    mark = mux.begin_epoch(1, [0, 1, 2], barriers={0: 1, 1: 1})
+    assert mark == {"epoch": 1, "index": 2, "shards": [0, 1, 2],
+                    "retired": [], "barriers": {0: 1, 1: 1}}
+    # survivors keep counting, the new shard starts at 1; entries carry
+    # the epoch they were delivered under
+    e = mux.ingest(0, "d0-2", seq=2, request_ids=["c"])
+    assert e.epoch == 1
+    e = mux.ingest(2, "d2-1", seq=1, request_ids=["d"])
+    assert e.epoch == 1 and mux.height(2) == 1
+    snap = mux.snapshot()
+    assert snap["epoch"] == 1 and snap["watermarks"] == [mark]
+    assert [x.epoch for x in mux.since(0)] == [0, 0, 1, 1]
+
+
+def test_mux_retired_shard_freezes():
+    mux = DeliveryMux([0, 1, 2])
+    mux.ingest(2, "d2-1", seq=1, request_ids=["x"])
+    mux.begin_epoch(1, [0, 1], retire=[2])
+    assert mux.live_shard_ids() == [0, 1]
+    assert mux.shard_ids() == [0, 1, 2]  # history stays queryable
+    assert mux.height(2) == 1
+    with pytest.raises(ShardStreamViolation, match="retired"):
+        mux.ingest(2, "d2-2", seq=2, request_ids=["y"])
+
+
+def test_mux_cross_epoch_handoff_dedup():
+    """The Mir-BFT re-bucketing rule, explicit: a moved client's request
+    that committed in its OLD shard must not commit again in its NEW one
+    — even across TWO flips (each flip rebuilds the hand-off set from
+    the cursors' still-unpruned history, which spans both here)."""
+    mux = DeliveryMux([0, 1])
+    mux.ingest(0, "d0-1", seq=1, request_ids=["mov:1", "stay:1"])
+    mux.begin_epoch(1, [0, 1, 2])
+    with pytest.raises(ShardStreamViolation, match="handed-off"):
+        mux.ingest(2, "d2-1", seq=1, request_ids=["mov:1"])
+    # fresh ids are fine, and the set carries across a second flip
+    mux.ingest(2, "d2-1", seq=1, request_ids=["mov:2"])
+    mux.begin_epoch(2, [0, 1])
+    with pytest.raises(ShardStreamViolation, match="handed-off"):
+        mux.ingest(1, "d1-1", seq=1, request_ids=["stay:1"])
+
+
+def test_mux_reentering_shard_id_is_fresh_generation():
+    mux = DeliveryMux([0, 1])
+    mux.ingest(1, "d1-1", seq=1, request_ids=["old:1"])
+    assert mux.requests_total() == 1
+    mux.begin_epoch(1, [0], retire=[1])
+    mux.begin_epoch(2, [0, 1])  # id 1 re-enters as a NEW group
+    # the dead incarnation's delivered count stays in the monotone total
+    # (shrink-then-grow must never make committed counters regress)
+    assert mux.requests_total() == 1
+    e = mux.ingest(1, "d1-1b", seq=1, request_ids=["new:1"])  # restarts at 1
+    assert e.epoch == 2
+    assert mux.requests_total() == 2
+    # ...and the dead incarnation's ids stay caught by the hand-off set
+    with pytest.raises(ShardStreamViolation, match="handed-off"):
+        mux.ingest(1, "d1-2", seq=2, request_ids=["old:1"])
+    # a dead generation has no cursor, but its unpruned ids must survive
+    # the NEXT flip's hand-off rebuild too (until prune trims them)
+    mux.begin_epoch(3, [0, 1])
+    with pytest.raises(ShardStreamViolation, match="handed-off"):
+        mux.ingest(0, "d0-1", seq=1, request_ids=["old:1"])
+    mux.prune(mux.total())  # the dead gen's entry leaves the horizon
+    mux.begin_epoch(4, [0, 1])
+    assert "old:1" not in mux._handoff_seen  # falls to pool history
+
+
+def test_mux_handoff_set_bounded_by_prune_horizon():
+    """The hand-off set is REBUILT at each flip from unpruned cursor
+    history (never accumulated across flips), so unbounded autoscaler
+    transitions cannot grow mux memory: a pruned id's cross-epoch dedup
+    falls to pool history, exactly like intra-shard dedup after prune."""
+    mux = DeliveryMux([0])
+    mux.ingest(0, "d1", seq=1, request_ids=["ancient:1"])
+    mux.ingest(0, "d2", seq=2, request_ids=["recent:1"])
+    mux.begin_epoch(1, [0, 1])
+    assert "ancient:1" in mux._handoff_seen
+    mux.prune(1)  # entry 0 (ancient:1) leaves the retention window
+    mux.begin_epoch(2, [0, 1])
+    # rebuilt from unpruned history only: bounded, not ever-growing
+    assert "ancient:1" not in mux._handoff_seen
+    mux.ingest(1, "d1-1", seq=1, request_ids=["ancient:1"])  # pool's job now
+    with pytest.raises(ShardStreamViolation, match="handed-off"):
+        mux.ingest(1, "d1-2", seq=2, request_ids=["recent:1"])
+
+
+def test_mux_handoff_excludes_control_commands():
+    """Barrier commands are per-SHARD control records, legitimately
+    committed once per shard: a stale barrier from an ABORTED transition
+    that finally orders on its shard after a later successful flip must
+    not trip the hand-off dedup (per-shard exactly-once for it is still
+    the cursor's job)."""
+    mux = DeliveryMux([0, 1])
+    stale = barrier_marker(7)  # epoch 7's transition aborted
+    mux.ingest(0, "d0-1", seq=1, request_ids=[stale, "c:1"])
+    mux.begin_epoch(8, [0, 1])
+    # shard 1's straggler commit of the SAME control command is fine...
+    mux.ingest(1, "d1-1", seq=1, request_ids=[stale])
+    # ...while a real client id still trips the hand-off guard
+    with pytest.raises(ShardStreamViolation, match="handed-off"):
+        mux.ingest(1, "d1-2", seq=2, request_ids=["c:1"])
+    # and per-shard exactly-once for the control command itself holds
+    with pytest.raises(ShardStreamViolation, match="duplicates"):
+        mux.ingest(0, "d0-2", seq=2, request_ids=[stale])
+
+
+def test_mux_begin_epoch_validation():
+    mux = DeliveryMux([0, 1])
+    with pytest.raises(ValueError, match="exceed"):
+        mux.begin_epoch(0, [0, 1])
+    with pytest.raises(ValueError, match="both retired and live"):
+        mux.begin_epoch(1, [0, 1], retire=[1])
+    with pytest.raises(ValueError, match="unknown shard"):
+        mux.begin_epoch(1, [0], retire=[7])
+
+
+# --------------------------------------------------------------- epoch journal
+
+def test_barrier_payload_roundtrip():
+    cmd = detect_reshard(reshard_command_payload(3, 2, 4))
+    assert (cmd.epoch, cmd.old_shards, cmd.new_shards) == (3, 2, 4)
+    assert detect_reshard(b"ordinary request") is None
+    assert barrier_marker(3) == f"{RESHARD_CLIENT}:reshard-e3"
+
+
+def test_journal_roundtrip_and_recovery(tmp_path):
+    j = EpochJournal(str(tmp_path / "epoch.journal"))
+    j.append({"t": "prepare", "epoch": 1, "old": 2, "new": 4})
+    j.append({"t": "barrier", "epoch": 1, "shard": 0, "seq": 5})
+    j.append({"t": "barrier", "epoch": 1, "shard": 1, "seq": 7})
+    j.append({"t": "flip", "epoch": 1, "shards": [0, 1, 2, 3]})
+    j.append({"t": "done", "epoch": 1})
+    j.close()
+    facts = recover_epochs(EpochJournal(j.path).replay())
+    assert facts == {"epoch": 1, "shards": 4, "next_epoch": 2,
+                     "incomplete": None}
+
+
+def test_journal_recovery_mid_drain_and_mid_flip(tmp_path):
+    # crashed mid-drain: prepared + one barrier, never flipped
+    j = EpochJournal(str(tmp_path / "a.journal"))
+    j.append({"t": "prepare", "epoch": 2, "old": 2, "new": 3})
+    j.append({"t": "barrier", "epoch": 2, "shard": 0, "seq": 9})
+    j.close()
+    facts = recover_epochs(EpochJournal(j.path).replay())
+    assert facts["incomplete"] == {"epoch": 2, "old": 2, "new": 3,
+                                   "barriers": {0: 9}, "flipped": False}
+    # crashed mid-flip: the journaled flip TOOK EFFECT
+    j2 = EpochJournal(str(tmp_path / "b.journal"))
+    j2.append({"t": "prepare", "epoch": 2, "old": 2, "new": 3})
+    j2.append({"t": "flip", "epoch": 2, "shards": [0, 1, 2]})
+    j2.close()
+    facts = recover_epochs(EpochJournal(j2.path).replay())
+    assert facts["incomplete"]["flipped"] is True
+    assert facts["next_epoch"] == 3
+
+
+def test_journal_torn_tail_tolerated(tmp_path):
+    path = str(tmp_path / "torn.journal")
+    j = EpochJournal(path)
+    j.append({"t": "prepare", "epoch": 1, "old": 2, "new": 3})
+    j.append({"t": "done", "epoch": 1})
+    j.close()
+    with open(path, "ab") as fh:
+        fh.write(b'{"t": "prepare", "epo')  # SIGKILL mid-append
+    facts = recover_epochs(EpochJournal(path).replay())
+    assert facts == {"epoch": 1, "shards": 3, "next_epoch": 2,
+                     "incomplete": None}
+
+
+def test_journal_append_after_torn_tail_seals_first(tmp_path):
+    """A record appended after a crash-torn write must NOT glue onto the
+    partial line (that would hide it — and every later record — from
+    replay forever): the first append seals the tail by truncating to
+    the longest replayable prefix."""
+    path = str(tmp_path / "seal.journal")
+    j = EpochJournal(path)
+    j.append({"t": "prepare", "epoch": 1, "old": 2, "new": 3})
+    j.close()
+    with open(path, "ab") as fh:
+        fh.write(b'{"t": "flip", "epo')  # SIGKILL mid-append of the flip
+    j2 = EpochJournal(path)
+    j2.append({"t": "abort", "epoch": 1, "reason": "recovery"})
+    j2.append({"t": "prepare", "epoch": 2, "old": 2, "new": 4})
+    j2.append({"t": "flip", "epoch": 2, "shards": [0, 1, 2, 3]})
+    j2.append({"t": "done", "epoch": 2})
+    j2.close()
+    facts = recover_epochs(EpochJournal(path).replay())
+    # epoch 2's whole life is visible — nothing swallowed by torn bytes
+    assert facts == {"epoch": 2, "shards": 4, "next_epoch": 3,
+                     "incomplete": None}
+
+
+def test_journal_aborted_epochs_stay_burned(tmp_path):
+    j = EpochJournal(str(tmp_path / "burn.journal"))
+    j.append({"t": "prepare", "epoch": 1, "old": 2, "new": 4})
+    j.append({"t": "abort", "epoch": 1, "reason": "drain deadline"})
+    j.close()
+    facts = recover_epochs(EpochJournal(j.path).replay())
+    # epoch 1's markers may sit in committed history: never reallocate it
+    assert facts == {"epoch": 0, "shards": None, "next_epoch": 2,
+                     "incomplete": None}
+
+
+# --------------------------------------------------- ShardSet over stub shards
+
+class _FakeShard(ShardHandle):
+    """A scripted consensus group: commits submitted requests instantly,
+    orders barrier commands like any request, reports pending clients."""
+
+    def __init__(self, sid):
+        self.shard_id = int(sid)
+        self.chain = []       # (seq, request_ids, decision)
+        self.submitted = []
+        self.pending: set = set()
+        self.waiters = 0      # submitters blocked in the pool space-wait
+        self.ready_flag = True
+        self.stopped = False
+
+    async def start(self):
+        self.stopped = False
+
+    async def stop(self):
+        self.stopped = True
+
+    async def submit(self, raw):
+        self.submitted.append(raw)
+        self._commit([raw.decode() if isinstance(raw, bytes) else str(raw)])
+
+    async def submit_barrier(self, epoch, old_shards, new_shards):
+        self._commit([barrier_marker(epoch)])
+
+    def _commit(self, request_ids):
+        seq = len(self.chain) + 1
+        self.chain.append((seq, tuple(request_ids), f"dec-{self.shard_id}-{seq}"))
+
+    def poll_committed(self, since):
+        return self.chain[since:]
+
+    def pool_occupancy(self):
+        return {"size": 0, "free": 8, "capacity": 8, "waiters": self.waiters}
+
+    def pending_client_ids(self):
+        return set(self.pending)
+
+    def ready(self):
+        return self.ready_flag
+
+
+def _moved_client(router, old_s, new_s):
+    return next(f"mc{k}" for k in range(10_000)
+                if router.moved(f"mc{k}", old_s, new_s))
+
+
+def _unmoved_client(router, old_s, new_s):
+    return next(f"uc{k}" for k in range(10_000)
+                if not router.moved(f"uc{k}", old_s, new_s))
+
+
+def test_shardset_full_epoch_protocol_over_stubs(tmp_path):
+    """Scale-out 2->3 then scale-in 3->2 through the real coordinator
+    (barrier -> drain -> flip, journaled), no consensus stack needed."""
+    journal = EpochJournal(str(tmp_path / "epoch.journal"))
+    s = ShardSet([_FakeShard(0), _FakeShard(1)], journal=journal,
+                 drain_deadline=5.0)
+
+    async def run():
+        made = []
+        summary = await s.reshard(
+            3, make_shard=lambda sid, epoch: made.append(sid) or _FakeShard(sid))
+        assert made == [2]
+        assert summary["epoch"] == 1 and summary["old"] == 2
+        assert sorted(summary["barriers"]) == [0, 1]
+        assert s.epoch == 1 and s.num_shards == 3
+        assert s.mux.epoch == 1
+        # the barrier commands themselves rode each OLD shard's stream
+        for sid in (0, 1):
+            ids = [r for _, rids, _ in s.shards[sid].chain for r in rids]
+            assert barrier_marker(1) in ids
+        # scale-in: shard 2 retires (empty pending -> drains immediately)
+        summary = await s.reshard(2)
+        assert summary["epoch"] == 2 and s.num_shards == 2
+        assert 2 in s.retired and s.retired[2].stopped
+        assert s.mux.live_shard_ids() == [0, 1]
+        assert s.stats_block()["reshard"]["transitions"] == 2
+
+    asyncio.run(run())
+    # the journal recorded the full edge sequence for both transitions
+    kinds = [r["t"] for r in EpochJournal(journal.path).replay()]
+    assert kinds == ["prepare", "barrier", "barrier", "flip", "done",
+                     "prepare", "barrier", "barrier", "barrier", "flip",
+                     "done"]
+
+
+def test_shardset_moved_client_parks_until_flip():
+    s = ShardSet([_FakeShard(0), _FakeShard(1)], drain_deadline=5.0)
+    moved = _moved_client(s.router, 2, 3)
+    unmoved = _unmoved_client(s.router, 2, 3)
+    s.shards[0].pending = {moved}  # drain holds until we clear it
+
+    async def run():
+        tr = asyncio.ensure_future(
+            s.reshard(3, make_shard=lambda sid, e: _FakeShard(sid)))
+        await asyncio.sleep(0.05)
+        assert s.reshard_in_progress
+        parked = asyncio.ensure_future(s.submit(moved, b"m:1"))
+        await asyncio.sleep(0.05)
+        assert not parked.done()  # moved client parks at the barrier
+        # unmoved clients never notice the transition
+        sid = await s.submit(unmoved, b"u:1")
+        assert sid == s.router.route_with(unmoved, 2)
+        s.shards[0].pending = set()  # drain completes
+        summary = await tr
+        assert summary["parked_submits_peak"] >= 1
+        landed = await parked  # released into the NEW epoch's shard
+        assert landed == s.router.route_with(moved, 3)
+
+    asyncio.run(run())
+
+
+def test_shardset_drain_deadline_raises_shard_epoch_error():
+    """The single loud error contract: deadline expiry aborts the
+    transition, parked moved-client submits raise ShardEpochError, the
+    set keeps serving the OLD epoch, and the epoch number is burned."""
+    s = ShardSet([_FakeShard(0), _FakeShard(1)], drain_deadline=0.3)
+    moved = _moved_client(s.router, 2, 3)
+    s.shards[1].pending = {moved}  # a moved client that never drains
+
+    async def run():
+        parked = None
+        with pytest.raises(ShardEpochError, match="drain deadline"):
+            tr = asyncio.ensure_future(
+                s.reshard(3, make_shard=lambda sid, e: _FakeShard(sid)))
+            await asyncio.sleep(0.05)
+            parked = asyncio.ensure_future(s.submit(moved, b"m:1"))
+            await tr
+        with pytest.raises(ShardEpochError):
+            await parked
+        assert not s.reshard_in_progress
+        assert s.epoch == 0 and s.num_shards == 2  # old epoch serves on
+        assert s.reshard_stats["aborts"] == 1
+        # the burned number is never reused (drain unblocked this time)
+        s.shards[1].pending = set()
+        summary = await s.reshard(3, make_shard=lambda sid, e: _FakeShard(sid))
+        assert summary["epoch"] == 2
+
+    asyncio.run(run())
+
+
+def test_shardset_barrier_resubmits_after_loss():
+    """A barrier submit that SUCCEEDED but whose command died with its
+    replica (crash before proposing — the request lived only in that
+    pool) must be re-submitted after the re-submit interval, not skipped
+    forever until the drain deadline aborts the transition."""
+
+    class _LossyShard(_FakeShard):
+        def __init__(self, sid):
+            super().__init__(sid)
+            self.drop_barriers = 0
+            self.barrier_submits = 0
+
+        async def submit_barrier(self, epoch, old_shards, new_shards):
+            self.barrier_submits += 1
+            if self.drop_barriers > 0:
+                self.drop_barriers -= 1
+                return  # "succeeded" into a pool that then died with its node
+            await super().submit_barrier(epoch, old_shards, new_shards)
+
+    s = ShardSet([_LossyShard(0), _LossyShard(1)], drain_deadline=20.0)
+    s.BARRIER_RESUBMIT_INTERVAL = 0.05
+    s.shards[1].drop_barriers = 2  # first two orderings vanish
+
+    async def run():
+        summary = await s.reshard(
+            3, make_shard=lambda sid, e: _FakeShard(sid))
+        assert summary["epoch"] == 1
+        assert s.shards[1].barrier_submits >= 3  # re-submitted until committed
+
+    asyncio.run(run())
+
+
+def test_shardset_drain_waits_out_pool_space_waiters():
+    """A submitter blocked in Pool.submit's SPACE wait holds a request no
+    pool (and no pending_client_ids) can see yet; admitted after the flip
+    it would commit on the OLD shard — the drain must wait it out."""
+    s = ShardSet([_FakeShard(0), _FakeShard(1)], drain_deadline=5.0)
+    s.shards[1].waiters = 1
+
+    async def run():
+        tr = asyncio.ensure_future(
+            s.reshard(3, make_shard=lambda sid, e: _FakeShard(sid)))
+        await asyncio.sleep(0.08)
+        assert s.reshard_phase == "drain"  # barriers done, held by waiter
+        s.shards[1].waiters = 0            # the waiter got its slot
+        summary = await tr
+        assert summary["epoch"] == 1 and s.epoch == 1
+
+    asyncio.run(run())
+
+
+def test_shardset_concurrent_reshard_refused():
+    s = ShardSet([_FakeShard(0), _FakeShard(1)], drain_deadline=5.0)
+    s.shards[0].pending = {_moved_client(s.router, 2, 3)}
+
+    async def run():
+        tr = asyncio.ensure_future(
+            s.reshard(3, make_shard=lambda sid, e: _FakeShard(sid)))
+        await asyncio.sleep(0.05)
+        with pytest.raises(ShardEpochError, match="already in progress"):
+            await s.reshard(4, make_shard=lambda sid, e: _FakeShard(sid))
+        s.shards[0].pending = set()
+        await tr
+        assert (await s.reshard(3)) == {"epoch": 1, "old": 3, "new": 3,
+                                        "noop": True}
+        with pytest.raises(ValueError, match="make_shard"):
+            await s.reshard(5)
+
+    asyncio.run(run())
+
+
+def test_shardset_recovers_journaled_epochs(tmp_path):
+    """A coordinator crashed mid-drain recovers into the OLD epoch (the
+    unflipped transition aborts, its number burns); one crashed just
+    after the flip recovers into the NEW epoch (done is appended)."""
+    path = str(tmp_path / "epoch.journal")
+    j = EpochJournal(path)
+    j.append({"t": "prepare", "epoch": 1, "old": 2, "new": 3})
+    j.append({"t": "barrier", "epoch": 1, "shard": 0, "seq": 4})
+    j.close()
+    # mid-drain crash: rebuild with the OLD epoch's 2 handles
+    s = ShardSet([_FakeShard(0), _FakeShard(1)], journal=EpochJournal(path))
+    assert s.epoch == 0 and s.reshard_stats["aborts"] == 1
+    assert recover_epochs(EpochJournal(path).replay())["next_epoch"] == 2
+    s.journal.close()
+
+    path2 = str(tmp_path / "epoch2.journal")
+    j = EpochJournal(path2)
+    j.append({"t": "prepare", "epoch": 1, "old": 2, "new": 3})
+    j.append({"t": "flip", "epoch": 1, "shards": [0, 1, 2]})
+    j.close()
+    # mid-flip crash: the flip took effect — recover with the NEW handles
+    with pytest.raises(ShardEpochError, match="rebuilt with"):
+        ShardSet([_FakeShard(0), _FakeShard(1)], journal=EpochJournal(path2))
+    s = ShardSet([_FakeShard(s) for s in range(3)], journal=EpochJournal(path2))
+    assert s.epoch == 1 and s.num_shards == 3
+    assert s.mux.epoch == 1
+    facts = recover_epochs(EpochJournal(path2).replay())
+    assert facts == {"epoch": 1, "shards": 3, "next_epoch": 2,
+                     "incomplete": None}
+    # ...and a completed epoch pins the count on the NEXT recovery too
+    with pytest.raises(ShardEpochError, match="rebuilt with"):
+        ShardSet([_FakeShard(0), _FakeShard(1)],
+                 journal=EpochJournal(path2))
+    # ...even when a LATER unflipped prepare trails the completed epoch
+    # (it aborts; the completed epoch's count still governs the rebuild)
+    j = EpochJournal(path2)
+    j.append({"t": "prepare", "epoch": 2, "old": 3, "new": 5})
+    j.close()
+    with pytest.raises(ShardEpochError, match="rebuilt with"):
+        ShardSet([_FakeShard(0), _FakeShard(1)],
+                 journal=EpochJournal(path2))
+    s.journal.close()
+
+
+def test_shardset_auto_prune_on_poll_hot_path():
+    """ISSUE satellite: poll_committed prunes applied entries behind the
+    bounded retention window automatically — long soaks cannot grow mux
+    memory with history — and never prunes entries it has not returned."""
+    s = ShardSet([_FakeShard(0)], retention=8)
+    for k in range(50):
+        s.shards[0]._commit([f"r{k}"])
+        s.poll_committed()
+    snap = s.mux.snapshot()
+    assert snap["total"] == 50
+    assert snap["pruned"] >= 50 - 8 - 1
+    assert len(s.mux.combined) <= 9
+    # everything ever returned is still counted
+    assert s.committed_requests(0) == 50
+
+
+# ------------------------------------------------------------------ autoscaler
+
+def test_autoscaler_scales_out_on_saturation_and_in_when_idle():
+    clock = [0.0]
+    a = OccupancyAutoscaler(high=0.8, low=0.2, cooldown=10.0,
+                            min_shards=1, max_shards=4,
+                            clock=lambda: clock[0])
+    # saturated by fill
+    assert a.evaluate({"fill": 0.9, "total_waiters": 0}, 2) == 3
+    a.note_action()
+    clock[0] += 11.0
+    # saturated by parked submitters even at low fill
+    assert a.evaluate({"fill": 0.1, "total_waiters": 3}, 3) == 4
+    a.note_action()
+    clock[0] += 11.0
+    # clamped at max
+    assert a.evaluate({"fill": 1.0, "total_waiters": 5}, 4) is None
+    # idle scales in, clamped at min
+    assert a.evaluate({"fill": 0.05, "total_waiters": 0}, 3) == 2
+    a.note_action()
+    clock[0] += 11.0
+    assert a.evaluate({"fill": 0.0, "total_waiters": 0}, 1) is None
+    # mid-band holds
+    assert a.evaluate({"fill": 0.5, "total_waiters": 0}, 2) is None
+    assert len(a.decisions) == 3
+
+
+def test_autoscaler_cooldown_prevents_flapping():
+    clock = [0.0]
+    a = OccupancyAutoscaler(high=0.8, low=0.2, cooldown=30.0,
+                            clock=lambda: clock[0])
+    assert a.evaluate({"fill": 0.95}, 1) == 2
+    a.note_action()
+    # saturated AND idle signals are both suppressed inside the window —
+    # including after a FAILED reshard (note_action re-arms either way)
+    for dt in (0.0, 5.0, 29.9):
+        clock[0] = dt
+        assert a.in_cooldown()
+        assert a.evaluate({"fill": 0.95}, 2) is None
+        assert a.evaluate({"fill": 0.01}, 2) is None
+    clock[0] = 30.1
+    assert not a.in_cooldown()
+    assert a.evaluate({"fill": 0.01}, 2) == 1
+
+
+def test_autoscaler_holds_when_nothing_reports():
+    """Explicit zero combined capacity means the pools have not come up —
+    indistinguishable from idle by fill alone; the scaler must hold, not
+    shrink a deployment that has not started."""
+    a = OccupancyAutoscaler(high=0.8, low=0.2, min_shards=1, max_shards=4)
+    assert a.evaluate({"fill": 0.0, "total_waiters": 0,
+                       "total_capacity": 0}, 3) is None
+    # genuinely idle (capacity reporting) still scales in
+    assert a.evaluate({"fill": 0.0, "total_waiters": 0,
+                       "total_capacity": 100}, 3) == 2
+
+
+def test_autoscaler_validation_and_config():
+    from smartbft_tpu.config import Configuration
+
+    with pytest.raises(ValueError):
+        OccupancyAutoscaler(high=0.2, low=0.8)
+    with pytest.raises(ValueError):
+        OccupancyAutoscaler(min_shards=4, max_shards=2)
+    with pytest.raises(ValueError):
+        OccupancyAutoscaler(step=0)
+    cfg = Configuration(self_id=1, autoscale_high_occupancy=0.7,
+                        autoscale_low_occupancy=0.1,
+                        autoscale_cooldown=5.0, autoscale_min_shards=2,
+                        autoscale_max_shards=6)
+    a = OccupancyAutoscaler.from_config(cfg)
+    assert (a.high, a.low, a.cooldown) == (0.7, 0.1, 5.0)
+    assert (a.min_shards, a.max_shards) == (2, 6)
+
+
+def test_run_autoscaler_loop_over_stub_set():
+    """The loop: saturated occupancy drives a real ShardSet.reshard OUT,
+    idle occupancy drives one IN, cooldown spaces them, and the loop
+    survives a failing transition."""
+
+    class _Set:
+        def __init__(self):
+            self.num_shards = 1
+            self.reshard_in_progress = False
+            self.fill = 0.95
+            self.calls = []
+            self.fail_next = False
+
+        def occupancy(self):
+            return {"fill": self.fill, "total_waiters": 0}
+
+        async def reshard(self, target, make_shard=None):
+            self.calls.append(target)
+            if self.fail_next:
+                self.fail_next = False
+                raise ShardEpochError("injected drain abort")
+            self.num_shards = target
+            return {"epoch": len(self.calls), "new": target}
+
+    async def run():
+        clock = [0.0]
+        stub = _Set()
+        a = OccupancyAutoscaler(high=0.8, low=0.2, cooldown=5.0,
+                                max_shards=4, clock=lambda: clock[0])
+        stop = asyncio.Event()
+        seen = []
+        task = asyncio.ensure_future(run_autoscaler(
+            stub, a, make_shard=lambda sid, e: None, interval=0.01,
+            stop=stop, on_reshard=seen.append))
+        await asyncio.sleep(0.05)
+        assert stub.calls == [2]          # scaled out once...
+        assert stub.num_shards == 2
+        clock[0] += 6.0                   # ...and only once per cooldown
+        stub.fail_next = True             # next decision fails (drain abort)
+        await asyncio.sleep(0.05)
+        assert stub.calls == [2, 3]
+        assert stub.num_shards == 2       # failed — but the loop survived
+        clock[0] += 6.0
+        stub.fill = 0.01                  # now idle: scale back in
+        await asyncio.sleep(0.05)
+        assert stub.calls == [2, 3, 1]
+        assert stub.num_shards == 1
+        stop.set()
+        executed = await asyncio.wait_for(task, timeout=2.0)
+        assert executed == 2              # out + in (the failure excluded)
+        assert len(seen) == 2
+
+    asyncio.run(run())
+
+
+# ------------------------------------------------- live integration (tier-1)
+
+def test_live_reshard_smoke_2_to_3():
+    """ISSUE satellite (fast tier-1 gate): S=2->3 under a small burst —
+    gapless + exactly-once pinned across the epoch flip, every acked
+    request committed exactly once, the barrier visible in both old
+    shards' streams."""
+
+    async def run():
+        import tempfile
+
+        with tempfile.TemporaryDirectory(prefix="reshard-smoke-") as root:
+            cluster = ShardedCluster(root, shards=2, n=4, depth=2, seed=7,
+                                     collect_entries=True,
+                                     reshard_drain_deadline=120.0)
+            await cluster.start()
+            try:
+                report = await run_reshard_schedule(
+                    cluster, [ChaosEvent(at=1.0, action="reshard", count=3)],
+                    requests=8, submit_every=0.15, settle_timeout=300.0)
+                assert_exactly_once_across_epochs(cluster, report)
+                assert cluster.set.num_shards == 3
+                assert cluster.set.epoch == 1
+                assert report.shard_counts_seen == [2, 3]
+                [summary] = report.reshards
+                assert sorted(summary["barriers"]) == [0, 1]
+                assert summary["moved_fraction"] <= 0.34 * 1.6
+                # the journal survived with the full transition
+                kinds = [r["t"] for r in cluster.set.journal.replay()]
+                assert kinds[0] == "prepare" and kinds[-1] == "done"
+            finally:
+                await cluster.stop()
+
+    asyncio.run(run())
+
+
+def test_reshard_crash_during_handoff_2_4_3():
+    """The acceptance scenario, tier-1 fast version: S=2->4->3 mid-burst
+    with one replica crashed INSIDE the handoff window (and rejoining
+    later) — every acked request exactly once across epochs, fork-free,
+    per-shard gapless enforced live by the mux."""
+
+    async def run():
+        import tempfile
+
+        with tempfile.TemporaryDirectory(prefix="reshard-crash-") as root:
+            cluster = ShardedCluster(root, shards=2, n=4, depth=2, seed=3,
+                                     collect_entries=True,
+                                     reshard_drain_deadline=120.0)
+            await cluster.start()
+            try:
+                report = await run_reshard_schedule(
+                    cluster,
+                    reshard_schedule(out_at=1.0, out_to=4, in_at=6.0,
+                                     in_to=3, crash_shard=0, crash_node=3,
+                                     restart_at=10.0),
+                    requests=12, submit_every=0.15, settle_timeout=400.0)
+                assert_exactly_once_across_epochs(cluster, report)
+                assert cluster.set.num_shards == 3
+                assert cluster.set.epoch == 2
+                assert report.shard_counts_seen == [2, 4, 3]
+                crashes = [e for e in report.events_fired
+                           if e.action == "crash_during_reshard"]
+                assert crashes, "the crash never fired"
+            finally:
+                await cluster.stop()
+
+    asyncio.run(run())
+
+
+@pytest.mark.slow
+def test_reshard_soak_slow():
+    """`python -m smartbft_tpu.testing.chaos --soak --reshard`, in-tree."""
+    asyncio.run(reshard_soak(rounds=2, verbose=False))

@@ -65,7 +65,7 @@ def test_router_reshard_moves_minimally():
     r = ShardRouter(4, seed=2)
     before = {f"c{k}": r.route(f"c{k}") for k in range(2000)}
     info = r.reshard(5)
-    assert info == {"old": 4, "new": 5}
+    assert info == {"old": 4, "new": 5, "epoch": 1}
     moved = 0
     for cid, old in before.items():
         new = r.route(cid)
@@ -119,19 +119,26 @@ def test_mux_combined_stream_and_invariants():
         mux.ingest(7, "d", seq=1)
 
 
-def test_set_rejects_submit_after_unrebuilt_reshard():
-    """reshard() re-points the MAPPING only; a set that was not rebuilt
-    for the new shard count refuses routed-out clients loudly instead of
-    dying with a bare KeyError at the front door."""
+def test_set_submit_pins_the_active_epoch():
+    """The front door routes in the set's ACTIVE epoch: an out-of-band
+    ``router.reshard()`` (the pre-elastic "rebuild the world" move)
+    installs a newer mapping in the router but cannot re-bucket the
+    set's live traffic — every submit still lands on a shard the set
+    actually has, on the old mapping, until ShardSet.reshard() runs the
+    epoch protocol and flips."""
     from smartbft_tpu.shard import ShardHandle, ShardSet
 
     class _Stub(ShardHandle):
         def __init__(self, sid):
             self.shard_id = sid
+            self.got = []
 
         async def start(self): ...
         async def stop(self): ...
-        async def submit(self, raw): ...
+
+        async def submit(self, raw):
+            self.got.append(raw)
+
         def poll_committed(self, since):
             return []
 
@@ -140,12 +147,12 @@ def test_set_rejects_submit_after_unrebuilt_reshard():
 
     async def run():
         s = ShardSet([_Stub(0), _Stub(1)])
-        s.router.reshard(8)
-        # some client now routes outside 0..1; find one and submit it
-        cid = next(f"c{k}" for k in range(10_000)
-                   if s.router.route(f"c{k}") >= 2)
-        with pytest.raises(ValueError, match="rebuild the ShardSet"):
-            await s.submit(cid, b"payload")
+        before = {f"c{k}": s.route(f"c{k}") for k in range(64)}
+        s.router.reshard(8)  # out-of-band: NOT the epoch protocol
+        assert s.epoch == 0  # the set's active epoch is unmoved
+        for cid, sid in before.items():
+            assert s.route(cid) == sid  # epoch-pinned routing
+            assert await s.submit(cid, b"payload") == sid
 
     asyncio.run(run())
 
