@@ -48,6 +48,17 @@ sockets on localhost (the ``smartbft_tpu.net`` subsystem) — and prints a
 JSON line whose ``transport`` block carries bytes on the wire, frames
 per flush (write coalescing), reconnects, and drops, paired against the
 in-process tx/s.
+
+Open-loop mode: ``--open-loop`` (or SMARTBFT_BENCH_OPENLOOP=1) runs
+benchmarks/openloop.py — Poisson arrivals at swept offered loads over
+Zipf-skewed clients against the admission-controlled sharded front door
+— and prints a JSON line whose ``latency`` block carries the
+submit→commit percentiles (p50/p95/p99, log-scale histogram), shed
+counts, the saturation knee, and the per-degraded-phase percentiles
+(breaker-open / view-change / reshard) of the fixed-rate degraded run.
+The subprocess timeout is DERIVED from the sweep size and phase plan so
+a stuck point degrades inside the child (which salvages the other rows)
+instead of this parent killing the whole block.
 """
 
 from __future__ import annotations
@@ -355,6 +366,102 @@ def sharded_bench(shards: str, cpu_mode: bool) -> None:
     }), flush=True)
 
 
+def assemble_open_loop_row(rows: list) -> dict:
+    """Fold benchmarks/openloop.py's JSON lines into the ONE bench.py
+    open-loop row.  Pure function, importable — tests/test_overload.py
+    pins the ``latency`` block schema against it exactly as
+    tests/test_verify_plane.py pins the breaker block.
+
+    The row contract: ``latency`` carries the sweep-wide percentiles and
+    histogram of the HIGHEST offered load that still met the SLO (or the
+    top point when everything overloaded — worst honest number, never an
+    empty block), the shed counts, the knee, and ``phases`` with the
+    degraded run's per-phase (breaker_open / view_change / reshard)
+    percentiles."""
+    sweep = [r for r in rows if r.get("bench") == "openloop"]
+    knee = next((r for r in rows if r.get("metric") == "open_loop_knee"), {})
+    degraded = next(
+        (r for r in rows if r.get("metric") == "open_loop_degraded"), {}
+    )
+    if not sweep:
+        raise RuntimeError("open-loop sweep produced no rows")
+    last_ok = (knee.get("last_ok") or {}).get("offered_per_sec")
+    anchor = next(
+        (r for r in sweep if r["offered_per_sec"] == last_ok),
+        max(sweep, key=lambda r: r["offered_per_sec"]),
+    )
+    latency = dict(anchor["latency"])
+    latency["shed"] = dict(
+        latency.get("shed") or {},
+        **{k: anchor["open_loop"][k]
+           for k in ("shed_admission", "shed_timeout")},
+    )
+    latency["knee"] = {
+        k: knee.get(k) for k in ("slo", "last_ok", "first_overloaded",
+                                 "beyond_sweep")
+    }
+    latency["phases"] = degraded.get("phases", {})
+    return {
+        "metric": "open_loop_p99_ms",
+        "value": latency.get("p99_ms", 0.0),
+        "unit": "ms",
+        "offered_per_sec": anchor["offered_per_sec"],
+        "goodput_per_sec": anchor["goodput_per_sec"],
+        "shards": anchor.get("shards"),
+        "zipf_skew": anchor.get("zipf_skew"),
+        "admission_high_water": anchor.get("admission_high_water"),
+        "sweep": [
+            {k: r.get(k) for k in ("offered_per_sec", "goodput_per_sec")}
+            | {"p99_ms": r["latency"]["p99_ms"],
+               "shed_rate": r["open_loop"]["shed_rate"],
+               "peak_occupancy": r["open_loop"]["peak_occupancy"]}
+            for r in sweep
+        ],
+        "degraded_notes": degraded.get("notes"),
+        "latency": latency,
+    }
+
+
+def open_loop_bench(cpu_mode: bool) -> None:
+    """Run benchmarks/openloop.py in a subprocess and print ONE JSON line
+    whose ``latency`` block carries percentiles + histogram + shed counts
+    + knee + degraded-phase percentiles (the round-12 contract)."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    rates = os.environ.get("SMARTBFT_BENCH_OPENLOOP_RATES",
+                           "200,400,800,1600")
+    duration = float(os.environ.get("SMARTBFT_BENCH_OPENLOOP_DURATION", "8"))
+    phase = float(os.environ.get("SMARTBFT_BENCH_OPENLOOP_PHASE", "6"))
+    drain = 3.0
+    cmd = [sys.executable, os.path.join(here, "benchmarks", "openloop.py"),
+           "--rates", rates, "--duration", str(duration),
+           "--phase-duration", str(phase)]
+    if cpu_mode:
+        cmd.append("--cpu")
+    points = len([r for r in rates.split(",") if r.strip()])
+    phase_timeout = float(os.environ.get(
+        "SMARTBFT_BENCH_OPENLOOP_PHASE_TIMEOUT", "60"))
+    # derived, not guessed (the PR-5/7 salvage lesson): every sweep point
+    # may burn its duration + drain + a stuck-cluster teardown, and the
+    # degraded run is 5 pumped phases plus 4 bounded waits (breaker
+    # open/close, depose, quiesce x2 share one budget each) plus a drain
+    # deadline — the child's own salvage fires before this parent kills it
+    timeout = float(os.environ.get(
+        "SMARTBFT_BENCH_OPENLOOP_TIMEOUT",
+        str(points * (duration + drain + phase_timeout)
+            + 5 * (phase + drain) + 5 * phase_timeout + 120)))
+    proc = subprocess.run(
+        cmd, timeout=timeout, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"open-loop bench failed: "
+            f"{proc.stderr.decode(errors='replace')[-400:]}"
+        )
+    rows = [json.loads(l) for l in proc.stdout.decode().splitlines()
+            if l.strip()]
+    print(json.dumps(assemble_open_loop_row(rows)), flush=True)
+
+
 def transport_bench(flavor: str) -> None:
     """Run benchmarks/transport.py paired (inproc + the chosen socket
     flavor, SAME workload/protocol stack, only the Comm seam differs) and
@@ -406,6 +513,15 @@ def main() -> None:
              "per-shard + aggregate `shard` block",
     )
     ap.add_argument(
+        "--open-loop", action="store_true",
+        default=os.environ.get("SMARTBFT_BENCH_OPENLOOP", "") == "1",
+        help="additionally run the open-loop service-level bench "
+             "(benchmarks/openloop.py): Poisson/Zipf arrivals against the "
+             "admission-controlled sharded front door, emitting a "
+             "`latency` block (p50/p95/p99, shed counts, saturation knee, "
+             "per-degraded-phase percentiles) in the JSON row",
+    )
+    ap.add_argument(
         "--transport", default=os.environ.get("SMARTBFT_BENCH_TRANSPORT", ""),
         choices=("", "inproc", "tcp", "uds"),
         help="additionally run the paired transport bench (benchmarks/"
@@ -432,6 +548,12 @@ def main() -> None:
             sharded_bench(args.shards, cpu_mode)
         except Exception as exc:  # noqa: BLE001 — sharded row is additive
             _log(f"bench: sharded sweep failed ({type(exc).__name__}: {exc})")
+
+    if args.open_loop:
+        try:
+            open_loop_bench(cpu_mode)
+        except Exception as exc:  # noqa: BLE001 — open-loop row is additive
+            _log(f"bench: open-loop bench failed ({type(exc).__name__}: {exc})")
 
     if args.transport:
         try:

@@ -98,6 +98,22 @@ class Configuration:
     request_max_bytes: int = 10 * 1024
     request_pool_submit_timeout: float = 5.0
 
+    # Admission control at the front door (no reference counterpart — the
+    # reference's pool blocks submitters on a weighted semaphore forever;
+    # a service past its saturation knee must SHED, not queue unboundedly:
+    # PBFT's own overload story assumes excess load is dropped, and queue
+    # growth past the knee buys only latency, never goodput).  Consumed by
+    # core.pool.Pool via PoolOptions; rides ConfigMirror/reconfig.
+    # - admission_high_water: fraction of request_pool_size at which
+    #   submit stops queueing and fails fast with AdmissionRejected
+    #   (retry-after hint derived from the measured drain rate).  The
+    #   gate input counts pooled requests PLUS parked submitters.  1.0
+    #   (default) disables shedding — pure bounded-wait semantics.
+    # request_pool_submit_timeout above doubles as the TOTAL bound a
+    # submitter may spend parked on pool space (one deadline across every
+    # re-park), so even with the gate off callers shed instead of wedging.
+    admission_high_water: float = 1.0
+
     # Pipelined in-flight window (no reference counterpart — the reference
     # keeps exactly one sequence in flight: the leader re-acquires the
     # propose token only after the current decision delivers,
@@ -246,6 +262,12 @@ class Configuration:
             )
         if self.verify_launch_retries < 0:
             raise ConfigError("verify_launch_retries should not be negative")
+        if not (0.0 < self.admission_high_water <= 1.0):
+            raise ConfigError(
+                "admission_high_water must be in (0, 1] (a fraction of "
+                f"request_pool_size; 1.0 disables shedding), got "
+                f"{self.admission_high_water}"
+            )
         if self.transport_reconnect_backoff_base > self.transport_reconnect_backoff_max:
             raise ConfigError(
                 "transport_reconnect_backoff_base is bigger than "

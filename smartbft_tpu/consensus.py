@@ -255,6 +255,7 @@ class Consensus:
                 auto_remove_timeout=self.config.request_auto_remove_timeout,
                 request_max_bytes=self.config.request_max_bytes,
                 submit_timeout=self.config.request_pool_submit_timeout,
+                admission_high_water=self.config.admission_high_water,
             ),
         )
         self._continue_create_components()
@@ -333,11 +334,17 @@ class Consensus:
         if self.controller is not None:
             await self.controller.handle_request(sender, req)
 
-    async def submit_request(self, req: bytes) -> None:
-        """consensus.go:309-317."""
+    async def submit_request(self, req: bytes, *, internal: bool = False) -> None:
+        """consensus.go:309-317.  ``internal`` marks a control-plane
+        submission (reshard barrier, operator command): it bypasses the
+        client-facing admission gate — under sustained overload the gate
+        would otherwise shed the very commands that remediate the
+        overload (a scale-out's barrier, a pool-resizing reconfig) —
+        while still riding the pool's hard capacity bound and submit
+        deadline."""
         if self.get_leader_id() == 0:
             raise RuntimeError("no leader")
-        await self.controller.submit_request(req)
+        await self.controller.submit_request(req, forwarded=internal)
 
     def pool_occupancy(self) -> dict:
         """This node's request-pool backpressure snapshot (empty before
@@ -488,6 +495,7 @@ class Consensus:
                 auto_remove_timeout=self.config.request_auto_remove_timeout,
                 request_max_bytes=self.config.request_max_bytes,
                 submit_timeout=self.config.request_pool_submit_timeout,
+                admission_high_water=self.config.admission_high_water,
             ),
             self.scheduler,
             metrics=self.metrics.pool,

@@ -224,11 +224,15 @@ class Controller(RequestTimeoutHandler):
 
     # ------------------------------------------------------------------ requests
 
-    async def submit_request(self, request: bytes) -> None:
-        """consensus entry (controller.go:249-264)."""
+    async def submit_request(self, request: bytes, *,
+                             forwarded: bool = False) -> None:
+        """consensus entry (controller.go:249-264).  ``forwarded`` marks a
+        follower's forward landing here: it bypasses the admission gate
+        (the request already holds a pool slot cluster-side; shedding it
+        would only re-arm the follower's complain timer)."""
         info = self.request_inspector.request_id(request)
         try:
-            await self.request_pool.submit(request)
+            await self.request_pool.submit(request, forwarded=forwarded)
         except Exception as e:
             self.logger.infof("Request %s was not submitted, error: %s", info, e)
             raise
@@ -249,7 +253,7 @@ class Controller(RequestTimeoutHandler):
             self.logger.warnf("Got bad request from %d: %s", sender, e)
             return
         try:
-            await self.submit_request(req)
+            await self.submit_request(req, forwarded=True)
         except Exception as e:
             # the reference warns on forwarded-submit failure too
             # (controller.go:258-263); a full pool here is routine under

@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import abc
 import asyncio
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from dataclasses import dataclass
 from typing import Callable, Optional
 
@@ -50,6 +50,26 @@ class SubmitTimeoutError(PoolError):
     pass
 
 
+class AdmissionRejected(PoolError):
+    """Fast-fail shed at the admission gate: pool occupancy (pooled
+    requests + already-parked submitters) is past the configured
+    high-water mark, so this submit is REFUSED immediately instead of
+    parking behind a queue that is already past its knee.
+
+    ``retry_after`` is the hint (seconds, same clock as the pool's
+    scheduler) derived from the measured drain rate: roughly how long the
+    pool needs to drain back below the high-water mark.  A client that
+    retries sooner will very likely be shed again; one that waits it out
+    arrives when capacity plausibly exists.  ``occupancy`` snapshots the
+    gate's inputs at rejection time."""
+
+    def __init__(self, message: str, *, retry_after: float = 0.0,
+                 occupancy: Optional[dict] = None):
+        super().__init__(message)
+        self.retry_after = retry_after
+        self.occupancy = occupancy or {}
+
+
 class PoolClosedError(PoolError):
     pass
 
@@ -74,7 +94,17 @@ class PoolOptions:
     complain_timeout: float = 10.0
     auto_remove_timeout: float = 10.0
     request_max_bytes: int = 100 * 1024
+    #: TOTAL wall/logical seconds one submit may spend parked on space —
+    #: a single bound across every re-park, not per-wait (a pre-overload
+    #: bug let each wakeup re-arm a fresh timeout, so a submitter could
+    #: park forever under sustained contention)
     submit_timeout: float = 10.0
+    #: admission gate: fraction of queue_size at which submit stops
+    #: queueing and fails fast with AdmissionRejected.  The gate input is
+    #: pooled requests PLUS already-parked submitters (queueing theory's
+    #: "system size", not just the buffer).  >= 1.0 disables shedding —
+    #: the pre-overload parking semantics.
+    admission_high_water: float = 1.0
 
 
 class _Item:
@@ -149,13 +179,52 @@ class Pool:
         # map + slice pair; popping oldest entries from one dict halves the
         # per-removal hash traffic on the n=64 bulk-removal hot path)
         self._del_map: "OrderedDict[RequestInfo, None]" = OrderedDict()
-        self._space_waiters: "list[asyncio.Future]" = []
+        self._space_waiters: "deque[asyncio.Future]" = deque()
+        # slots promised to woken-but-not-yet-resumed waiters: counted as
+        # occupied by every capacity check so a fresh submitter cannot
+        # barge into a slot during the one-loop-hop wake window
+        self._reserved_slots = 0
+        # overload accounting: sheds by cause + a drain-rate estimate for
+        # the AdmissionRejected retry-after hint (see _note_drained)
+        self.shed_admission = 0
+        self.shed_timeout = 0
+        self._drain_anchor = scheduler.now()
+        self._drain_accum = 0
+        self._drain_rate = 0.0  # requests/sec, EWMA over DRAIN_WINDOW spans
 
     # ------------------------------------------------------------------ submit
 
-    async def submit(self, request: bytes) -> None:
-        """Add a request; dedups against in-pool and recently-deleted; waits
-        up to submit_timeout for space (requestpool.go:191-284)."""
+    def _admission_slots(self) -> Optional[int]:
+        """The high-water mark in SLOTS, or None when the gate is off."""
+        hw = self._opts.admission_high_water
+        if hw >= 1.0:
+            return None
+        return max(1, int(hw * self._opts.queue_size))
+
+    async def submit(self, request: bytes, *, forwarded: bool = False) -> None:
+        """Add a request; dedups against in-pool and recently-deleted.
+
+        Overload contract (requestpool.go:191-284, hardened):
+
+        * **admission gate** — with ``admission_high_water`` < 1, a pool
+          whose system size (pooled + parked submitters) is at/past the
+          mark sheds THIS submit immediately with :class:`AdmissionRejected`
+          (retry-after hint from the drain rate) instead of queueing past
+          the knee.  ``forwarded=True`` (a follower's forward landing at
+          the leader) BYPASSES the gate: the request already holds a pool
+          slot cluster-side and shedding it here would only re-arm the
+          follower's complain timer — internal forwards ride the existing
+          timeout chain, the gate guards the client-facing door (README
+          "Overload behavior");
+        * **bounded wait** — below the mark but full, the submitter parks
+          for at most ``submit_timeout`` TOTAL (one deadline across every
+          re-park), then sheds with :class:`SubmitTimeoutError`; a shed
+          submitter's request is in no pool;
+        * **FIFO fairness** — parked submitters are woken oldest-first,
+          and a fresh submitter never barges past them even when a removal
+          just freed a slot (it parks at the tail; a woken waiter that
+          loses a race re-parks at the HEAD, keeping its place).
+        """
         info = self._inspector.request_id(request)
         if self._closed:
             raise PoolClosedError(f"pool closed, request rejected: {info}")
@@ -168,18 +237,51 @@ class Pool:
             )
         self._check_dup(info)
 
-        while len(self._items) >= self._opts.queue_size:
+        hw = self._admission_slots() if not forwarded else None
+        if hw is not None \
+                and len(self._items) + self._reserved_slots \
+                + len(self._space_waiters) >= hw:
+            self.shed_admission += 1
+            if self._metrics:
+                self._metrics.count_of_failed_add_requests.with_labels("admission").add(1)
+            raise AdmissionRejected(
+                f"admission control: pool at "
+                f"{len(self._items)}+{len(self._space_waiters)} of "
+                f"high-water {hw}/{self._opts.queue_size}, request shed: "
+                f"{info}",
+                retry_after=self.retry_after_hint(),
+                occupancy=self.occupancy(),
+            )
+
+        deadline = self._scheduler.now() + self._opts.submit_timeout
+        at_head = False
+        while len(self._items) + self._reserved_slots >= self._opts.queue_size \
+                or (self._space_waiters and not at_head):
+            remaining = deadline - self._scheduler.now()
+            if remaining <= 0:
+                self.shed_timeout += 1
+                if self._metrics:
+                    self._metrics.count_of_failed_add_requests.with_labels("semaphore").add(1)
+                raise SubmitTimeoutError(
+                    f"timeout submitting to request pool: {info}"
+                )
             fut: asyncio.Future = asyncio.get_running_loop().create_future()
-            self._space_waiters.append(fut)
+            if at_head:
+                self._space_waiters.appendleft(fut)
+            else:
+                self._space_waiters.append(fut)
             timer = self._scheduler.schedule(
-                self._opts.submit_timeout,
+                remaining,
                 lambda: fut.done() or fut.set_exception(
                     SubmitTimeoutError(f"timeout submitting to request pool: {info}")
                 ),
             )
+            woken_clean = False
             try:
                 await fut
+                woken_clean = True
             except SubmitTimeoutError:
+                self.shed_timeout += 1
                 if self._metrics:
                     self._metrics.count_of_failed_add_requests.with_labels("semaphore").add(1)
                 raise
@@ -187,10 +289,31 @@ class Pool:
                 timer.cancel()
                 if fut in self._space_waiters:
                     self._space_waiters.remove(fut)
+                    # this waiter left the queue without consuming a slot;
+                    # the new head must not strand on capacity it now owns
+                    self._release_space()
+                elif fut.done() and not fut.cancelled() \
+                        and fut.exception() is None:
+                    # woken by _release_space: stop reserving its slot —
+                    # filled synchronously below on the clean path
+                    self._reserved_slots -= 1
+                    if not woken_clean:
+                        # woken but exiting abnormally (task cancelled in
+                        # the wake window): hand the freed slot to the next
+                        # waiter instead of stranding it until a removal
+                        self._release_space()
             if self._closed:
                 raise PoolClosedError(f"pool closed, request rejected: {info}")
-            # space may have been taken by another waiter; dedup again and loop
-            self._check_dup(info)
+            # space may have been taken by another woken waiter; dedup
+            # again and loop — re-parking at the HEAD keeps FIFO order
+            at_head = True
+            try:
+                self._check_dup(info)
+            except PoolError:
+                # this waiter exits without filling the slot it was woken
+                # for — hand it to the next in line, don't strand them
+                self._release_space()
+                raise
 
         timer = self._scheduler.schedule(
             self._opts.forward_timeout, lambda: self._on_request_to(request, info)
@@ -202,6 +325,9 @@ class Pool:
         self._size_bytes += len(request)
         if self._metrics:
             self._metrics.count_of_requests.set(len(self._items))
+        # the fairness rule parks fresh submitters behind existing waiters
+        # even when a slot is free; hand any remaining capacity to them now
+        self._release_space()
         self._on_submitted()
 
     def _check_dup(self, info: RequestInfo) -> None:
@@ -223,15 +349,76 @@ class Pool:
         block of the sharded front door's combined occupancy surface
         (shard.ShardSet.occupancy sums these across shards).  ``free`` is
         how many submits can land before :meth:`submit` starts waiting;
-        ``waiters`` is how many submitters are ALREADY parked on space."""
+        ``waiters`` is how many submitters are ALREADY parked on space;
+        the ``shed_*`` counters and ``drain_rate`` are the admission
+        gate's outputs (README "Overload behavior")."""
+        hw = self._admission_slots()
         return {
             "size": len(self._items),
             "bytes": self._size_bytes,
             "capacity": self._opts.queue_size,
-            "free": max(0, self._opts.queue_size - len(self._items)),
+            # reserved slots are promised to woken waiters — not free, or
+            # this would overstate headroom submit() will refuse to honor
+            "free": max(0, self._opts.queue_size - len(self._items)
+                        - self._reserved_slots),
             "in_flight": len(self._in_flight),
-            "waiters": len(self._space_waiters),
+            # reserved slots belong to woken-but-not-yet-resumed waiters:
+            # still counted so the reshard drain (which must wait out every
+            # space-waiter) cannot observe a spuriously clean pool
+            "waiters": len(self._space_waiters) + self._reserved_slots,
+            "high_water": hw if hw is not None else self._opts.queue_size,
+            "shed_admission": self.shed_admission,
+            "shed_timeout": self.shed_timeout,
+            "drain_rate": round(self._drain_rate, 3),
         }
+
+    # -- drain-rate estimate (the retry-after hint's input) ----------------
+
+    #: seconds of scheduler time one drain-rate sample spans; short enough
+    #: to track a breaker trip's capacity collapse within a few waves,
+    #: long enough that one bulk removal does not read as a steady rate
+    DRAIN_WINDOW = 0.5
+
+    def _note_drained(self, n: int) -> None:
+        """Fold ``n`` removals into the drain-rate EWMA.  Called on every
+        removal path; O(1), two float ops per call outside window edges."""
+        if n <= 0:
+            return
+        self._drain_accum += n
+        now = self._scheduler.now()
+        dt = now - self._drain_anchor
+        if dt >= self.DRAIN_WINDOW:
+            inst = self._drain_accum / dt
+            self._drain_rate = inst if self._drain_rate <= 0.0 \
+                else 0.5 * self._drain_rate + 0.5 * inst
+            self._drain_anchor = now
+            self._drain_accum = 0
+
+    def retry_after_hint(self) -> float:
+        """Seconds until the pool plausibly drains back below the
+        admission high-water mark at the measured drain rate.  With no
+        rate measured yet (cold pool, stalled consensus) the hint is the
+        submit timeout — the bound a parked caller would have waited."""
+        hw = self._admission_slots()
+        if hw is None:
+            return 0.0
+        # the same system-size expression the gate rejects on — a hint
+        # computed from a smaller occupancy would invite an early retry
+        # that gets shed again
+        excess = (len(self._items) + self._reserved_slots
+                  + len(self._space_waiters) - hw + 1)
+        if excess <= 0:
+            return 0.0
+        now = self._scheduler.now()
+        rate = self._drain_rate
+        # fold the (possibly newer) partial window in so the hint reacts
+        # to a drain that started after the last window edge
+        dt = now - self._drain_anchor
+        if dt >= self.DRAIN_WINDOW and self._drain_accum:
+            rate = max(rate, self._drain_accum / dt)
+        if rate <= 0.0:
+            return self._opts.submit_timeout
+        return min(max(excess / rate, 0.001), self._opts.auto_remove_timeout)
 
     def pending_infos(self) -> list[RequestInfo]:
         """Every request still pooled (including in-flight reservations),
@@ -313,7 +500,7 @@ class Pool:
         through the recently-deleted dedup map, exactly like
         :meth:`remove_request`."""
         missing = 0
-        removed = False
+        removed = 0
         for info in infos:
             self._in_flight.discard(info)
             item = self._items.pop(info, None)
@@ -321,7 +508,7 @@ class Pool:
                 self._move_to_del(info)
                 missing += 1
                 continue
-            removed = True
+            removed += 1
             if item.timer is not None:
                 item.timer.cancel()
             self._size_bytes -= len(item.request)
@@ -345,6 +532,7 @@ class Pool:
                 self._metrics.count_of_requests.set(len(self._items))
             except Exception:
                 pass
+        self._note_drained(removed)
         self._release_space()
         return missing
 
@@ -369,6 +557,7 @@ class Pool:
                 )
             except Exception:
                 pass
+        self._note_drained(1)
         self._release_space()
 
     def _move_to_del(self, info: RequestInfo) -> None:
@@ -385,11 +574,13 @@ class Pool:
         # removal path frees hundreds of slots in one call; waking just one
         # would strand the rest until their submit_timeout).  Overwaking is
         # harmless: submit() re-checks capacity in a while loop.
-        capacity = self._opts.queue_size - len(self._items)
+        capacity = (self._opts.queue_size - len(self._items)
+                    - self._reserved_slots)
         while self._space_waiters and capacity > 0:
-            fut = self._space_waiters.pop(0)
+            fut = self._space_waiters.popleft()
             if not fut.done():
                 fut.set_result(None)
+                self._reserved_slots += 1
                 capacity -= 1
 
     # ------------------------------------------------------------------ timers

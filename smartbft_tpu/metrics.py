@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import abc
 import contextvars
+import math
 import threading
 import weakref
 from dataclasses import dataclass, field
@@ -523,6 +524,225 @@ def tpu_counters_aggregate(providers: Sequence[InMemoryProvider]) -> dict:
             if ".tpu." in key:
                 out[key + "_count"] = out.get(key + "_count", 0.0) + len(vals)
     return out
+
+
+# ---------------------------------------------------------------------------
+# Commit-latency accounting (the open-loop service surface: README
+# "Overload behavior", benchmarks/openloop.py, bench.py --open-loop)
+# ---------------------------------------------------------------------------
+
+
+class LogScaleHistogram:
+    """Fixed-bucket log-scale histogram with BOUNDED memory.
+
+    The in-memory provider's histograms append every observation — fine
+    for bench windows, fatal for a service recording one sample per
+    request forever.  This histogram is a fixed array of geometric
+    buckets (default: 1 µs low edge, √2 growth, 64 buckets ≈ 1 µs..100 s
+    span), so a billion observations cost the same 64 ints.  Quantiles
+    come from the cumulative bucket walk and are reported at the bucket's
+    geometric midpoint — ≤ ~±19% relative error at √2 growth, far inside
+    the run-to-run noise of any latency measurement this repo makes."""
+
+    __slots__ = ("low", "growth", "buckets", "count", "total", "max_seen",
+                 "min_seen", "_log_low", "_log_growth")
+
+    def __init__(self, low: float = 1e-6, growth: float = 2.0 ** 0.5,
+                 nbuckets: int = 64):
+        self.low = low
+        self.growth = growth
+        self.buckets = [0] * nbuckets
+        self.count = 0
+        self.total = 0.0
+        self.max_seen = 0.0
+        self.min_seen = float("inf")
+        self._log_low = math.log(low)
+        self._log_growth = math.log(growth)
+
+    def observe(self, value: float) -> None:
+        self.count += 1
+        self.total += value
+        if value > self.max_seen:
+            self.max_seen = value
+        if value < self.min_seen:
+            self.min_seen = value
+        if value <= self.low:
+            idx = 0
+        else:
+            idx = int((math.log(value) - self._log_low) / self._log_growth)
+            idx = min(max(idx, 0), len(self.buckets) - 1)
+        self.buckets[idx] += 1
+
+    def quantile(self, q: float) -> float:
+        """The q-quantile (0..1) at the owning bucket's geometric midpoint,
+        clamped into the observed [min, max] envelope; 0.0 when empty."""
+        if not self.count:
+            return 0.0
+        rank = max(1, int(q * self.count + 0.999999))  # ceil, 1-based
+        seen = 0
+        for i, n in enumerate(self.buckets):
+            seen += n
+            if seen >= rank:
+                mid = self.low * (self.growth ** (i + 0.5))
+                return min(max(mid, self.min_seen), self.max_seen)
+        return self.max_seen
+
+    def snapshot(self) -> dict:
+        """JSON-able percentile block (milliseconds, the service unit)."""
+        ms = 1e3
+        return {
+            "count": self.count,
+            "p50_ms": round(self.quantile(0.50) * ms, 3),
+            "p95_ms": round(self.quantile(0.95) * ms, 3),
+            "p99_ms": round(self.quantile(0.99) * ms, 3),
+            "mean_ms": round(self.total / self.count * ms, 3)
+            if self.count else 0.0,
+            "max_ms": round(self.max_seen * ms, 3),
+        }
+
+    def nonzero_buckets(self) -> dict:
+        """Sparse bucket dump for the bench row's ``histogram`` block:
+        {upper_edge_ms: count} for every non-empty bucket."""
+        out = {}
+        for i, n in enumerate(self.buckets):
+            if n:
+                edge_ms = self.low * (self.growth ** (i + 1)) * 1e3
+                out[f"{edge_ms:.3g}"] = n
+        return out
+
+
+class CommitLatencyTracker:
+    """Per-request submit→commit latency for a sharded front door.
+
+    The ShardSet stamps each request's arrival at ``submit`` (BEFORE any
+    admission/backpressure wait — the latency a client experiences
+    includes the queueing) and resolves the stamp when the request id
+    appears in the combined committed stream.  Aggregated into
+    :class:`LogScaleHistogram` buckets per shard + overall, with shed
+    counters (requests refused by admission control or timed out of the
+    space wait) alongside — a latency distribution without its shed rate
+    is survivor bias.
+
+    **Phases.**  ``begin_phase(name)`` opens a named window (histogram +
+    shed deltas) that subsequent commits/sheds also land in — how the
+    degraded-mode SLO runs attribute p99 to "breaker open" vs "view
+    change" vs "reshard" without re-running the workload per fault.
+
+    **Bounded memory.**  Histograms are fixed arrays; the pending-stamp
+    map is capped at ``max_pending`` — beyond it the OLDEST stamp is
+    dropped and counted (an overloaded front door sheds; it never grows
+    an unbounded latency map).  ``clock`` is injectable: wall
+    ``time.monotonic`` in production/bench, the logical ``Scheduler.now``
+    in deterministic tests."""
+
+    def __init__(self, clock=None, max_pending: int = 65536):
+        import collections
+        import time as _time
+
+        self._clock = clock if clock is not None else _time.monotonic
+        self._pending: "collections.OrderedDict[str, float]" = \
+            collections.OrderedDict()
+        self.max_pending = max_pending
+        self.dropped_stamps = 0
+        self.aggregate = LogScaleHistogram()
+        self.per_shard: dict[int, LogScaleHistogram] = {}
+        self.shed = {"admission": 0, "timeout": 0, "other": 0}
+        self._phases: "dict[str, dict]" = {}
+        self._phase_order: list[str] = []
+        self._current_phase: Optional[dict] = None
+
+    # -- stamping ----------------------------------------------------------
+
+    def on_submitted(self, key: str) -> bool:
+        """Stamp ``key``'s arrival (front-door entry, pre-queueing).
+
+        A key already pending keeps its ORIGINAL stamp — a client
+        retrying a still-in-flight request experiences latency from its
+        FIRST submit, and overwriting would let the pool's dedup path
+        erase the measurement of exactly the slow (hence retried)
+        requests.  Returns True when a fresh stamp was created."""
+        key = str(key)
+        if key in self._pending:
+            return False
+        self._pending[key] = self._clock()
+        if len(self._pending) > self.max_pending:
+            self._pending.popitem(last=False)
+            self.dropped_stamps += 1
+        return True
+
+    def discard(self, key: str) -> None:
+        """Drop a stamp without counting anything (e.g. a submit that
+        turned out to be a duplicate of an ALREADY-COMMITTED request —
+        no commit is coming, and it was not shed either)."""
+        self._pending.pop(str(key), None)
+
+    def on_shed(self, key: Optional[str], kind: str) -> None:
+        """The stamped submit was refused (``admission`` / ``timeout`` /
+        ``other``): drop its stamp, count the shed."""
+        if key is not None:
+            self._pending.pop(str(key), None)
+        kind = kind if kind in self.shed else "other"
+        self.shed[kind] += 1
+        if self._current_phase is not None:
+            self._current_phase["shed"][kind] += 1
+
+    def on_committed(self, key: str, shard_id: int) -> None:
+        """Resolve a stamp against the committed stream; unstamped ids
+        (barrier commands, requests submitted around the tracker) no-op."""
+        t0 = self._pending.pop(str(key), None)
+        if t0 is None:
+            return
+        dt = max(self._clock() - t0, 0.0)
+        self.aggregate.observe(dt)
+        hist = self.per_shard.get(shard_id)
+        if hist is None:
+            hist = self.per_shard[shard_id] = LogScaleHistogram()
+        hist.observe(dt)
+        if self._current_phase is not None:
+            self._current_phase["hist"].observe(dt)
+
+    # -- phases ------------------------------------------------------------
+
+    def begin_phase(self, name: str) -> None:
+        """Open (or re-open) the named attribution window; subsequent
+        commits and sheds land in it until the next begin_phase."""
+        phase = self._phases.get(name)
+        if phase is None:
+            phase = self._phases[name] = {
+                "hist": LogScaleHistogram(),
+                "shed": {k: 0 for k in self.shed},
+            }
+            self._phase_order.append(name)
+        self._current_phase = phase
+
+    def end_phase(self) -> None:
+        self._current_phase = None
+
+    # -- reading -----------------------------------------------------------
+
+    def pending(self) -> int:
+        return len(self._pending)
+
+    def snapshot(self) -> dict:
+        """The JSON-able ``latency`` block every open-loop bench row
+        carries (schema pinned by tests/test_overload.py)."""
+        out = dict(self.aggregate.snapshot())
+        out["shed"] = dict(self.shed)
+        # the raw distribution (sparse {upper_edge_ms: count}), bounded at
+        # 64 entries — what the bench row's "histogram" promise refers to
+        out["histogram"] = self.aggregate.nonzero_buckets()
+        out["pending_stamps"] = len(self._pending)
+        out["dropped_stamps"] = self.dropped_stamps
+        out["per_shard"] = {
+            s: h.snapshot() for s, h in sorted(self.per_shard.items())
+        }
+        if self._phase_order:
+            out["phases"] = {
+                name: dict(self._phases[name]["hist"].snapshot(),
+                           shed=dict(self._phases[name]["shed"]))
+                for name in self._phase_order
+            }
+        return out
 
 
 # ---------------------------------------------------------------------------

@@ -64,6 +64,9 @@ class OccupancyAutoscaler:
         self.step = step
         self._clock = clock
         self._last_action: Optional[float] = None
+        #: cumulative front-door sheds at the last evaluate — an increase
+        #: is a saturation signal in its own right (see evaluate)
+        self._last_shed: Optional[int] = None
         #: decision log for benches/tests: (monotonic, from_s, to_s, why)
         self.decisions: list[tuple] = []
 
@@ -94,24 +97,38 @@ class OccupancyAutoscaler:
         ``occupancy`` is a ``ShardSet.occupancy()`` snapshot: ``fill`` is
         the combined filled fraction, ``total_waiters`` counts submitters
         already parked on a full pool (saturation even when a race just
-        freed a slot)."""
+        freed a slot), and ``shed_admission``/``shed_timeout`` are the
+        front door's cumulative sheds.  Shedding since the last
+        evaluation is a saturation signal in its own right — with an
+        admission gate armed below ``high`` (e.g. hw 0.8 vs high 0.85)
+        fill can NEVER reach the threshold and waiters never form (the
+        gate sheds before the pool fills), so without this signal the
+        autoscaler would watch a shedding cluster forever and never
+        scale out the one remedy it owns."""
         if self.in_cooldown():
             return None
+        # baseline advances only on ACTIONABLE evaluations: sheds that
+        # land mid-cooldown still read as saturation once it expires,
+        # instead of being silently consumed by a held evaluation
+        sheds = int(occupancy.get("shed_admission", 0)) \
+            + int(occupancy.get("shed_timeout", 0))
+        shedding = self._last_shed is not None and sheds > self._last_shed
+        self._last_shed = sheds
         fill = float(occupancy.get("fill", 0.0))
         waiters = int(occupancy.get("total_waiters", 0))
-        saturated = fill >= self.high or waiters > 0
+        saturated = fill >= self.high or waiters > 0 or shedding
         # "nothing reporting" (explicit zero combined capacity — e.g. the
         # pools have not started yet) is indistinguishable from idle by
         # fill alone; hold rather than shrink a deployment that has not
         # come up.  Absent capacity (embedder snapshots without the key)
         # keeps plain fill semantics.
-        idle = (fill <= self.low and waiters == 0
+        idle = (fill <= self.low and waiters == 0 and not shedding
                 and occupancy.get("total_capacity") != 0)
         if saturated and num_shards < self.max_shards:
             target = min(num_shards + self.step, self.max_shards)
             self.decisions.append(
                 (self._clock(), num_shards, target,
-                 f"fill={fill:.2f} waiters={waiters}")
+                 f"fill={fill:.2f} waiters={waiters} shedding={shedding}")
             )
             return target
         if idle and num_shards > self.min_shards:

@@ -65,6 +65,13 @@ from .epoch import (
 )
 from .mux import DeliveryMux, ShardStreamViolation
 from .router import ShardRouter
+from ..core.pool import (
+    AdmissionRejected,
+    ReqAlreadyExistsError,
+    ReqAlreadyProcessedError,
+    SubmitTimeoutError,
+)
+from ..metrics import CommitLatencyTracker
 from ..utils.tasks import create_logged_task
 
 __all__ = ["ShardHandle", "ShardSet"]
@@ -177,7 +184,8 @@ class ShardSet:
     def __init__(self, shards: Sequence, router: Optional[ShardRouter] = None,
                  coalescer=None, *, journal: Optional[EpochJournal] = None,
                  drain_deadline: float = 30.0, retention: int = 4096,
-                 on_deliver: Optional[Callable] = None):
+                 on_deliver: Optional[Callable] = None,
+                 clock: Optional[Callable[[], float]] = None):
         """``shards``: shard handles, one per group; their ``shard_id``
         must be 0..S-1 (the router's bucket space).  ``coalescer``: the
         SHARED AsyncBatchCoalescer all shards verify through — optional,
@@ -191,7 +199,10 @@ class ShardSet:
         waiting for barriers + moved-range drain before it aborts and
         parked submits raise ShardEpochError.  ``retention``: max
         combined entries the mux keeps after they have been handed to the
-        embedder (the automatic prune watermark); <= 0 disables pruning."""
+        embedder (the automatic prune watermark); <= 0 disables pruning.
+        ``clock``: time source for the per-request commit-latency tracker
+        (default wall ``time.monotonic``; deterministic tests inject the
+        logical ``Scheduler.now``)."""
         self.shards = {int(s.shard_id): s for s in shards}
         if sorted(self.shards) != list(range(len(shards))):
             raise ValueError(
@@ -214,6 +225,10 @@ class ShardSet:
         #: shards retired by scale-in flips (stopped, history in the mux)
         self.retired: dict[int, object] = {}
         self.submitted = 0
+        #: submit→commit latency + shed accounting (README "Overload
+        #: behavior"): ``submit(..., request_key=...)`` stamps arrivals,
+        #: ``poll_committed`` resolves them against the combined stream
+        self.latency = CommitLatencyTracker(clock=clock)
         self._epoch = self.router.epoch
         self._next_epoch = self._epoch + 1
         self._transition: Optional[_Transition] = None
@@ -315,38 +330,79 @@ class ShardSet:
     def route(self, client_id) -> int:
         return self.router.route(client_id, epoch=self._epoch)
 
-    async def submit(self, client_id, raw_request: bytes) -> int:
+    async def submit(self, client_id, raw_request: bytes,
+                     *, request_key: Optional[str] = None) -> int:
         """Route ``client_id``'s request to its owning shard (in the
         ACTIVE epoch) and forward into that shard's pool.  Returns the
         shard id it landed on.
 
         Backpressure is PER SHARD and real: a full pool parks this
         submitter exactly as a single-group deployment would (Pool.submit
-        waits up to submit_timeout, then raises), and other shards'
-        intake is unaffected — one hot shard cannot stall the set.
+        waits up to its TOTAL submit deadline, then sheds), and other
+        shards' intake is unaffected — one hot shard cannot stall the
+        set.  With ``admission_high_water`` configured on the shard's
+        pool, an over-the-knee submit fails fast with
+        :class:`~smartbft_tpu.core.pool.AdmissionRejected` (retry-after
+        hint attached) instead of queueing — both shed shapes are counted
+        in ``latency.shed`` and re-raised to the caller.
+
+        ``request_key``: the committed-stream id of this request (the
+        ``str(RequestInfo)`` form, ``"client:request"``).  When given,
+        the front door stamps submit→commit latency for it — arrival is
+        stamped HERE, before any admission/park wait, so the measured
+        latency is what the client experiences.
 
         During a live reshard, a client whose key-range is MOVING parks
         here until the epoch flips (then lands on its new shard); if the
         bounded drain deadline expires first, it gets ShardEpochError.
-        Unmoved clients submit straight through the whole transition."""
-        tr = self._transition
-        if tr is not None and tr.moved(self.router, client_id):
-            tr.parked += 1
-            tr.parked_peak = max(tr.parked_peak, tr.parked)
-            try:
-                await self._wait_for_flip(tr)
-            finally:
-                tr.parked -= 1
-        sid = self.router.route(client_id, epoch=self._epoch)
-        shard = self.shards.get(sid)
-        if shard is None:
-            raise ShardEpochError(
-                f"client {client_id!r} routes to shard {sid} in epoch "
-                f"{self._epoch}, but this set has shards "
-                f"{sorted(self.shards)} — the router was re-pointed "
-                f"outside ShardSet.reshard(); use the epoch protocol"
-            )
-        await shard.submit(raw_request)
+        Unmoved clients submit straight through the whole transition.
+        Parked-at-barrier submitters are COUNTED in :meth:`occupancy`
+        (``total_waiters`` / ``parked_moved``) — the admission gate and
+        the autoscaler must see the same pressure the clients feel."""
+        # fresh=False on a retry of a still-pending request: the ORIGINAL
+        # stamp keeps measuring from the first submit, and a failure of
+        # THIS attempt must not erase it (the pending request still
+        # commits) — dedup/shed handling below keys off `fresh`
+        fresh = (self.latency.on_submitted(request_key)
+                 if request_key is not None else False)
+        try:
+            tr = self._transition
+            if tr is not None and tr.moved(self.router, client_id):
+                tr.parked += 1
+                tr.parked_peak = max(tr.parked_peak, tr.parked)
+                try:
+                    await self._wait_for_flip(tr)
+                finally:
+                    tr.parked -= 1
+            sid = self.router.route(client_id, epoch=self._epoch)
+            shard = self.shards.get(sid)
+            if shard is None:
+                raise ShardEpochError(
+                    f"client {client_id!r} routes to shard {sid} in epoch "
+                    f"{self._epoch}, but this set has shards "
+                    f"{sorted(self.shards)} — the router was re-pointed "
+                    f"outside ShardSet.reshard(); use the epoch protocol"
+                )
+            await shard.submit(raw_request)
+        except ReqAlreadyExistsError:
+            # a retry of a still-pending request: not a shed — the
+            # original stamp stays and resolves when the request commits
+            raise
+        except ReqAlreadyProcessedError:
+            # duplicate of an already-committed request: no commit is
+            # coming for this stamp, and it was not shed either
+            if fresh and request_key is not None:
+                self.latency.discard(request_key)
+            raise
+        except AdmissionRejected:
+            self.latency.on_shed(request_key if fresh else None, "admission")
+            raise
+        except SubmitTimeoutError:
+            self.latency.on_shed(request_key if fresh else None, "timeout")
+            raise
+        except BaseException:
+            self.latency.on_shed(request_key if fresh else None, "other")
+            raise
         self.submitted += 1
         return sid
 
@@ -368,17 +424,28 @@ class ShardSet:
             )
 
     def occupancy(self) -> dict:
-        """Combined submit/backpressure surface over the per-shard pools."""
+        """Combined submit/backpressure surface over the per-shard pools.
+
+        Submitters parked at a reshard barrier (moved clients waiting for
+        the flip) hold requests NO pool can see yet, but they are load
+        all the same: they count into ``total_waiters`` (and separately
+        as ``parked_moved``) so the admission gate's occupancy signal and
+        the autoscaler's saturation signal agree with client-experienced
+        pressure during a transition."""
         per = {s: self.shards[s].pool_occupancy() for s in sorted(self.shards)}
         live = [o for o in per.values() if o]
         total_size = sum(o.get("size", 0) for o in live)
         total_cap = sum(o.get("capacity", 0) for o in live)
+        parked = self._transition.parked if self._transition else 0
         return {
             "per_shard": per,
             "total_size": total_size,
             "total_free": sum(o.get("free", 0) for o in live),
             "total_capacity": total_cap,
-            "total_waiters": sum(o.get("waiters", 0) for o in live),
+            "total_waiters": sum(o.get("waiters", 0) for o in live) + parked,
+            "parked_moved": parked,
+            "shed_admission": sum(o.get("shed_admission", 0) for o in live),
+            "shed_timeout": sum(o.get("shed_timeout", 0) for o in live),
             # the autoscaler's saturation signal: filled fraction of the
             # combined pool capacity (0.0 when nothing is reporting)
             "fill": (total_size / total_cap) if total_cap else 0.0,
@@ -412,6 +479,9 @@ class ShardSet:
                                 request_ids=request_ids)
             self._chain_pos[sid] = pos + len(fresh)
         out = self.mux.since(start)
+        for e in out:
+            for rid in e.request_ids:
+                self.latency.on_committed(rid, e.shard_id)
         tr = self._transition
         if tr is not None and len(tr.barriers) < tr.old_s:
             marker = barrier_marker(tr.epoch)
@@ -718,4 +788,5 @@ class ShardSet:
         reshard["epoch"] = self._epoch
         reshard["in_progress"] = self.reshard_phase
         reshard["watermarks"] = self.mux.snapshot()["watermarks"]
-        return {"per_shard": per_shard, "aggregate": agg, "reshard": reshard}
+        return {"per_shard": per_shard, "aggregate": agg, "reshard": reshard,
+                "latency": self.latency.snapshot()}

@@ -82,12 +82,17 @@ async def submit_barrier_request(consensus, epoch: int, old_shards: int,
                                  new_shards: int) -> None:
     """Order the barrier command through ``consensus``, treating the
     pool's already-exists/already-processed dedup as success (a recovered
-    coordinator re-submits; client dedup makes that exactly-once)."""
+    coordinator re-submits; client dedup makes that exactly-once).
+    ``internal=True``: the barrier must not be shed by the client-facing
+    admission gate — a reshard is how an over-the-knee deployment scales
+    OUT, so the gate refusing its own remediation would lock the cluster
+    into shedding forever."""
     from ..core.pool import ReqAlreadyExistsError, ReqAlreadyProcessedError
 
     try:
         await consensus.submit_request(
-            barrier_request_bytes(epoch, old_shards, new_shards)
+            barrier_request_bytes(epoch, old_shards, new_shards),
+            internal=True,
         )
     except (ReqAlreadyExistsError, ReqAlreadyProcessedError):
         pass
@@ -468,17 +473,23 @@ class App(Application, Assembler, Comm, Signer, Verifier, RequestInspector,
         await self.stop()
         await self.start()
 
-    async def submit(self, client_id: str, request_id: str, payload: bytes = b"") -> None:
+    async def submit(self, client_id: str, request_id: str, payload: bytes = b"",
+                     *, internal: bool = False) -> None:
         req = encode(TestRequest(client_id=client_id, request_id=request_id, payload=payload))
-        await self.consensus.submit_request(req)
+        await self.consensus.submit_request(req, internal=internal)
 
     async def submit_reconfig(
         self, request_id: str, nodes: list[int], config=None
     ) -> None:
-        """Order a reconfiguration transaction (test/reconfig.go pattern)."""
+        """Order a reconfiguration transaction (test/reconfig.go pattern).
+        internal=True: a reconfig is control plane — the one that raises
+        pool capacity or disarms the admission gate must not be shed by
+        the very gate it remediates (Consensus.submit_request rationale)."""
         from .reconfig import reconfig_request_payload
 
-        await self.submit("reconfig", request_id, reconfig_request_payload(nodes, config))
+        await self.submit("reconfig", request_id,
+                          reconfig_request_payload(nodes, config),
+                          internal=True)
 
     def pool_occupancy(self) -> dict:
         """Backpressure snapshot of this node's request pool — the shard

@@ -51,9 +51,13 @@ from typing import Callable, Optional
 
 from ..codec import decode
 from ..config import Configuration
+from ..core.pool import AdmissionRejected, SubmitTimeoutError
 from ..messages import Commit, Prepare, ViewMetadata
+from ..metrics import CommitLatencyTracker
 from ..utils.clock import Scheduler
+from ..utils.tasks import create_logged_task
 from .app import App, SharedLedgers, fast_config, wait_for
+from .load import OpenLoopPump, ZipfClients
 from .network import Network
 
 
@@ -120,6 +124,21 @@ class ChaosEvent:
       tunnel-class errors; ``engine_slow`` (``fraction`` seconds of added
       latency); ``engine_permanent``: compile-class error, trips the
       breaker immediately; ``engine_heal``: clear all device faults.
+
+    Overload actions (the open-loop pump as a schedulable fault — README
+    "Overload behavior"):
+
+    - ``load_spike``: start an OPEN-loop Poisson arrival pump at
+      ``fraction`` arrivals per logical second over a Zipf-skewed client
+      universe of ``count`` ids (``count`` <= 1 means the default 64 —
+      the field's dataclass default is 1); arrivals spawn background
+      submits that ack, shed (admission / space-wait timeout), or fail,
+      all counted in the report, with submit→commit latency stamped per
+      request into the cluster's ``latency`` tracker;
+    - ``load_stop``: stop the pump (outstanding submits finish or shed).
+      A pump still running when the schedule's last event has fired AND
+      the baseline submissions are done gets an implicit stop — the run
+      must drain, not pump to the hard cap.
 
     Elastic-shard actions (consumed by :func:`run_reshard_schedule`
     against a ``ShardedCluster``; ``shard`` scopes node-shaped actions to
@@ -203,10 +222,21 @@ class ChaosReport:
     heal_at: float = 0.0
     leaders_seen: set = field(default_factory=set)
     events_fired: list = field(default_factory=list)
+    # open-loop spike accounting (load_spike / load_stop actions)
+    spike_offered: int = 0
+    spike_acked: int = 0
+    spike_shed_admission: int = 0
+    spike_shed_timeout: int = 0
+    spike_failed: int = 0
+    spike_peak_occupancy: int = 0   # max (pooled + parked) on any live node
 
     @property
     def decisions_after_heal(self) -> int:
         return self.final_decisions - self.decisions_at_heal
+
+    @property
+    def spike_shed(self) -> int:
+        return self.spike_shed_admission + self.spike_shed_timeout
 
 
 # ---------------------------------------------------------------------- cluster
@@ -300,6 +330,18 @@ class ChaosCluster:
         self.partition_minority: set[int] = set()
         #: the node the run's first dynamic "leader" target resolved to
         self.faulty_node: Optional[int] = None
+        #: active open-loop spike (load_spike action), None when stopped
+        self.spike: Optional[dict] = None
+        #: request-id sequence shared by EVERY spike of a run — a second
+        #: load_spike must not re-issue the first one's ids (pool dedup
+        #: would reject its whole burst as duplicates)
+        self._spike_seq = 0
+        self._spike_pending = 0
+        #: per-request submit→commit latency on the LOGICAL clock — fed by
+        #: the spike pump, resolved by the run loop's ledger scan, read by
+        #: overload scenarios (phase p99s via begin_phase)
+        self.latency = CommitLatencyTracker(clock=self.scheduler.now)
+        self._latency_scan_pos = 0
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -414,6 +456,23 @@ class ChaosCluster:
             self._require_engine().permanent_error()
         elif evt.action == "engine_heal":
             self._require_engine().heal()
+        # overload actions: the open-loop pump is a fault like any other —
+        # no node is marked faulted, the point is precisely that honest
+        # traffic keeps arriving at nodes that must now shed
+        elif evt.action == "load_spike":
+            rate = evt.fraction if evt.fraction > 0 else 50.0
+            # count is the Zipf client universe; the ChaosEvent default
+            # (1, shared with engine_fail/reshard semantics) means
+            # "unspecified" — a 1-client spike is a degenerate hammer
+            # nobody schedules deliberately, so <= 1 takes the default 64
+            n_clients = int(evt.count) if int(evt.count) > 1 else 64
+            self.spike = {
+                "pump": OpenLoopPump(rate, self.rng,
+                                     start=self.scheduler.now()),
+                "zipf": ZipfClients(n_clients, prefix="spike"),
+            }
+        elif evt.action == "load_stop":
+            self.spike = None
         else:
             raise ValueError(f"unknown chaos action: {evt.action}")
         return evt
@@ -424,6 +483,21 @@ class ChaosCluster:
                 "engine_* chaos actions need ChaosCluster(engine_faults=True)"
             )
         return self.engine
+
+    def scan_latency_commits(self) -> None:
+        """Resolve latency stamps against the longest live ledger
+        (prefix-consistent, so already-scanned positions are stable).
+        Called every run-loop tick; tests that submit stamped requests
+        AFTER a schedule call it again to resolve the tail."""
+        live = self.live_apps()
+        if not live:
+            return
+        probe = max(live, key=lambda a: a.height())
+        ledger = probe.ledger()
+        for d in ledger[self._latency_scan_pos:]:
+            for info in probe.requests_from_proposal(d.proposal):
+                self.latency.on_committed(str(info), 0)
+        self._latency_scan_pos = len(ledger)
 
     def _corruptor(self, fraction: float):
         """Per-target message corruption.
@@ -462,15 +536,20 @@ class ChaosCluster:
         Requests ``chaos-0..requests-1`` are submitted one per
         ``submit_every`` logical seconds through the ``submit_via`` node
         (0 = rotate over live non-faulted nodes), interleaved with the
-        timeline's events.  After the last event AND last submission, the
-        run continues until every live node committed every request (or
-        ``settle_timeout`` logical seconds pass, which raises)."""
+        timeline's events.  An active ``load_spike`` additionally pumps
+        open-loop Poisson arrivals as background submit tasks (they ack,
+        shed, or fail — all counted; ACKED spike requests join the drain
+        target, shed ones never will).  After the last event AND last
+        submission, the run continues until every live node committed
+        every request (or ``settle_timeout`` logical seconds pass, which
+        raises)."""
         report = ChaosReport()
         pending = sorted(schedule, key=lambda e: e.at)
         now = 0.0
         submitted = 0
         next_submit = 0.0
         heal_seen = False
+        self._spike_pending = 0
 
         def target_app() -> Optional[App]:
             if submit_via:
@@ -478,11 +557,64 @@ class ChaosCluster:
             healthy = self.healthy_apps()
             return healthy[submitted % len(healthy)] if healthy else None
 
+        async def spike_submit(key: str, cid: str, rid: str) -> None:
+            healthy = self.healthy_apps()
+            app = healthy[report.spike_offered % len(healthy)] \
+                if healthy else None
+            self.latency.on_submitted(key)
+            if app is None or app.consensus is None:
+                self.latency.on_shed(key, "other")
+                report.spike_failed += 1
+                return
+            try:
+                await app.submit(cid, rid)
+                report.spike_acked += 1
+            except AdmissionRejected:
+                self.latency.on_shed(key, "admission")
+                report.spike_shed_admission += 1
+            except SubmitTimeoutError:
+                self.latency.on_shed(key, "timeout")
+                report.spike_shed_timeout += 1
+            except Exception:  # noqa: BLE001 — counted, never kills the run
+                self.latency.on_shed(key, "other")
+                report.spike_failed += 1
+
+        def pump_spike() -> None:
+            sp = self.spike
+            if sp is None:
+                return
+            for _ in range(sp["pump"].due(self.scheduler.now())):
+                cid = sp["zipf"].sample(self.rng)
+                rid = f"spike-{self._spike_seq}"
+                self._spike_seq += 1
+                report.spike_offered += 1
+                # a done-callback counter, not a retained task list: the
+                # drain check must not rescan O(offered) tasks per tick
+                self._spike_pending += 1
+                task = create_logged_task(
+                    spike_submit(f"{cid}:{rid}", cid, rid),
+                    name=f"chaos-{rid}",
+                )
+                task.add_done_callback(
+                    lambda _t: setattr(self, "_spike_pending",
+                                       self._spike_pending - 1)
+                )
+
+        def sample_occupancy() -> None:
+            for a in self.live_apps():
+                occ = a.pool_occupancy()
+                pressure = occ.get("size", 0) + occ.get("waiters", 0)
+                if pressure > report.spike_peak_occupancy:
+                    report.spike_peak_occupancy = pressure
+
         def all_drained() -> bool:
             live = self.live_apps()
+            # spike requests that were ACKED are pooled somewhere and must
+            # commit; the count is final once every spike task finished
+            need = requests + report.spike_acked
             return bool(live) and all(
-                self.committed(a) >= requests for a in live
-            )
+                self.committed(a) >= need for a in live
+            ) and self._spike_pending == 0
 
         deadline = None
         while True:
@@ -503,11 +635,24 @@ class ChaosCluster:
                 else:
                     next_submit = now + submit_every
             report.submitted = submitted
-            # 3. bookkeeping
+            # 2b. open-loop spike arrivals (when a load_spike is active)
+            pump_spike()
+            # 3. bookkeeping (latency/occupancy scans only when an
+            # overload measurement is live — schedules without a spike
+            # must not pay per-tick ledger decoding for an empty tracker)
+            if self.spike is not None or self.latency.pending():
+                self.scan_latency_commits()
+                sample_occupancy()
             lead = self.leader_of()
             if lead:
                 report.leaders_seen.add(lead)
             if not heal_seen and not pending and submitted >= requests:
+                # schedule end is an implicit load_stop: every event has
+                # fired so no load_stop can arrive, and an unstopped pump
+                # would push the run to the 1h hard cap instead of
+                # draining (a spike meant to outlive the baseline pump
+                # schedules its load_stop explicitly)
+                self.spike = None
                 heal_seen = True
                 report.heal_at = now
                 live = self.live_apps()
@@ -845,6 +990,98 @@ async def sharded_soak(
                 )
 
 
+async def openloop_soak(
+    *, rounds: int = 3, shards: int = 2, n: int = 4, depth: int = 2,
+    seed: int = 1, rate: float = 600.0, duration: float = 4.0,
+    verbose: bool = True,
+) -> None:
+    """Overload soak: every round drives OPEN-loop Poisson/Zipf arrivals
+    far past the knee of a small-pool sharded cluster with admission
+    control armed, then drops to a trickle.  Asserts the overload
+    contract (README "Overload behavior"): shedding engages, combined
+    pool occupancy stays bounded by capacity (no unbounded queue growth),
+    committed goodput stays positive THROUGH the spike, and the recovery
+    phase's p99 returns under the spike phase's — all on the logical
+    clock, so a round costs real milliseconds per offered second."""
+    import dataclasses as _dc
+    import tempfile
+
+    from .load import run_open_loop
+    from .sharded import ShardedCluster, sharded_config
+
+    for r in range(rounds):
+        with tempfile.TemporaryDirectory(prefix="chaos-openloop-") as root:
+            pool_size = 24
+            cfg = lambda s, i: _dc.replace(
+                sharded_config(i, depth=depth),
+                request_pool_size=pool_size,
+                admission_high_water=0.75,
+                request_pool_submit_timeout=1.0,
+                request_batch_max_count=8,
+            )
+            cluster = ShardedCluster(
+                root, shards=shards, n=n, depth=depth, config_fn=cfg,
+                seed=seed + r,
+            )
+            await cluster.start()
+            try:
+                capacity = shards * pool_size
+                cluster.set.latency.begin_phase("spike")
+                # drain=1.0: let the hot shard's admitted backlog commit
+                # before the trickle phase starts, or its first arrivals
+                # hit a gate still holding the spike's tail
+                spike = await run_open_loop(
+                    cluster, rate=rate, duration=duration, seed=seed + r,
+                    drain=1.0,
+                )
+                cluster.set.latency.begin_phase("recovery")
+                calm = await run_open_loop(
+                    cluster, rate=rate / 40.0, duration=duration,
+                    drain=6.0, seed=seed + r + 1000,
+                    request_prefix="calm",
+                )
+                cluster.set.latency.end_phase()
+                snap = cluster.set.latency.snapshot()
+                phases = snap["phases"]
+                assert spike.shed > 0, (
+                    f"round {r}: a {rate}/s spike at capacity {capacity} "
+                    f"must shed, got {spike.block()}"
+                )
+                assert spike.acked > 0 and phases["spike"]["count"] > 0, (
+                    f"round {r}: goodput collapsed under the spike: "
+                    f"{spike.block()}"
+                )
+                assert spike.peak_occupancy <= capacity, (
+                    f"round {r}: occupancy {spike.peak_occupancy} exceeded "
+                    f"combined capacity {capacity} — admission failed to "
+                    f"bound the queue"
+                )
+                assert calm.shed == 0, (
+                    f"round {r}: the trickle phase must not shed: "
+                    f"{calm.block()}"
+                )
+                # "recovers" = not worse than the spike beyond measurement
+                # resolution: admission keeps ADMITTED-request latency near
+                # baseline even mid-spike, so the two phases can be equal —
+                # allow one √2 histogram bucket of quantization slack
+                assert phases["recovery"]["p99_ms"] <= \
+                    max(phases["spike"]["p99_ms"] * 1.5, 1.0), (
+                    f"round {r}: p99 did not recover after the spike: "
+                    f"{phases}"
+                )
+                cluster.check_invariants()
+            finally:
+                await cluster.stop()
+            if verbose:
+                print(
+                    f"openloop round {r}: offered={spike.offered} "
+                    f"acked={spike.acked} shed={spike.shed} "
+                    f"peak_occ={spike.peak_occupancy}/{capacity} "
+                    f"spike_p99={phases['spike']['p99_ms']}ms "
+                    f"recovery_p99={phases['recovery']['p99_ms']}ms — OK"
+                )
+
+
 # ---------------------------------------------------------------------- reshard
 
 @dataclass
@@ -1126,6 +1363,17 @@ def main(argv: Optional[list[str]] = None) -> int:
              "exactly-once across epochs + fork-free + gapless pinned",
     )
     ap.add_argument(
+        "--open-loop", action="store_true",
+        help="run the overload soak: open-loop Poisson/Zipf arrivals past "
+             "the knee of a small-pool admission-controlled sharded "
+             "cluster — shedding engages, occupancy stays bounded, "
+             "goodput stays positive, p99 recovers",
+    )
+    ap.add_argument(
+        "--rate", type=float, default=600.0,
+        help="--open-loop offered load (arrivals per logical second)",
+    )
+    ap.add_argument(
         "--sockets", action="store_true",
         help="run the fault matrix at the SOCKET level: one OS process per "
              "replica over real UDS transport (smartbft_tpu.net), SIGKILL-"
@@ -1147,6 +1395,17 @@ def main(argv: Optional[list[str]] = None) -> int:
             requests=args.requests,
         )
         print("chaos soak (sockets): all rounds passed")
+        return 0
+    if args.open_loop:
+        asyncio.run(
+            openloop_soak(
+                rounds=args.rounds,
+                depth=min(args.depth, 4),
+                seed=args.seed,
+                rate=args.rate,
+            )
+        )
+        print("chaos soak (open-loop): all rounds passed")
         return 0
     if args.reshard:
         asyncio.run(

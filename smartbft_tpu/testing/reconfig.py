@@ -44,10 +44,11 @@ _MS_FIELDS = (
 
 # occupancy fractions travel as integer basis points (x/10000): the codec
 # carries ints natively and 1 bp resolution is far below any meaningful
-# autoscale threshold difference
+# autoscale/admission threshold difference
 _BP_FIELDS = (
     "autoscale_high_occupancy",
     "autoscale_low_occupancy",
+    "admission_high_water",
 )
 
 _INT_FIELDS = (
@@ -106,6 +107,7 @@ class ConfigMirror:
     autoscale_max_shards: int = 8
     autoscale_high_occupancy_bp: int = 8500
     autoscale_low_occupancy_bp: int = 1500
+    admission_high_water_bp: int = 10000
     rotation_granularity: str = "decision"
     request_batch_max_interval_ms: int = 0
     request_forward_timeout_ms: int = 0

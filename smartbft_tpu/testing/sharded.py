@@ -413,6 +413,9 @@ class ShardedCluster:
             retention=mux_retention,
             on_deliver=self.delivered_entries.append
             if collect_entries else None,
+            # commit latency on the SHARED clock: logical seconds in
+            # manually-advanced tests, wall seconds under WallClockDriver
+            clock=self.scheduler.now,
         )
         self._client_ids: dict[int, list[str]] = {}
         self._client_scan_pos: dict[int, int] = {}
@@ -476,11 +479,15 @@ class ShardedCluster:
     async def submit(self, client_id: str, request_id: str,
                      payload: bytes = b"") -> int:
         """Encode a TestRequest and push it through the routed front door;
-        returns the shard it landed on."""
+        returns the shard it landed on.  The request's committed-stream id
+        rides along so the set's CommitLatencyTracker can stamp
+        submit→commit latency for it."""
         req = encode(TestRequest(
             client_id=client_id, request_id=request_id, payload=payload
         ))
-        return await self.set.submit(client_id, req)
+        return await self.set.submit(
+            client_id, req, request_key=f"{client_id}:{request_id}"
+        )
 
     def client_for_shard(self, sid: int, j: int = 0) -> str:
         """A deterministic client id that ROUTES to shard ``sid`` in the

@@ -440,3 +440,29 @@ def test_config_validate_rejects_bad_autoscale_envelope():
     )
     with pytest.raises(ConfigError, match="autoscale shard bounds"):
         bad.validate()
+
+
+def test_config_mirror_round_trips_admission_control():
+    """A config-bearing reconfig must carry the admission gate (ISSUE 8):
+    dropping admission_high_water on the wire would silently disarm
+    overload shedding mid-run (the mirror default is 10000 bp = gate
+    off).  The fraction travels as integer basis points like the
+    autoscale thresholds, exact at 1bp resolution."""
+    import dataclasses
+
+    from smartbft_tpu.testing.app import fast_config
+    from smartbft_tpu.testing.reconfig import mirror_config, unmirror_config
+
+    cfg = dataclasses.replace(
+        fast_config(1),
+        admission_high_water=0.8123,
+        request_pool_submit_timeout=2.5,
+    )
+    rt = unmirror_config(mirror_config(cfg))
+    assert rt.admission_high_water == 0.8123
+    assert rt.request_pool_submit_timeout == 2.5
+    rt.with_node_locals(fast_config(3)).validate()
+    # the default round-trips to "gate off" exactly
+    assert unmirror_config(
+        mirror_config(fast_config(1))
+    ).admission_high_water == 1.0
