@@ -287,6 +287,9 @@ def e2e_bench(cpu_mode: bool) -> None:
         # breaker accounting rides along so a degraded (host-fallback)
         # device row is never mistaken for a healthy device run
         "breaker": dev_row.get("breaker"),
+        # which verify plane ran: single device or an N-device mesh
+        # (devices, fill per device, pad waste, loud downgrades)
+        "mesh": dev_row.get("mesh"),
         # per-phase message-plane timers (ingest/route/vote-reg/codec) from
         # the device row's timed window — the PERF.md decomposition inputs
         "protocol_plane": dev_row.get("protocol_plane"),
@@ -364,6 +367,90 @@ def sharded_bench(shards: str, cpu_mode: bool) -> None:
             **(resize.get("reshard") or {}),
         } if resize else None,
     }), flush=True)
+
+
+def assemble_mesh_row(rows: list) -> dict:
+    """Fold benchmarks/mesh.py's JSON lines into the ONE bench.py mesh
+    row.  Pure function, importable — tests/test_mesh_plane.py pins the
+    ``mesh`` block schema against it exactly as tests/test_overload.py
+    pins the open-loop ``latency`` block.
+
+    The row contract: ``mesh.sweep`` carries the devices ∈ {1,2,4,8}
+    points at the fixed shard count (tx/s, launches, items/launch,
+    per-launch capacity, fill, pad waste), ``mesh.verdict_parity`` the
+    bit-for-bit check against the single-device engine,
+    ``mesh.capacity_scaling`` the top-vs-1 capacity ratio, and
+    ``shard_map_available`` / ``downgrades`` record which path ran."""
+    sweep = [r for r in rows if r.get("bench") == "mesh"]
+    parity = next((r for r in rows if r.get("metric") == "mesh_parity"), {})
+    scaling = next((r for r in rows if r.get("metric") == "mesh_scaling"), {})
+    if not sweep:
+        raise RuntimeError("mesh sweep produced no rows")
+    top = max(sweep, key=lambda r: r["devices"])
+    base = min(sweep, key=lambda r: r["devices"])
+    top_mesh = top.get("mesh") or {}
+    return {
+        "metric": "mesh_committed_tx_per_sec",
+        "value": top["tx_per_sec"],
+        "unit": "tx/s",
+        "vs_baseline": round(top["tx_per_sec"] / base["tx_per_sec"], 3)
+        if base["tx_per_sec"] else 0.0,
+        "devices": top["devices"],
+        "mesh": {
+            "fixed_shards": top.get("shards"),
+            "crypto": top.get("crypto"),
+            "sweep": [
+                {k: r.get(k) for k in (
+                    "devices", "tx_per_sec", "launches", "items_per_launch",
+                    "capacity_items_per_launch", "batch_fill_pct",
+                    "pad_waste_pct", "mixed_waves", "elapsed_s",
+                    "launch_probe_ms",
+                )}
+                for r in sweep
+            ],
+            "capacity_scaling": scaling.get("value"),
+            "items_per_launch_ratio": scaling.get("items_per_launch_ratio"),
+            "tx_ratio": scaling.get("tx_ratio"),
+            "verdict_parity": {
+                "match": parity.get("match"),
+                "devices_checked": parity.get("devices_checked"),
+                "items": parity.get("items"),
+            },
+            "shard_map_available": top_mesh.get("shard_map_available"),
+            "downgrades": top_mesh.get("downgrades", 0),
+            "top": top_mesh,
+        },
+    }
+
+
+def mesh_bench(devices: str, cpu_mode: bool) -> None:
+    """Run the benchmarks/mesh.py sweep in a subprocess and print ONE
+    JSON line whose ``mesh`` block carries the devices sweep at fixed S
+    (the ISSUE 10 contract)."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    cmd = [sys.executable, os.path.join(here, "benchmarks", "mesh.py"),
+           "--devices", devices]
+    if cpu_mode:
+        cmd.append("--cpu")
+    points = max(1, len([d for d in devices.split(",") if d.strip()]))
+    point_timeout = float(os.environ.get(
+        "SMARTBFT_BENCH_MESH_POINT_TIMEOUT", "120"))
+    # derived, not guessed: every point may burn its commit deadline plus
+    # a stuck-cluster teardown, and parity pays one compile per width —
+    # the child's own per-point salvage fires before this parent kills it
+    timeout = float(os.environ.get(
+        "SMARTBFT_BENCH_MESH_TIMEOUT", str((points + 2) * point_timeout + 120)
+    ))
+    proc = subprocess.run(
+        cmd, timeout=timeout, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"mesh sweep failed: {proc.stderr.decode(errors='replace')[-400:]}"
+        )
+    rows = [json.loads(l) for l in proc.stdout.decode().splitlines()
+            if l.strip()]
+    print(json.dumps(assemble_mesh_row(rows)), flush=True)
 
 
 def assemble_open_loop_row(rows: list) -> dict:
@@ -513,6 +600,15 @@ def main() -> None:
              "per-shard + aggregate `shard` block",
     )
     ap.add_argument(
+        "--mesh", nargs="?", const="1,2,4,8",
+        default=os.environ.get("SMARTBFT_BENCH_MESH", ""),
+        help="additionally run the mesh verify-plane sweep (benchmarks/"
+             "mesh.py): fixed S, devices swept (default 1,2,4,8) on the "
+             "virtual CPU mesh (real devices when present), emitting a "
+             "`mesh` block (per-launch capacity/fill/pad-waste per device "
+             "count + bit-for-bit verdict parity) in the JSON row",
+    )
+    ap.add_argument(
         "--open-loop", action="store_true",
         default=os.environ.get("SMARTBFT_BENCH_OPENLOOP", "") == "1",
         help="additionally run the open-loop service-level bench "
@@ -548,6 +644,12 @@ def main() -> None:
             sharded_bench(args.shards, cpu_mode)
         except Exception as exc:  # noqa: BLE001 — sharded row is additive
             _log(f"bench: sharded sweep failed ({type(exc).__name__}: {exc})")
+
+    if args.mesh:
+        try:
+            mesh_bench(args.mesh, cpu_mode)
+        except Exception as exc:  # noqa: BLE001 — mesh row is additive
+            _log(f"bench: mesh sweep failed ({type(exc).__name__}: {exc})")
 
     if args.open_loop:
         try:

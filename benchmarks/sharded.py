@@ -270,6 +270,7 @@ async def run_sweep_point(S: int, args, pad_sizes) -> dict:
             "launch_probe_ms": round(launch_probe_ms, 2),
             "elapsed_s": round(elapsed, 2),
             "mixed_waves": shard_block["aggregate"]["coalescer"]["mixed_waves"],
+            "mesh": shard_block["aggregate"].get("mesh"),
             "shard": shard_block,
         }
     finally:

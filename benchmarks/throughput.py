@@ -298,16 +298,22 @@ async def run_cluster(engine_kind: str, n: int, requests: int, batch: int,
         # reported as a device run.  Shared mode has one coalescer; in
         # per-replica mode ANY node degrading must show, so snapshots are
         # aggregated (counters summed, flags OR-ed) across all nodes.
-        snaps = [
-            co.fault_snapshot()
-            for co in {id(providers[i].coalescer): providers[i].coalescer
-                       for i in node_ids}.values()
-        ]
+        coalescers = list({
+            id(providers[i].coalescer): providers[i].coalescer
+            for i in node_ids
+        }.values())
+        snaps = [co.fault_snapshot() for co in coalescers]
         breaker_row = {
             k: (any(s[k] for s in snaps) if isinstance(snaps[0][k], bool)
                 else sum(s[k] for s in snaps))
             for k in snaps[0]
         }
+        # mesh block (ISSUE 10 contract: in EVERY bench row) — shared mode
+        # has one coalescer; in per-replica mode the planes are homogeneous
+        # in SHAPE (devices/enabled/downgrades) but the launch/fill counts
+        # below are ONE plane's, so `planes` makes the scope explicit
+        mesh_row = dict(coalescers[0].mesh_snapshot(),
+                        planes=len(coalescers))
         return {
             "engine": engine_kind,
             "scheme": scheme_name,
@@ -328,6 +334,7 @@ async def run_cluster(engine_kind: str, n: int, requests: int, batch: int,
             "sigs_verified": stats.sigs_verified,
             "elapsed_s": round(elapsed, 2),
             "breaker": breaker_row,
+            "mesh": mesh_row,
             "protocol_plane": dict(
                 plane,
                 # the four timers are disjoint (metrics.ProtocolPlaneTimers),

@@ -156,10 +156,23 @@ class Configuration:
     #   trips it immediately).
     # - verify_probe_interval: cadence of the background canary probe that
     #   re-tries the device while the breaker is open.
+    # - verify_mesh_devices: device-mesh width of the verify plane.  0
+    #   (default) keeps the single-device engine.  N >= 1 graduates the
+    #   coalescer's engine onto an N-device mesh at start/reconfig
+    #   (CryptoProvider.configure_verify_mesh): every coalesced wave is
+    #   padded to a device-count multiple, partitioned along the batch
+    #   axis (NamedSharding(mesh, P('batch'))), and verified in ONE
+    #   logical launch spanning the mesh.  The fault knobs above apply
+    #   per MESH launch unchanged (deadline abandons the whole mesh
+    #   launch, the breaker degrades every shard to host together).
+    #   DEGRADED MODE: a host with fewer visible devices than configured
+    #   keeps the single-device engine LOUDLY, with a counted downgrade
+    #   (consensus.tpu.count_mesh_downgrades) — it never dies at start.
     verify_launch_timeout: float = 30.0
     verify_launch_retries: int = 2
     verify_breaker_threshold: int = 3
     verify_probe_interval: float = 2.0
+    verify_mesh_devices: int = 0
 
     # Real-socket transport (smartbft_tpu/net/ — no reference counterpart:
     # the reference is a library whose embedder supplies Comm; these knobs
@@ -262,6 +275,11 @@ class Configuration:
             )
         if self.verify_launch_retries < 0:
             raise ConfigError("verify_launch_retries should not be negative")
+        if self.verify_mesh_devices < 0:
+            raise ConfigError(
+                "verify_mesh_devices should not be negative "
+                "(0 = single-device verify plane)"
+            )
         if not (0.0 < self.admission_high_water <= 1.0):
             raise ConfigError(
                 "admission_high_water must be in (0, 1] (a fraction of "

@@ -158,17 +158,30 @@ class Consensus:
         coalescer fills only unset pieces (a shared cross-replica coalescer
         keeps its explicit settings); verifiers without the seam no-op."""
         configure = getattr(self.verifier, "configure_fault_policy", None)
-        if configure is None:
-            return
-        from .crypto.provider import VerifyFaultPolicy
+        if configure is not None:
+            from .crypto.provider import VerifyFaultPolicy
 
-        try:
-            configure(
-                policy=VerifyFaultPolicy.from_config(self.config),
-                metrics=self.metrics.tpu,
-            )
-        except Exception as e:  # noqa: BLE001 — wiring must not kill start
-            self.logger.warnf("verify-plane fault wiring failed: %r", e)
+            try:
+                configure(
+                    policy=VerifyFaultPolicy.from_config(self.config),
+                    metrics=self.metrics.tpu,
+                )
+            except Exception as e:  # noqa: BLE001 — wiring must not kill start
+                self.logger.warnf("verify-plane fault wiring failed: %r", e)
+        # mesh graduation (verify_mesh_devices > 0): swap the coalescer's
+        # engine onto an N-device mesh — idempotent across colocated
+        # replicas sharing one coalescer and across reconfigs; an
+        # unbuildable mesh downgrades loudly inside the provider (counted)
+        # instead of raising, so only unexpected wiring errors land here.
+        if self.config.verify_mesh_devices > 0:
+            configure_mesh = getattr(self.verifier, "configure_verify_mesh",
+                                     None)
+            if configure_mesh is not None:
+                try:
+                    configure_mesh(self.config.verify_mesh_devices,
+                                   metrics=self.metrics.tpu)
+                except Exception as e:  # noqa: BLE001 — ditto
+                    self.logger.warnf("verify-mesh wiring failed: %r", e)
 
     async def start(self) -> None:
         """consensus.go:108-165."""
@@ -330,9 +343,14 @@ class Consensus:
             filtered.append((sender, m))
         return filtered
 
-    async def handle_request(self, sender: int, req: bytes) -> None:
+    async def handle_request(self, sender: int, req: bytes):
+        """Returns the pool-shed exception (admission / submit-timeout)
+        when the forwarded request was refused by the overload machinery —
+        the socket transport turns it into a structured REJECT frame for
+        the forwarder — and None otherwise."""
         if self.controller is not None:
-            await self.controller.handle_request(sender, req)
+            return await self.controller.handle_request(sender, req)
+        return None
 
     async def submit_request(self, req: bytes, *, internal: bool = False) -> None:
         """consensus.go:309-317.  ``internal`` marks a control-plane

@@ -238,20 +238,28 @@ class Controller(RequestTimeoutHandler):
             raise
         self.logger.debugf("Request %s was submitted", info)
 
-    async def handle_request(self, sender: int, req: bytes) -> None:
+    async def handle_request(self, sender: int, req: bytes):
         """A forwarded client request lands at the leader
-        (controller.go:231-247)."""
+        (controller.go:231-247).
+
+        Returns the shed exception when the pool's OVERLOAD machinery
+        refused the submit (admission gate / bounded-wait timeout) so a
+        transport can propagate a structured reject to the forwarding
+        replica (net.framing.FT_REJECT); every other outcome — submitted,
+        not-the-leader drop, bad request, dedup — returns None.  In-
+        process callers ignore the return value, so the contract is
+        purely additive."""
         i_am, leader = self.i_am_the_leader()
         if not i_am:
             self.logger.warnf(
                 "Got request from %d but the leader is %d, dropping request", sender, leader
             )
-            return
+            return None
         try:
             self.verifier.verify_request(req)
         except Exception as e:
             self.logger.warnf("Got bad request from %d: %s", sender, e)
-            return
+            return None
         try:
             await self.submit_request(req, forwarded=True)
         except Exception as e:
@@ -265,6 +273,11 @@ class Controller(RequestTimeoutHandler):
                     "Got request from %d but couldn't submit it (%d failures so far): %s",
                     sender, self._fwd_submit_failures, e,
                 )
+            from .pool import AdmissionRejected, SubmitTimeoutError
+
+            if isinstance(e, (AdmissionRejected, SubmitTimeoutError)):
+                return e
+        return None
 
     # -- pool timeout chain (controller.go:266-297) ------------------------
 

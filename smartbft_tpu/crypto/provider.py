@@ -121,6 +121,56 @@ class VerifyStats:
         return 1e6 * self.total_kernel_seconds / self.sigs_verified
 
 
+@dataclass
+class MeshVerifyStats(VerifyStats):
+    """VerifyStats for a device-mesh engine: every record also accounts
+    pad waste and per-device launch fill (a batch-axis-partitioned wave
+    places its items contiguously, so padding lands on the TAIL devices —
+    the per-device fill vector makes that visible instead of hiding it in
+    the overall mean).  Exported through ``MeshVerifyEngine.mesh_snapshot``
+    into the ``mesh`` block of every bench row."""
+
+    devices: int = 1
+    pad_slots: int = 0
+    launches_spanning_all_devices: int = 0
+    last_device_fill_pct: list = field(default_factory=list)
+
+    def record(self, n_sigs: int, n_slots: int, seconds: float) -> None:
+        super().record(n_sigs, n_slots, seconds)
+        pad = max(n_slots - n_sigs, 0)
+        self.pad_slots += pad
+        per_dev = max(1, n_slots // max(1, self.devices))
+        fills = []
+        for d in range(self.devices):
+            got = min(max(n_sigs - d * per_dev, 0), per_dev)
+            fills.append(round(100.0 * got / per_dev, 1))
+        self.last_device_fill_pct = fills
+        if fills and fills[-1] > 0:
+            self.launches_spanning_all_devices += 1
+        m = self.metrics
+        if m is not None and hasattr(m, "count_mesh_launches"):
+            m.count_mesh_launches.add(1)
+            m.count_mesh_pad_slots.add(pad)
+            if fills:
+                m.mesh_device_fill_percent.observe(min(fills))
+
+    def mesh_block(self, capacity: int = 0) -> dict:
+        """The JSON-able engine half of the bench ``mesh`` block."""
+        return {
+            "devices": self.devices,
+            "launches": self.launches,
+            "items": self.sigs_verified,
+            "slots": self.slots_used,
+            "fill_pct": round(self.batch_fill_pct, 1),
+            "pad_slots": self.pad_slots,
+            "pad_waste_pct": round(100.0 * self.pad_slots / self.slots_used, 1)
+            if self.slots_used else 0.0,
+            "capacity_items_per_launch": int(capacity),
+            "device_fill_pct_last": list(self.last_device_fill_pct),
+            "launches_spanning_all_devices": self.launches_spanning_all_devices,
+        }
+
+
 class LaunchTimeout(Exception):
     """A coalescer flush exceeded its launch deadline.  The wave was
     abandoned: the worker thread keeps running, but its late result is
@@ -549,6 +599,11 @@ class AsyncBatchCoalescer:
             metrics.breaker_state.set(0.0)  # healthy until proven otherwise
         self.fault_stats = VerifyFaultStats()
         self.shard_stats = ShardAttribution()
+        #: mesh graduation accounting (CryptoProvider.configure_verify_mesh
+        #: writes these; they live on the coalescer because the coalescer
+        #: is the ONE shared object in sharded mode — like the breaker)
+        self.mesh_configured = 0   # Configuration.verify_mesh_devices wired
+        self.mesh_downgrades = 0   # loud unbuildable-mesh downgrades
         self._pending: list[tuple] = []
         self._futures: list[tuple[asyncio.Future, int, int, object]] = []
         self._flush_scheduled = False
@@ -611,6 +666,35 @@ class AsyncBatchCoalescer:
     def shard_snapshot(self) -> dict:
         """Wave-composition attribution (see :class:`ShardAttribution`)."""
         return self.shard_stats.snapshot()
+
+    def mesh_snapshot(self) -> dict:
+        """The ``mesh`` block of every bench row: which verify plane ran
+        (single device or an N-device mesh), per-launch fill per device,
+        pad waste, and the loud-downgrade count — so a row measured on a
+        downgraded single-device plane is never mistaken for a mesh run.
+        ``shard_map_available`` records the capability truth (memoized
+        probe, satellite of ISSUE 10) for the 2D quorum-step path."""
+        eng = self.engine
+        devices = int(getattr(eng, "devices", 0))
+        out = {
+            "enabled": devices > 0,
+            "devices": devices if devices > 0 else 1,
+            "configured_devices": self.mesh_configured,
+            "downgrades": self.mesh_downgrades,
+        }
+        try:
+            from ..parallel.engine import shard_map_available
+
+            out["shard_map_available"] = shard_map_available()
+        except Exception:  # noqa: BLE001 — capability probe only
+            out["shard_map_available"] = None
+        snap = getattr(eng, "mesh_snapshot", None)
+        if snap is not None:
+            try:
+                out.update(snap())
+            except Exception:  # noqa: BLE001 — a stats hiccup must not
+                pass           # poison a bench row assembly
+        return out
 
     async def submit(self, items, tag=None) -> list[bool]:
         """``tag``: opaque attribution label (the submitter's shard id in
@@ -1103,6 +1187,81 @@ class CryptoProvider:
         self._coalescer.configure(
             policy=policy, fallback_engine=fallback_engine, metrics=metrics
         )
+
+    def configure_verify_mesh(self, devices: int, metrics=None) -> None:
+        """Graduate the coalescer's engine onto an N-device mesh — the
+        ``Configuration.verify_mesh_devices`` knob, wired by
+        ``Consensus._wire_verify_plane`` at start and on every reconfig.
+
+        Idempotent and shared-coalescer-safe: the first provider wired
+        swaps the engine in; colocated providers (sharded mode — S groups,
+        ONE coalescer) see a mesh of the requested width already installed
+        (``devices`` attribute, delegated through fault-injection wrappers)
+        and no-op.  The PR 3 fault contract then holds per MESH launch for
+        free: the deadline/retry/breaker machinery wraps ``engine.verify``,
+        so expiry abandons the whole mesh launch, retries re-dispatch it,
+        the breaker degrades every shard to the host fallback together and
+        the canary recovers them back onto the mesh.
+
+        **Degraded mode**: when the mesh is unbuildable (fewer visible
+        devices than configured) the current single-device engine stays,
+        LOUDLY, with a counted downgrade (``coalescer.mesh_downgrades`` +
+        ``consensus.tpu.count_mesh_downgrades``) — a mis-provisioned host
+        serves at reduced width instead of dying."""
+        if devices <= 0:
+            return
+        co = self._coalescer
+        co.mesh_configured = int(devices)
+        # prefer the coalescer's own metrics bundle (the shared one every
+        # provider feeds) over a caller-supplied per-node bundle; fill the
+        # unset slot like configure_fault_policy so later wirings and the
+        # downgrade counter read the same bundle
+        if metrics is not None and co.metrics is None:
+            co.configure(metrics=metrics)
+        metrics = co.metrics if co.metrics is not None else metrics
+        current = co.engine
+        if int(getattr(current, "devices", 0)) == int(devices):
+            self.engine = current
+            return  # already this mesh (possibly FaultyEngine-wrapped)
+        from ..parallel.engine import MeshUnavailable, MeshVerifyEngine
+
+        try:
+            engine = MeshVerifyEngine(
+                devices=int(devices), scheme=self.scheme,
+                pad_sizes=getattr(current, "pad_sizes", None),
+                metrics=metrics,
+            )
+        except MeshUnavailable as exc:
+            co.mesh_downgrades += 1
+            if metrics is not None and hasattr(metrics, "count_mesh_downgrades"):
+                metrics.count_mesh_downgrades.add(1)
+            logging.getLogger("smartbft_tpu.crypto").warning(
+                "verify mesh UNBUILDABLE (%s); DOWNGRADED to the "
+                "single-device %s (downgrade %d counted)",
+                exc, type(current).__name__, co.mesh_downgrades,
+            )
+            return
+        inner = getattr(current, "inner", None)
+        if inner is not None:
+            # a fault-injection wrapper (testing.engine_faults.FaultyEngine)
+            # around a single-device engine: graduate INSIDE it — swapping
+            # the wrapper out would silently disconnect chaos fault
+            # injection from the live plane
+            current.inner = engine
+            current.scheme = engine.scheme
+            current.pad_sizes = engine.pad_sizes
+            current.devices = engine.devices
+            engine = current
+        else:
+            co.engine = engine
+        # one coalesced flush should be able to fill the mesh's largest
+        # launch — a smaller cap would split waves and waste the new width
+        co.max_batch = max(co.max_batch, engine.pad_sizes[-1])
+        if co.fallback_engine is None:
+            co.fallback_engine = HostVerifyEngine(scheme=self.scheme)
+        if metrics is not None and hasattr(metrics, "mesh_devices"):
+            metrics.mesh_devices.set(float(engine.devices))
+        self.engine = engine
 
     # -- Signer -------------------------------------------------------------
 

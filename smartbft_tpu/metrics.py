@@ -501,6 +501,17 @@ class TPUCryptoMetrics:
         #: 1.0 while the host-fallback circuit breaker is open (degraded
         #: mode: waves verify on CPU), 0.0 when the device engine serves
         self.breaker_state = _g(p, "tpu", "verify_breaker_open")
+        # mesh verify plane (ISSUE 10): the graduated multi-device path.
+        # mesh_devices is the installed mesh width (0 = single-device);
+        # per-launch accounting (launch count, pad-slot waste, the MINIMUM
+        # per-device fill of each launch — padding lands on tail devices)
+        # plus the loud unbuildable-mesh downgrade counter, so a degraded
+        # single-device run is never mistaken for a mesh run
+        self.mesh_devices = _g(p, "tpu", "mesh_devices")
+        self.count_mesh_launches = _c(p, "tpu", "count_mesh_launches")
+        self.count_mesh_pad_slots = _c(p, "tpu", "count_mesh_pad_slots")
+        self.count_mesh_downgrades = _c(p, "tpu", "count_mesh_downgrades")
+        self.mesh_device_fill_percent = _h(p, "tpu", "mesh_device_fill_percent")
 
 
 def tpu_counters_aggregate(providers: Sequence[InMemoryProvider]) -> dict:

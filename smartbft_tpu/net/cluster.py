@@ -54,6 +54,22 @@ class ControlError(RuntimeError):
     pass
 
 
+class ControlRejected(ControlError):
+    """A control-channel submit was SHED by the replica's admission
+    machinery (PR 8 contract over the socket): ``kind`` is "admission" or
+    "timeout", ``retry_after`` the drain-rate hint in seconds (0.0 = no
+    hint), ``occupancy`` the pool snapshot at rejection time.  A socket
+    client that backs off by ``retry_after`` arrives when capacity
+    plausibly exists; one that hammers gets shed again."""
+
+    def __init__(self, message: str, *, kind: str = "",
+                 retry_after: float = 0.0, occupancy: Optional[dict] = None):
+        super().__init__(message)
+        self.kind = kind
+        self.retry_after = retry_after
+        self.occupancy = occupancy or {}
+
+
 class ControlClient:
     """Line-JSON client for one replica's control channel.  Connects per
     call: a replica that was SIGKILLed and respawned is reachable again
@@ -85,6 +101,13 @@ class ControlClient:
         finally:
             sock.close()
         if not resp.get("ok"):
+            if resp.get("rejected"):
+                raise ControlRejected(
+                    resp.get("error", "request shed"),
+                    kind=resp["rejected"],
+                    retry_after=resp.get("retry_after_ms", 0) / 1000.0,
+                    occupancy=resp.get("occupancy"),
+                )
             raise ControlError(resp.get("error", "control command failed"))
         return resp
 

@@ -25,7 +25,13 @@ Frame types:
   SPI surface, also how the pool forwards requests to the leader);
 * ``FT_SYNC_REQ`` / ``FT_SYNC_RESP`` — ledger catch-up for the
   multi-process cluster (a restarted replica has no in-process shared
-  ledger to sync from), correlated by nonce.
+  ledger to sync from), correlated by nonce;
+* ``FT_REJECT``     — structured shed notice travelling the REVERSE
+  direction of an ``FT_REQUEST``: the receiving replica's pool refused
+  the request (admission gate / bounded-wait timeout), and the sender —
+  which fronts the client — gets the PR 8 admission contract (shed kind,
+  retry-after hint, occupancy snapshot) instead of silence.  Advisory:
+  the protocol's forward/complain timers keep running either way.
 
 The handshake / sync payloads are encoded with the UNTAGGED canonical
 codec (``codec.encode`` / ``codec.decode``): the frame type already
@@ -55,9 +61,11 @@ FT_CONSENSUS = 2
 FT_REQUEST = 3
 FT_SYNC_REQ = 4
 FT_SYNC_RESP = 5
+FT_REJECT = 6
 
 _KNOWN_TYPES = frozenset(
-    (FT_HELLO, FT_CONSENSUS, FT_REQUEST, FT_SYNC_REQ, FT_SYNC_RESP)
+    (FT_HELLO, FT_CONSENSUS, FT_REQUEST, FT_SYNC_REQ, FT_SYNC_RESP,
+     FT_REJECT)
 )
 
 
@@ -132,6 +140,35 @@ class Hello:
     node_id: int = 0
     group: int = 0
     key: bytes = b""
+
+
+def reject_digest(request: bytes) -> bytes:
+    """Constant-size correlation id for a rejected request: echoing the
+    FULL request back would roughly double per-request bandwidth exactly
+    when the link is already saturated (rejects fire under overload)."""
+    import hashlib
+
+    return hashlib.blake2b(bytes(request), digest_size=16).digest()
+
+
+@wiremsg
+class RejectFrame:
+    """Structured shed notice for one FT_REQUEST (untagged encoding, like
+    every control-plane frame).  ``kind`` is the PR 8 shed cause
+    ("admission" | "timeout"); ``retry_after_ms`` the drain-rate-derived
+    hint (0 = no hint, as for bounded-wait timeouts); ``request_digest``
+    is :func:`reject_digest` of the rejected raw request — a fixed-size
+    correlation id the forwarder can match against its in-flight set
+    without any shared nonce state (and without the overload-amplifying
+    full echo); ``occupancy``/``high_water`` snapshot the gate's inputs
+    at rejection time (0/0 when unavailable)."""
+
+    kind: str = ""
+    reason: str = ""
+    retry_after_ms: int = 0
+    occupancy: int = 0
+    high_water: int = 0
+    request_digest: bytes = b""
 
 
 @wiremsg

@@ -552,6 +552,7 @@ class ControlServer:
             lead = r.consensus.get_leader_id() if r.consensus else 0
             return {"ok": True, "leader": lead}
         if cmd == "submit":
+            from ..core.pool import AdmissionRejected, SubmitTimeoutError
             from ..testing.app import TestRequest
 
             raw = encode(TestRequest(
@@ -559,7 +560,27 @@ class ControlServer:
                 request_id=req["rid"],
                 payload=bytes.fromhex(req.get("payload", "")),
             ))
-            await r.consensus.submit_request(raw)
+            try:
+                await r.consensus.submit_request(raw)
+            except AdmissionRejected as e:
+                # the PR 8 admission contract, now visible to SOCKET
+                # clients: structured reject + drain-rate retry-after
+                # hint instead of an opaque error string
+                return {
+                    "ok": False,
+                    "rejected": "admission",
+                    "retry_after_ms": int((e.retry_after or 0.0) * 1000),
+                    "occupancy": e.occupancy,
+                    "error": f"AdmissionRejected: {e}",
+                }
+            except SubmitTimeoutError as e:
+                return {
+                    "ok": False,
+                    "rejected": "timeout",
+                    "retry_after_ms": 0,
+                    "occupancy": r.consensus.pool_occupancy(),
+                    "error": f"SubmitTimeoutError: {e}",
+                }
             return {"ok": True}
         if cmd == "height":
             pool = r.consensus.pool_occupancy() if r.consensus else {}

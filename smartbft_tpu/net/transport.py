@@ -61,16 +61,19 @@ from .framing import (
     DEFAULT_MAX_FRAME_BYTES,
     FT_CONSENSUS,
     FT_HELLO,
+    FT_REJECT,
     FT_REQUEST,
     FT_SYNC_REQ,
     FT_SYNC_RESP,
     FrameDecoder,
     FrameError,
     Hello,
+    RejectFrame,
     SyncBatch,
     SyncRequest,
     encode_frame,
     parse_addr,
+    reject_digest,
 )
 
 #: read-buffer size per reader.read() call; one sender flush usually fits
@@ -101,7 +104,7 @@ class TransportMetrics:
         "flush_batches", "ingest_batches", "connects", "reconnects",
         "connect_failures", "outbox_dropped", "link_dropped",
         "malformed_frames", "connections_dropped", "handshake_rejected",
-        "sync_requests", "sync_responses",
+        "sync_requests", "sync_responses", "rejects_sent", "rejects_received",
     )
 
     def __init__(self) -> None:
@@ -176,6 +179,14 @@ class SocketComm(Comm):
         #: multi-process sync server hook: (from_height) -> (decisions,
         #: total_height) with decisions a list[framing.WireDecision]
         self.sync_server: Optional[Callable[[int], tuple[list, int]]] = None
+        #: optional embedder hook: (sender_id, framing.RejectFrame) called
+        #: on every received FT_REJECT (the peer shed a request this node
+        #: forwarded); the last few frames are kept in `rejects` either way
+        self.on_reject: Optional[Callable[[int, RejectFrame], None]] = None
+        #: bounded record of received reject frames (newest last) — the
+        #: client-visible admission contract over the wire, readable via
+        #: the control channel / tests without installing a hook
+        self.rejects: deque = deque(maxlen=64)
         self._rng = rng or random.Random(self_id * 7919 + 17)
         self._peers: dict[int, _Peer] = {
             pid: _Peer(pid, addr) for pid, addr in peers.items()
@@ -541,7 +552,14 @@ class SocketComm(Comm):
                 elif ftype == FT_REQUEST:
                     await self._flush_consensus(run)
                     if self.consensus is not None:
-                        await self.consensus.handle_request(sender, payload)
+                        shed = await self.consensus.handle_request(
+                            sender, payload
+                        )
+                        if shed is not None:
+                            self._send_reject(sender, payload, shed)
+                elif ftype == FT_REJECT:
+                    await self._flush_consensus(run)
+                    self._on_reject_frame(sender, payload)
                 elif ftype == FT_SYNC_REQ:
                     await self._flush_consensus(run)
                     self._serve_sync(sender, payload)
@@ -584,6 +602,44 @@ class SocketComm(Comm):
                 for sender, msg in run:
                     c.handle_message(sender, msg)
         run.clear()
+
+    # ------------------------------------------------------------ rejects
+
+    def _send_reject(self, sender: int, payload: bytes, shed) -> None:
+        """Turn a pool shed of a forwarded request into a structured
+        REJECT frame back to the forwarder (the PR 8 admission contract,
+        now visible across the wire instead of dying inside this
+        process).  Advisory: the forwarder's pool timers keep running."""
+        from ..core.pool import AdmissionRejected
+
+        retry_after = float(getattr(shed, "retry_after", 0.0) or 0.0)
+        occ = getattr(shed, "occupancy", None) or {}
+        kind = "admission" if isinstance(shed, AdmissionRejected) \
+            else "timeout"
+        frame = RejectFrame(
+            kind=kind,
+            reason=str(shed)[:512],
+            retry_after_ms=int(retry_after * 1000),
+            occupancy=int(occ.get("size", 0) or 0),
+            high_water=int(occ.get("high_water", 0) or 0),
+            request_digest=reject_digest(payload),
+        )
+        self._enqueue(sender, encode_frame(FT_REJECT, encode(frame)))
+        self.metrics.rejects_sent += 1
+
+    def _on_reject_frame(self, sender: int, payload: bytes) -> None:
+        frame = decode(RejectFrame, payload)  # CodecError -> drop conn
+        self.metrics.rejects_received += 1
+        self.rejects.append((sender, frame))
+        self.logger.warnf(
+            "peer %d shed a forwarded request (%s, retry-after %d ms)",
+            sender, frame.kind, frame.retry_after_ms,
+        )
+        if self.on_reject is not None:
+            try:
+                self.on_reject(sender, frame)
+            except Exception as e:  # noqa: BLE001 — embedder hook
+                self.logger.warnf("on_reject hook failed: %r", e)
 
     # ------------------------------------------------------------ sync RPC
 

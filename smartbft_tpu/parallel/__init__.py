@@ -20,15 +20,23 @@ Two products:
 """
 
 from .engine import (
+    MeshUnavailable,
+    MeshVerifyEngine,
     QuorumMeshVerifyEngine,
     ShardedVerifyEngine,
     build_mesh,
+    mesh_device_count,
     quorum_decide,
+    shard_map_available,
 )
 
 __all__ = [
+    "MeshUnavailable",
+    "MeshVerifyEngine",
     "QuorumMeshVerifyEngine",
     "ShardedVerifyEngine",
     "build_mesh",
+    "mesh_device_count",
     "quorum_decide",
+    "shard_map_available",
 ]

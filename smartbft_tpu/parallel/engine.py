@@ -21,7 +21,38 @@ from typing import Optional
 import numpy as np
 
 from ..crypto import p256
-from ..crypto.provider import JaxVerifyEngine
+from ..crypto.provider import JaxVerifyEngine, MeshVerifyStats
+
+
+#: one-shot memo for the shard_map probe: [wrapper-or-None] once resolved.
+#: The fallback-import dance (attr walk + jax.experimental import attempt)
+#: used to re-run on EVERY engine construction; the answer is a property
+#: of the jax build and cannot change within a process, so it is cached —
+#: and exported into the metrics ``mesh`` block (shard_map_available) so
+#: bench rows record which path actually ran.
+_SHARD_MAP_MEMO: list = []
+
+
+def _probe_shard_map():
+    """The raw probe (see :func:`resolve_shard_map`); runs at most once."""
+    import jax
+
+    sm = getattr(jax, "shard_map", None)
+    if sm is None:
+        try:
+            from jax.experimental.shard_map import shard_map as sm
+        except Exception:
+            return None
+
+    def call(f, *, mesh, in_specs, out_specs):
+        try:
+            return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_vma=False)
+        except TypeError:  # older spelling
+            return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_rep=False)
+
+    return call
 
 
 def resolve_shard_map(required: bool = False):
@@ -36,29 +67,18 @@ def resolve_shard_map(required: bool = False):
     the checker rejects).  When neither API exists: returns None, or with
     ``required=True`` raises the capability error — callers either gate on
     :func:`shard_map_available` or demand it outright.
+
+    Memoized: the probe runs once per process (the answer is fixed by the
+    jax build); repeated engine constructions reuse the cached wrapper.
     """
-    import jax
-
-    sm = getattr(jax, "shard_map", None)
-    if sm is None:
-        try:
-            from jax.experimental.shard_map import shard_map as sm
-        except Exception:
-            if required:
-                raise RuntimeError(
-                    "no usable shard_map API in this jax build (neither "
-                    "jax.shard_map nor jax.experimental.shard_map)"
-                )
-            return None
-
-    def call(f, *, mesh, in_specs, out_specs):
-        try:
-            return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                      check_vma=False)
-        except TypeError:  # older spelling
-            return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                      check_rep=False)
-
+    if not _SHARD_MAP_MEMO:
+        _SHARD_MAP_MEMO.append(_probe_shard_map())
+    call = _SHARD_MAP_MEMO[0]
+    if call is None and required:
+        raise RuntimeError(
+            "no usable shard_map API in this jax build (neither "
+            "jax.shard_map nor jax.experimental.shard_map)"
+        )
     return call
 
 
@@ -119,6 +139,82 @@ class ShardedVerifyEngine(JaxVerifyEngine):
 
     def _place(self, a):
         return self._jax.device_put(a, self._sharding)
+
+
+class MeshUnavailable(RuntimeError):
+    """The configured verify mesh cannot be built on this host (fewer
+    visible devices than requested).  The wiring seam
+    (``CryptoProvider.configure_verify_mesh``) catches this and constructs
+    the single-device engine LOUDLY with a counted downgrade — a
+    mis-provisioned host degrades to reduced width instead of dying."""
+
+
+def mesh_device_count() -> int:
+    """Visible device count (0 when jax cannot initialize a backend)."""
+    import jax
+
+    try:
+        return len(jax.devices())
+    except Exception:  # noqa: BLE001 — capability probe, never fatal
+        return 0
+
+
+#: default per-device lane ladder for the graduated mesh engine: each
+#: device contributes a fixed lane budget, so aggregate per-launch
+#: capacity scales linearly with the mesh width (the whole point of
+#: amortizing the ~fixed launch overhead across N devices)
+MESH_PER_DEVICE_LANES = (8, 64, 512, 2048)
+
+
+class MeshVerifyEngine(ShardedVerifyEngine):
+    """The GRADUATED live-path mesh engine (ISSUE 10, ROADMAP item 1).
+
+    Each coalesced wave is padded to a device-count multiple, partitioned
+    along the batch axis with ``NamedSharding(mesh, P('batch'))`` (the
+    SNIPPETS.md [1]/[2] idiom), and verified in ONE logical launch that
+    spans the whole mesh; per-item verdicts gather back to the host and
+    the coalescer slices them per submitter/tag exactly as on the
+    single-device engine.  Construction raises :class:`MeshUnavailable`
+    when the host has fewer visible devices than requested — the wiring
+    seam turns that into a loud counted downgrade, never a crash.
+
+    ``pad_sizes=None`` derives a ladder of ``MESH_PER_DEVICE_LANES`` lanes
+    PER DEVICE, so per-launch capacity (``pad_sizes[-1]``) scales with the
+    mesh width; an explicit ladder is rounded up to device multiples like
+    any :class:`ShardedVerifyEngine`.  ``stats`` is a
+    :class:`~smartbft_tpu.crypto.provider.MeshVerifyStats`: per-launch
+    per-device fill and pad waste ride every record, exported through
+    ``AsyncBatchCoalescer.mesh_snapshot`` into the bench ``mesh`` block.
+    """
+
+    def __init__(self, devices: Optional[int] = None, mesh=None,
+                 pad_sizes: Optional[tuple[int, ...]] = None, scheme=p256,
+                 metrics=None):
+        if mesh is None:
+            import jax
+
+            avail = list(jax.devices())
+            want = len(avail) if not devices else int(devices)
+            if want < 1 or want > len(avail):
+                raise MeshUnavailable(
+                    f"verify mesh wants {want} device(s), host has "
+                    f"{len(avail)}"
+                )
+            mesh = build_mesh((want,), ("batch",), devices=avail[:want])
+        n_dev = int(np.prod(mesh.devices.shape))
+        if pad_sizes is None:
+            pad_sizes = tuple(l * n_dev for l in MESH_PER_DEVICE_LANES)
+        super().__init__(mesh=mesh, pad_sizes=tuple(pad_sizes), scheme=scheme)
+        #: mesh width — the attribute the wiring seam keys idempotence on
+        #: (FaultyEngine delegates it, so a fault-wrapped mesh still reads
+        #: as "already graduated")
+        self.devices = self.lanes
+        self.stats = MeshVerifyStats(devices=self.devices, metrics=metrics)
+
+    def mesh_snapshot(self) -> dict:
+        """JSON-able block: devices, per-launch fill per device, pad
+        waste — the engine half of the bench ``mesh`` block."""
+        return self.stats.mesh_block(capacity=self.pad_sizes[-1])
 
 
 class QuorumMeshVerifyEngine(JaxVerifyEngine):

@@ -784,6 +784,7 @@ class ShardSet:
         if self.coalescer is not None:
             agg["coalescer"] = self.coalescer.shard_snapshot()
             agg["breaker"] = self.coalescer.fault_snapshot()
+            agg["mesh"] = self.coalescer.mesh_snapshot()
         reshard = dict(self.reshard_stats)
         reshard["epoch"] = self._epoch
         reshard["in_progress"] = self.reshard_phase

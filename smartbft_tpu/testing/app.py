@@ -226,6 +226,10 @@ class App(Application, Assembler, Comm, Signer, Verifier, RequestInspector,
             # expose the verify-plane wiring seam so Consensus.start can
             # arm launch deadlines / retry / breaker from the Configuration
             self.configure_fault_policy = crypto.configure_fault_policy
+        if crypto is not None and hasattr(crypto, "configure_verify_mesh"):
+            # mesh-graduation seam: Configuration.verify_mesh_devices
+            # reaches the shared coalescer through the same facade wiring
+            self.configure_verify_mesh = crypto.configure_verify_mesh
 
     # ------------------------------------------------------------------ app
 

@@ -124,6 +124,11 @@ class ChaosEvent:
       tunnel-class errors; ``engine_slow`` (``fraction`` seconds of added
       latency); ``engine_permanent``: compile-class error, trips the
       breaker immediately; ``engine_heal``: clear all device faults.
+    - ``engine_device_down`` / ``engine_device_restore`` (``count`` =
+      mesh device index): MESH-scoped faults — losing one device of an
+      N-device verify mesh fails every launch (one logical launch spans
+      the whole mesh), so the breaker degrades ALL shards to host
+      together and the canary recovers them back onto the mesh.
 
     Overload actions (the open-loop pump as a schedulable fault — README
     "Overload behavior"):
@@ -454,6 +459,10 @@ class ChaosCluster:
             self._require_engine().slow(evt.fraction)
         elif evt.action == "engine_permanent":
             self._require_engine().permanent_error()
+        elif evt.action == "engine_device_down":
+            self._require_engine().lose_device(max(0, int(evt.count)))
+        elif evt.action == "engine_device_restore":
+            self._require_engine().restore_device(max(0, int(evt.count)))
         elif evt.action == "engine_heal":
             self._require_engine().heal()
         # overload actions: the open-loop pump is a fault like any other —

@@ -63,10 +63,20 @@ class FaultyEngine:
         # both key off the pad ladder
         if hasattr(inner, "pad_sizes"):
             self.pad_sizes = inner.pad_sizes
+        # ...and a wrapped MESH engine must still look mesh-shaped: the
+        # configure_verify_mesh idempotence check and the bench `mesh`
+        # block key off `devices` / `mesh_snapshot`
+        if hasattr(inner, "devices"):
+            self.devices = inner.devices
         self._lock = threading.Lock()
         self._fail_next = 0
         self._slow_s = 0.0
         self._permanent = False
+        #: mesh-scoped device faults: indices of "lost" mesh devices.  One
+        #: lost device fails the WHOLE launch — that is the semantics of a
+        #: mesh (one logical launch spans every device), and it is exactly
+        #: why a single sick chip degrades ALL shards to host together.
+        self._down_devices: set[int] = set()
         #: set = not hanging; cleared by hang(), re-set by heal()/fail_next
         self._release = threading.Event()
         self._release.set()
@@ -82,6 +92,10 @@ class FaultyEngine:
     def prewarm_keys(self, pubs) -> None:
         if hasattr(self.inner, "prewarm_keys"):
             self.inner.prewarm_keys(pubs)
+
+    def mesh_snapshot(self) -> dict:
+        snap = getattr(self.inner, "mesh_snapshot", None)
+        return snap() if snap is not None else {}
 
     # -- fault modes -------------------------------------------------------
 
@@ -112,12 +126,27 @@ class FaultyEngine:
             self._permanent = on
             self._release.set()
 
+    def lose_device(self, idx: int = 0) -> None:
+        """Mesh-scoped fault: device ``idx`` of the (wrapped) mesh is
+        lost.  Every verify call — one logical launch spanning the whole
+        mesh — raises a transient tunnel-class error until the device is
+        restored, so the coalescer's retry/breaker machinery sees exactly
+        what a real ICI/device loss produces: the WHOLE mesh launch
+        failing, for every shard at once."""
+        with self._lock:
+            self._down_devices.add(int(idx))
+
+    def restore_device(self, idx: int = 0) -> None:
+        with self._lock:
+            self._down_devices.discard(int(idx))
+
     def heal(self) -> None:
         """Clear every fault mode and release any parked verify calls."""
         with self._lock:
             self._fail_next = 0
             self._slow_s = 0.0
             self._permanent = False
+            self._down_devices.clear()
             self._release.set()
 
     # -- the engine surface ------------------------------------------------
@@ -128,8 +157,9 @@ class FaultyEngine:
             slow = self._slow_s
             permanent = self._permanent
             failing = self._fail_next > 0
-            if failing:
-                self._fail_next -= 1
+            down = sorted(self._down_devices)
+            if failing or down:
+                self._fail_next -= 1 if failing else 0
                 self.injected_failures += 1
         if slow:
             time.sleep(slow)
@@ -140,6 +170,11 @@ class FaultyEngine:
         if failing:
             raise RuntimeError(
                 "UNAVAILABLE: injected transient device fault"
+            )
+        if down:
+            raise RuntimeError(
+                f"UNAVAILABLE: injected mesh device fault (device(s) "
+                f"{down} lost; the whole mesh launch fails)"
             )
         return self.inner.verify(items)
 

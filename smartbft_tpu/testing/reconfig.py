@@ -63,6 +63,7 @@ _INT_FIELDS = (
     "pipeline_depth",
     "verify_launch_retries",
     "verify_breaker_threshold",
+    "verify_mesh_devices",
     "transport_outbox_cap",
     "transport_max_frame_bytes",
     "autoscale_min_shards",
@@ -101,6 +102,7 @@ class ConfigMirror:
     pipeline_depth: int = 1
     verify_launch_retries: int = 2
     verify_breaker_threshold: int = 3
+    verify_mesh_devices: int = 0
     transport_outbox_cap: int = 4096
     transport_max_frame_bytes: int = 16 * 1024 * 1024
     autoscale_min_shards: int = 1

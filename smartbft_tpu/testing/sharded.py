@@ -298,8 +298,9 @@ class ShardedCluster:
         collect_entries: bool = False,
         journal: bool = True,
     ):
-        """``crypto``: "trivial" | "p256" | "ed25519" (see module
-        docstring).  ``engine``: the shared device-stand-in engine for the
+        """``crypto``: "trivial" | "p256" | "ed25519" | "toy" (see module
+        docstring; "toy" is the real provider stack over the array-math
+        testing.toy_scheme — the mesh-path configuration tests use it).  ``engine``: the shared device-stand-in engine for the
         real-crypto modes (defaults to a HostVerifyEngine of the scheme);
         trivial mode always uses the always-valid host engine, wrapped in
         a :class:`FaultyEngine` when ``engine_faults`` — then the
@@ -342,17 +343,27 @@ class ShardedCluster:
             crypto_for = lambda s, i: CoalescedTrivialCrypto(
                 i, self.coalescer, tag=s
             )
-        elif crypto in ("p256", "ed25519"):
+        elif crypto in ("p256", "ed25519", "toy"):
             from ..crypto import ed25519, p256
             from ..crypto.provider import (
                 Ed25519CryptoProvider,
                 Keyring,
                 P256CryptoProvider,
             )
+            from . import toy_scheme
 
-            scheme = p256 if crypto == "p256" else ed25519
-            provider_cls = P256CryptoProvider if crypto == "p256" \
-                else Ed25519CryptoProvider
+            # "toy": real CryptoProvider stack + array-math device kernel
+            # (testing.toy_scheme) — the configuration mesh-path tests and
+            # the mesh bench sweep use, since its kernel compiles in ms at
+            # ANY device count (the p256 mesh kernel costs minutes per
+            # mesh shape on a cold cache)
+            scheme = {"p256": p256, "ed25519": ed25519,
+                      "toy": toy_scheme}[crypto]
+            provider_cls = {
+                "p256": P256CryptoProvider,
+                "ed25519": Ed25519CryptoProvider,
+                "toy": toy_scheme.ToyCryptoProvider,
+            }[crypto]
             self.engine = engine if engine is not None \
                 else HostVerifyEngine(scheme=scheme)
             max_batch = getattr(self.engine, "pad_sizes", (2048,))[-1]

@@ -280,3 +280,143 @@ def test_mute_and_drop_link_faults():
         await b.close()
 
     asyncio.run(run())
+
+
+# ----------------------------------------------------- structured rejects
+
+def test_forwarded_request_shed_returns_structured_reject_frame():
+    """FT_REQUEST whose submit is SHED by the pool's overload machinery
+    travels back as a tagged FT_REJECT frame carrying the retry-after
+    hint and the occupancy snapshot — the PR 8 admission contract is now
+    visible over the wire instead of dying inside the replica process."""
+    import time
+
+    from smartbft_tpu.core.pool import AdmissionRejected
+
+    sockdir = tempfile.mkdtemp(prefix="sbft-rej-", dir="/tmp")
+    addr_a = f"uds://{sockdir}/a.sock"
+    addr_b = f"uds://{sockdir}/b.sock"
+
+    async def run():
+        shed = AdmissionRejected(
+            "pool past high-water", retry_after=1.5,
+            occupancy={"size": 9, "high_water": 8},
+        )
+
+        class ShedStub(_Sink):
+            def __init__(self):
+                super().__init__()
+                self.requests = []
+
+            async def handle_request(self, sender, req):
+                self.requests.append((sender, req))
+                return shed
+
+        a = SocketComm(1, addr_a, {2: addr_b}, cluster_key=b"k",
+                       backoff_base=0.01, backoff_max=0.05)
+        b = SocketComm(2, addr_b, {1: addr_a}, cluster_key=b"k",
+                       backoff_base=0.01, backoff_max=0.05)
+        stub = ShedStub()
+        b.attach(stub)
+        a.attach(_Sink())
+        hooked = []
+        a.on_reject = lambda sender, frame: hooked.append((sender, frame))
+        await a.start()
+        await b.start()
+        try:
+            a.send_transaction(2, b"hot-request")
+            deadline = time.monotonic() + 5.0
+            while a.metrics.rejects_received < 1 \
+                    and time.monotonic() < deadline:
+                await asyncio.sleep(0.01)
+            assert stub.requests and stub.requests[0][1] == b"hot-request"
+            assert b.metrics.rejects_sent == 1
+            assert a.metrics.rejects_received == 1
+            sender, frame = a.rejects[-1]
+            assert sender == 2 and frame.kind == "admission"
+            assert frame.retry_after_ms == 1500
+            assert frame.occupancy == 9 and frame.high_water == 8
+            from smartbft_tpu.net.framing import reject_digest
+
+            assert frame.request_digest == reject_digest(b"hot-request")
+            assert hooked and hooked[0][1].kind == "admission"
+            # counters ride the transport snapshot (control `stats` cmd)
+            assert a.transport_snapshot()["rejects_received"] == 1
+            assert b.transport_snapshot()["rejects_sent"] == 1
+        finally:
+            await a.close()
+            await b.close()
+
+    asyncio.run(run())
+
+
+def test_control_submit_returns_structured_admission_reject():
+    """The socket CLIENT door: a shed control-channel submit surfaces as
+    a typed ControlRejected with kind/retry-after/occupancy, not an
+    opaque error string."""
+    import pytest
+
+    from smartbft_tpu.core.pool import AdmissionRejected, SubmitTimeoutError
+    from smartbft_tpu.net.cluster import ControlClient, ControlRejected
+    from smartbft_tpu.net.launch import ControlServer
+
+    class _StubConsensus:
+        def __init__(self, exc):
+            self.exc = exc
+
+        async def submit_request(self, raw, *, internal=False):
+            raise self.exc
+
+        def pool_occupancy(self):
+            return {"size": 3}
+
+    class _StubReplica:
+        id = 1
+
+        def __init__(self, exc):
+            self.consensus = _StubConsensus(exc)
+
+    sockdir = tempfile.mkdtemp(prefix="sbft-ctl-", dir="/tmp")
+
+    async def run():
+        addr = f"uds://{sockdir}/ctl.sock"
+        replica = _StubReplica(AdmissionRejected(
+            "pool full", retry_after=0.75, occupancy={"size": 3}
+        ))
+        srv = ControlServer(replica, addr, asyncio.Event())
+        await srv.start()
+        try:
+            def call():
+                ControlClient(addr, timeout=5.0).call(
+                    cmd="submit", client="c", rid="r1"
+                )
+
+            with pytest.raises(ControlRejected) as exc:
+                await asyncio.to_thread(call)
+            assert exc.value.kind == "admission"
+            assert abs(exc.value.retry_after - 0.75) < 1e-9
+            assert exc.value.occupancy == {"size": 3}
+        finally:
+            await srv.close()
+
+        # bounded-wait timeouts reject structurally too (no hint)
+        addr2 = f"uds://{sockdir}/ctl2.sock"
+        srv2 = ControlServer(
+            _StubReplica(SubmitTimeoutError("timed out")), addr2,
+            asyncio.Event(),
+        )
+        await srv2.start()
+        try:
+            def call2():
+                ControlClient(addr2, timeout=5.0).call(
+                    cmd="submit", client="c", rid="r2"
+                )
+
+            with pytest.raises(ControlRejected) as exc:
+                await asyncio.to_thread(call2)
+            assert exc.value.kind == "timeout"
+            assert exc.value.retry_after == 0.0
+        finally:
+            await srv2.close()
+
+    asyncio.run(run())
