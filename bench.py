@@ -377,12 +377,20 @@ def assemble_mesh_row(rows: list) -> dict:
 
     The row contract: ``mesh.sweep`` carries the devices ∈ {1,2,4,8}
     points at the fixed shard count (tx/s, launches, items/launch,
-    per-launch capacity, fill, pad waste), ``mesh.verdict_parity`` the
-    bit-for-bit check against the single-device engine,
-    ``mesh.capacity_scaling`` the top-vs-1 capacity ratio, and
-    ``shard_map_available`` / ``downgrades`` record which path ran."""
+    per-launch capacity, fill, pad waste — gated values, with the
+    ungated control's launches/fill riding along), ``mesh.gating`` the
+    top point's gated-vs-ungated deltas plus the coalescer's hold
+    decisions (waves_held, held_ms, depth_gain_items),
+    ``mesh.verdict_parity`` / ``mesh.verdict_parity_2d`` the
+    bit-for-bit checks against the single-device engine (1D batch mesh
+    and 2D seq×vote quorum mesh), ``mesh.capacity_scaling`` the
+    top-vs-1 capacity ratio, and ``shard_map_available`` /
+    ``downgrades`` record which path ran."""
     sweep = [r for r in rows if r.get("bench") == "mesh"]
     parity = next((r for r in rows if r.get("metric") == "mesh_parity"), {})
+    parity_2d = next(
+        (r for r in rows if r.get("metric") == "mesh_parity_2d"), {}
+    )
     scaling = next((r for r in rows if r.get("metric") == "mesh_scaling"), {})
     if not sweep:
         raise RuntimeError("mesh sweep produced no rows")
@@ -404,18 +412,36 @@ def assemble_mesh_row(rows: list) -> dict:
                     "devices", "tx_per_sec", "launches", "items_per_launch",
                     "capacity_items_per_launch", "batch_fill_pct",
                     "pad_waste_pct", "mixed_waves", "elapsed_s",
-                    "launch_probe_ms",
+                    "launch_probe_ms", "hold_s", "launches_ungated",
+                    "batch_fill_ungated_pct", "tx_per_sec_ungated",
                 )}
                 for r in sweep
             ],
             "capacity_scaling": scaling.get("value"),
             "items_per_launch_ratio": scaling.get("items_per_launch_ratio"),
             "tx_ratio": scaling.get("tx_ratio"),
+            # the ISSUE 11 wave-deepening claim at the top point: gated
+            # fill up, launches strictly below the ungated control
+            "gating": {
+                "hold_s": top.get("hold_s"),
+                "launches": top.get("launches"),
+                "launches_ungated": top.get("launches_ungated"),
+                "fill_pct": top.get("batch_fill_pct"),
+                "fill_ungated_pct": top.get("batch_fill_ungated_pct"),
+                "hold": top_mesh.get("hold"),
+            },
             "verdict_parity": {
                 "match": parity.get("match"),
                 "devices_checked": parity.get("devices_checked"),
                 "items": parity.get("items"),
             },
+            "verdict_parity_2d": {
+                "match": parity_2d.get("match"),
+                "counts_match": parity_2d.get("counts_match"),
+                "devices_checked": parity_2d.get("devices_checked"),
+                "items": parity_2d.get("items"),
+            },
+            "topology": top_mesh.get("topology", "1d"),
             "shard_map_available": top_mesh.get("shard_map_available"),
             "downgrades": top_mesh.get("downgrades", 0),
             "top": top_mesh,
@@ -435,11 +461,14 @@ def mesh_bench(devices: str, cpu_mode: bool) -> None:
     points = max(1, len([d for d in devices.split(",") if d.strip()]))
     point_timeout = float(os.environ.get(
         "SMARTBFT_BENCH_MESH_POINT_TIMEOUT", "120"))
-    # derived, not guessed: every point may burn its commit deadline plus
-    # a stuck-cluster teardown, and parity pays one compile per width —
-    # the child's own per-point salvage fires before this parent kills it
+    # derived, not guessed: every point runs TWICE (ungated control +
+    # gated run) and may burn its commit deadline plus a stuck-cluster
+    # teardown each time, and the two parity stages pay one compile per
+    # width — the child's per-point salvage fires before this parent
+    # kills it
     timeout = float(os.environ.get(
-        "SMARTBFT_BENCH_MESH_TIMEOUT", str((points + 2) * point_timeout + 120)
+        "SMARTBFT_BENCH_MESH_TIMEOUT",
+        str((2 * points + 3) * point_timeout + 120)
     ))
     proc = subprocess.run(
         cmd, timeout=timeout, stdout=subprocess.PIPE, stderr=subprocess.PIPE,

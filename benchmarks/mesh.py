@@ -1,4 +1,4 @@
-"""Mesh verify-plane sweep: one coalesced wave, N devices (ISSUE 10).
+"""Mesh verify-plane sweep: one coalesced wave, N devices (ISSUE 10/11).
 
 Fixed shard count S, devices swept over ``--devices`` (default 1,2,4,8):
 each point runs a full S-shard cluster — routed front door, pipelined
@@ -10,18 +10,32 @@ a fixed per-device lane budget, so aggregate per-launch CAPACITY scales
 linearly with the mesh width — the economics that amortize the rig's
 fixed ~220 ms launch overhead across all devices (PAPERS.md [7]).
 
-Two stages, each printing JSON lines:
+ISSUE 11: every sweep point now runs TWICE at the same fixed workload —
+an UNGATED control (``verify_flush_hold = 0``, the round-13 eager
+contract) and a GATED run (occupancy-aware flush gating through the
+real Configuration knob) — and the row carries both, so the
+wave-deepening claim (gated fill > 90 % at D=8, strictly fewer
+launches than the control) is measured, not asserted.  Client
+submission is PACED (``--pace`` between decision rounds) so waves
+arrive the way live traffic does — staggered — instead of as one
+pre-loaded burst the eager window would accidentally coalesce anyway.
+
+Stages, each printing JSON lines:
 
 * **parity** — the same randomized mixed wave (several signers, forged
   items, counts that force pad slots) is verified through the
   single-device engine and through a MeshVerifyEngine at every swept
   device count; the row records whether every verdict vector matched
   bit-for-bit.  The tier-1 property test pins the same claim for P-256;
-  the bench re-checks it for the crypto it actually runs.
+  the bench re-checks it for the crypto it actually runs.  A second
+  ``mesh_parity_2d`` row makes the same bit-for-bit check through the
+  seq×vote ``QuorumMeshVerifyEngine`` (the ``verify_mesh_topology =
+  "2d"`` path, whose quorum counts psum across the 'vote' mesh axis).
 * **sweep** — one ``{"bench": "mesh", "devices": D, ...}`` row per
-  point (tx/s, launches, items/launch, capacity, fill, pad waste, mixed
-  waves, the coalescer ``mesh`` block) plus a final ``mesh_scaling``
-  line comparing the top point against D=1.
+  point (gated tx/s, launches, items/launch, capacity, fill, pad
+  waste, mixed waves, the coalescer ``mesh`` block with its ``hold``
+  decisions, plus the ungated control's launches/fill/tx) and a final
+  ``mesh_scaling`` line comparing the top point against D=1.
 
 Crypto: ``--crypto toy`` (default) is the real CryptoProvider stack over
 ``testing.toy_scheme`` — an array-math kernel that compiles in
@@ -124,9 +138,53 @@ def run_parity(device_counts: list[int], crypto: str) -> dict:
     }
 
 
-def build_cluster(tmp, devices: int, args, scheme):
+def run_parity_2d(device_counts: list[int], crypto: str) -> dict:
+    """The 2D (seq×vote) quorum-mesh parity row (ISSUE 11 tentpole b):
+    the same mixed wave through ``QuorumMeshVerifyEngine`` at every
+    even swept width must match the single-device engine bit for bit,
+    and the psum'd per-message vote counts must equal the host tally of
+    valid verdicts."""
+    from smartbft_tpu.crypto.provider import JaxVerifyEngine
+    from smartbft_tpu.parallel import QuorumMeshVerifyEngine, shard_map_available
+
+    scheme = _scheme(crypto)
+    if not shard_map_available():
+        return {"metric": "mesh_parity_2d", "crypto": crypto,
+                "devices_checked": [], "items": 0, "match": None,
+                "counts_match": None, "note": "no shard_map in this build"}
+    items, expect = _mixed_wave(scheme)
+    base = JaxVerifyEngine(pad_sizes=(16, 64), scheme=scheme).verify(items)
+    match = base == expect
+    counts_match = True
+    checked = []
+    for d in device_counts:
+        eng = QuorumMeshVerifyEngine(devices=d, scheme=scheme, quorum=3)
+        got = eng.verify(items)
+        checked.append(d)
+        if got != base:
+            match = False
+            _log(f"mesh 2d parity: verdict MISMATCH at devices={d}")
+        tally: dict = {}
+        for it, ok in zip(items, got):
+            tally[it[0]] = tally.get(it[0], 0) + (1 if ok else 0)
+        if eng.last_counts != tally:
+            counts_match = False
+            _log(f"mesh 2d parity: psum count MISMATCH at devices={d}")
+    return {
+        "metric": "mesh_parity_2d",
+        "crypto": crypto,
+        "devices_checked": checked,
+        "items": len(items),
+        "match": bool(match),
+        "counts_match": bool(counts_match),
+    }
+
+
+def build_cluster(tmp, devices: int, args, scheme, hold: float):
     """S-shard cluster whose verify plane graduates onto a
-    ``devices``-wide mesh through the Configuration knob."""
+    ``devices``-wide mesh through the Configuration knob; ``hold``
+    arms occupancy-aware flush gating through the REAL
+    ``verify_flush_hold`` knob (0 = the ungated control)."""
     import dataclasses
 
     from smartbft_tpu.crypto.provider import JaxVerifyEngine
@@ -140,6 +198,7 @@ def build_cluster(tmp, devices: int, args, scheme):
         return dataclasses.replace(
             sharded_config(i, depth=args.pipeline),
             verify_mesh_devices=devices,
+            verify_flush_hold=hold,
             wal_group_commit=True,
             request_batch_max_count=args.batch,
             request_batch_max_interval=0.02,
@@ -165,14 +224,19 @@ def build_cluster(tmp, devices: int, args, scheme):
     )
 
 
-async def run_sweep_point(devices: int, args) -> dict:
-    from smartbft_tpu.crypto.provider import VerifyStats
+async def _run_cluster_point(devices: int, args, hold: float) -> dict:
+    """One fixed-workload cluster run at ``devices`` width with the
+    given flush-hold knob; returns the raw measurement dict."""
+    from smartbft_tpu.crypto.provider import (
+        VerifyStats,
+        prewarm_verify_engine,
+    )
     from smartbft_tpu.utils.clock import WallClockDriver
 
     scheme = _scheme(args.crypto)
     requests_per_shard = args.decisions * args.batch
     tmp = tempfile.mkdtemp(prefix=f"bench-mesh-{devices}-")
-    cluster = build_cluster(tmp, devices, args, scheme)
+    cluster = build_cluster(tmp, devices, args, scheme, hold)
     driver = WallClockDriver(cluster.scheduler, tick_interval=0.01)
     try:
         driver.start()
@@ -184,11 +248,16 @@ async def run_sweep_point(devices: int, args) -> dict:
                 f"knob wiring failed: wanted a {devices}-device mesh, "
                 f"coalescer runs {type(engine).__name__} ({got_devices})"
             )
-        # pre-warm every mesh lane shape + probe the warm launch cost
+        if abs(cluster.coalescer.hold - hold) > 1e-9:
+            raise RuntimeError(
+                f"knob wiring failed: wanted verify_flush_hold={hold}, "
+                f"coalescer holds {cluster.coalescer.hold}"
+            )
+        # pre-warm every mesh lane shape (persists into the compilation
+        # cache — see enable_compile_cache) + probe the warm launch cost
+        prewarm_verify_engine(engine, scheme)
         sk, pub = scheme.keygen(b"mesh-probe")
         item = scheme.make_item(b"p", scheme.sign_raw(sk, b"p"), pub)
-        for size in engine.pad_sizes:
-            engine.verify([item] * size)
         t0 = time.perf_counter()
         for _ in range(3):
             engine.verify([item])
@@ -200,11 +269,17 @@ async def run_sweep_point(devices: int, args) -> dict:
         for s in range(args.shards):
             cluster.client_for_shard(s, 3)
         t0 = time.perf_counter()
+        # PACED submission: one decision round per pace interval, so
+        # waves arrive staggered like live traffic (the eager window
+        # would otherwise coalesce a pre-loaded burst by accident and
+        # the gated-vs-ungated comparison would measure nothing)
         for j in range(args.decisions):
             for s in range(args.shards):
                 for k in range(args.batch):
                     cid = cluster.client_for_shard(s, (j + k) % 4)
                     await cluster.submit(cid, f"m-{s}-{j}-{k}")
+            if args.pace > 0:
+                await asyncio.sleep(args.pace)
         deadline = time.perf_counter() + POINT_TIMEOUT
         while time.perf_counter() < deadline:
             if all(sh.committed() >= requests_per_shard
@@ -221,29 +296,18 @@ async def run_sweep_point(devices: int, args) -> dict:
         cluster.check_invariants()
 
         stats = engine.stats
-        total = sum(sh.committed() for sh in cluster.shard_list)
-        decisions = sum(sh.height() for sh in cluster.shard_list)
-        mesh_block = cluster.coalescer.mesh_snapshot()
         return {
-            "bench": "mesh",
-            "devices": devices,
-            "shards": args.shards,
-            "crypto": args.crypto,
-            "nodes_per_shard": args.nodes,
-            "pipeline": args.pipeline,
-            "decisions": decisions,
-            "tx_per_sec": round(total / elapsed, 1),
-            "launches": stats.launches,
-            "items_per_launch": round(stats.sigs_verified / stats.launches, 1)
-            if stats.launches else 0.0,
-            "capacity_items_per_launch": int(engine.pad_sizes[-1]),
-            "batch_fill_pct": round(stats.batch_fill_pct, 1),
-            "pad_waste_pct": mesh_block.get("pad_waste_pct", 0.0),
-            "mixed_waves":
-                cluster.coalescer.shard_snapshot()["mixed_waves"],
+            "hold_s": hold,
             "launch_probe_ms": round(launch_probe_ms, 2),
             "elapsed_s": round(elapsed, 2),
-            "mesh": mesh_block,
+            "total": sum(sh.committed() for sh in cluster.shard_list),
+            "decisions": sum(sh.height() for sh in cluster.shard_list),
+            "launches": stats.launches,
+            "items": stats.sigs_verified,
+            "fill_pct": round(stats.batch_fill_pct, 1),
+            "capacity": int(engine.pad_sizes[-1]),
+            "mesh": cluster.coalescer.mesh_snapshot(),
+            "mixed_waves": cluster.coalescer.shard_snapshot()["mixed_waves"],
         }
     finally:
         try:
@@ -252,6 +316,50 @@ async def run_sweep_point(devices: int, args) -> dict:
             pass
         await driver.stop()
         shutil.rmtree(tmp, ignore_errors=True)
+
+
+async def run_sweep_point(devices: int, args) -> dict:
+    """One devices-sweep row: the UNGATED control first (hold 0, the
+    round-13 contract), then the GATED run at the same fixed workload.
+    Gated numbers are the row's primary values; the control rides along
+    as ``*_ungated`` so fill/launch deltas are in every row.  With
+    ``--hold 0`` the two runs would be identical, so the control is
+    reused instead of paying a second cluster for a no-op comparison."""
+    control = await _run_cluster_point(devices, args, 0.0)
+    gated = control if args.hold <= 0 \
+        else await _run_cluster_point(devices, args, args.hold)
+    mesh_block = gated["mesh"]
+    return {
+        "bench": "mesh",
+        "devices": devices,
+        "shards": args.shards,
+        "crypto": args.crypto,
+        "nodes_per_shard": args.nodes,
+        "pipeline": args.pipeline,
+        "decisions": gated["decisions"],
+        "hold_s": args.hold,
+        "pace_s": args.pace,
+        "tx_per_sec": round(gated["total"] / gated["elapsed_s"], 1)
+        if gated["elapsed_s"] else 0.0,
+        "launches": gated["launches"],
+        "items_per_launch":
+            round(gated["items"] / gated["launches"], 1)
+            if gated["launches"] else 0.0,
+        "capacity_items_per_launch": gated["capacity"],
+        "batch_fill_pct": gated["fill_pct"],
+        "pad_waste_pct": mesh_block.get("pad_waste_pct", 0.0),
+        "mixed_waves": gated["mixed_waves"],
+        "launch_probe_ms": gated["launch_probe_ms"],
+        "elapsed_s": gated["elapsed_s"],
+        # the ungated control at the SAME fixed workload: the
+        # wave-deepening deltas (fill up, launches strictly down)
+        "launches_ungated": control["launches"],
+        "batch_fill_ungated_pct": control["fill_pct"],
+        "tx_per_sec_ungated": round(
+            control["total"] / control["elapsed_s"], 1)
+        if control["elapsed_s"] else 0.0,
+        "mesh": mesh_block,
+    }
 
 
 def main() -> None:
@@ -266,11 +374,18 @@ def main() -> None:
                     help="decisions committed per shard per point")
     ap.add_argument("--pipeline", type=int, default=8)
     ap.add_argument("--crypto", choices=("toy", "p256"), default="toy")
-    ap.add_argument("--per-device-lanes", default="4,16",
+    ap.add_argument("--per-device-lanes", default="4,8,12,16",
                     help="pad-ladder lanes contributed by EACH device — "
-                         "per-launch capacity = lanes x devices")
+                         "per-launch capacity = lanes x devices (a denser "
+                         "ladder lets deepened waves land near a rung)")
     ap.add_argument("--window", type=float, default=0.02,
                     help="coalescer fan-in window (seconds)")
+    ap.add_argument("--hold", type=float, default=0.25,
+                    help="verify_flush_hold for the GATED run (seconds; "
+                         "the ungated control always runs at 0)")
+    ap.add_argument("--pace", type=float, default=0.03,
+                    help="sleep between decision submission rounds — "
+                         "staggers wave arrivals like live traffic")
     ap.add_argument("--cpu", action="store_true",
                     help="pin JAX to CPU and self-provision a virtual "
                          "device mesh (the MULTICHIP harness idiom)")
@@ -279,6 +394,13 @@ def main() -> None:
     sweep = [int(x) for x in args.devices.split(",") if x.strip()]
     if args.cpu or os.environ.get("SMARTBFT_BENCH_CPU") == "1":
         force_cpu(virtual_devices=max(sweep))
+    else:
+        # device rigs: persist compiled mesh shapes across bench
+        # subprocesses (SMARTBFT_JAX_CACHE_DIR overrides the location) —
+        # the 2-3 min per-process compile tax must not poison every row
+        from smartbft_tpu.utils.jaxenv import enable_compile_cache
+
+        enable_compile_cache()
     import jax
 
     avail = len(jax.devices())
@@ -296,6 +418,12 @@ def main() -> None:
         print(json.dumps(run_parity(sweep, args.crypto)), flush=True)
     except Exception as exc:  # noqa: BLE001 — parity row is additive
         _log(f"mesh parity: FAILED — {exc!r}")
+    try:
+        # the 2D engine needs an even width for a real 'vote' axis
+        two_d = [d for d in sweep if d % 2 == 0] or sweep
+        print(json.dumps(run_parity_2d(two_d, args.crypto)), flush=True)
+    except Exception as exc:  # noqa: BLE001 — parity row is additive
+        _log(f"mesh 2d parity: FAILED — {exc!r}")
 
     rows = []
     for d in sweep:
@@ -306,9 +434,11 @@ def main() -> None:
             _log(f"mesh[{d}]: FAILED — {exc!r}")
             continue
         _log(f"mesh[{d}]: {row['tx_per_sec']} tx/s, {row['launches']} "
-             f"launches, {row['items_per_launch']} items/launch "
+             f"launches (ungated {row['launches_ungated']}), "
+             f"{row['items_per_launch']} items/launch "
              f"(capacity {row['capacity_items_per_launch']}), fill "
-             f"{row['batch_fill_pct']}%")
+             f"{row['batch_fill_pct']}% (ungated "
+             f"{row['batch_fill_ungated_pct']}%)")
         print(json.dumps(row), flush=True)
         rows.append(row)
 

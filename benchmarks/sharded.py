@@ -450,6 +450,13 @@ def main() -> None:
 
     if args.cpu or os.environ.get("SMARTBFT_BENCH_CPU") == "1":
         force_cpu()
+    else:
+        # persistent XLA compile cache on the device path (force_cpu
+        # enables it for the CPU path): per-process pad-shape compiles
+        # must not poison every device bench row
+        from smartbft_tpu.utils.jaxenv import enable_compile_cache
+
+        enable_compile_cache()
     if args.pad_sizes == "auto":
         pad_sizes = (1024, 2048, 4096, 8192) \
             if args.engine == "launch-cost" else (8, 32, 128, 512)

@@ -168,11 +168,30 @@ class Configuration:
     #   DEGRADED MODE: a host with fewer visible devices than configured
     #   keeps the single-device engine LOUDLY, with a counted downgrade
     #   (consensus.tpu.count_mesh_downgrades) — it never dies at start.
+    # - verify_mesh_topology: the mesh SHAPE when verify_mesh_devices > 0.
+    #   "1d" (default) partitions the batch axis (MeshVerifyEngine);
+    #   "2d" graduates onto the seq x vote QuorumMeshVerifyEngine, whose
+    #   per-sequence quorum counts psum across the 'vote' mesh axis —
+    #   quorum counting itself rides the device collective — while
+    #   per-item verdicts stay bit-identical to the 1D engine.  A build
+    #   with no usable shard_map downgrades loudly like an unbuildable
+    #   mesh.
+    # - verify_flush_hold: occupancy-aware flush gating (wall-clock
+    #   seconds; 0 disables).  A coalescer flush whose wave sits below a
+    #   pad-ladder rung may HOLD up to this hard deadline while per-tag
+    #   submit-rate tracking predicts more shards' waves inbound, so one
+    #   deeper launch replaces several shallow ones (fixed-launch-
+    #   overhead amortization).  The hold is bypassed outright while the
+    #   breaker is open (host fallback must not wait), past max_batch,
+    #   and for rung-exact waves; hold decisions are exported in the
+    #   bench `mesh` block (waves_held, held_ms, depth_gain_items).
     verify_launch_timeout: float = 30.0
     verify_launch_retries: int = 2
     verify_breaker_threshold: int = 3
     verify_probe_interval: float = 2.0
     verify_mesh_devices: int = 0
+    verify_mesh_topology: str = "1d"
+    verify_flush_hold: float = 0.0
 
     # Real-socket transport (smartbft_tpu/net/ — no reference counterpart:
     # the reference is a library whose embedder supplies Comm; these knobs
@@ -279,6 +298,17 @@ class Configuration:
             raise ConfigError(
                 "verify_mesh_devices should not be negative "
                 "(0 = single-device verify plane)"
+            )
+        if self.verify_mesh_topology not in ("1d", "2d"):
+            raise ConfigError(
+                "verify_mesh_topology should be '1d' (batch-axis mesh) or "
+                "'2d' (seq x vote quorum mesh), got "
+                f"{self.verify_mesh_topology!r}"
+            )
+        if self.verify_flush_hold < 0:
+            raise ConfigError(
+                "verify_flush_hold should not be negative "
+                "(0 disables occupancy-aware flush gating)"
             )
         if not (0.0 < self.admission_high_water <= 1.0):
             raise ConfigError(

@@ -168,8 +168,19 @@ class Consensus:
                 )
             except Exception as e:  # noqa: BLE001 — wiring must not kill start
                 self.logger.warnf("verify-plane fault wiring failed: %r", e)
+        # occupancy-aware flush gating (verify_flush_hold): wired before
+        # the mesh so a graduated engine's first waves already gate.
+        # configure_hold keeps explicit constructor holds (the shared-
+        # coalescer contract, like the fault policy).
+        configure_hold = getattr(self.verifier, "configure_flush_hold", None)
+        if configure_hold is not None:
+            try:
+                configure_hold(self.config.verify_flush_hold)
+            except Exception as e:  # noqa: BLE001 — wiring must not kill start
+                self.logger.warnf("verify flush-hold wiring failed: %r", e)
         # mesh graduation (verify_mesh_devices > 0): swap the coalescer's
-        # engine onto an N-device mesh — idempotent across colocated
+        # engine onto an N-device mesh — 1D batch-axis or (topology "2d")
+        # the seq x vote quorum mesh — idempotent across colocated
         # replicas sharing one coalescer and across reconfigs; an
         # unbuildable mesh downgrades loudly inside the provider (counted)
         # instead of raising, so only unexpected wiring errors land here.
@@ -177,9 +188,24 @@ class Consensus:
             configure_mesh = getattr(self.verifier, "configure_verify_mesh",
                                      None)
             if configure_mesh is not None:
+                # a pre-topology provider implementation gets the width
+                # alone — probed by SIGNATURE, never by catching
+                # TypeError (a TypeError raised inside mesh construction
+                # must surface in the log, not silently downgrade a "2d"
+                # config to the 1D mesh)
+                kwargs = {"metrics": self.metrics.tpu}
                 try:
-                    configure_mesh(self.config.verify_mesh_devices,
-                                   metrics=self.metrics.tpu)
+                    import inspect
+
+                    params = inspect.signature(configure_mesh).parameters
+                    if "topology" in params:
+                        kwargs["topology"] = self.config.verify_mesh_topology
+                except (TypeError, ValueError):
+                    # unsignaturable callable (C extension, mock): assume
+                    # the current provider surface
+                    kwargs["topology"] = self.config.verify_mesh_topology
+                try:
+                    configure_mesh(self.config.verify_mesh_devices, **kwargs)
                 except Exception as e:  # noqa: BLE001 — ditto
                     self.logger.warnf("verify-mesh wiring failed: %r", e)
 

@@ -135,17 +135,25 @@ class MeshVerifyStats(VerifyStats):
     launches_spanning_all_devices: int = 0
     last_device_fill_pct: list = field(default_factory=list)
 
-    def record(self, n_sigs: int, n_slots: int, seconds: float) -> None:
+    def record(self, n_sigs: int, n_slots: int, seconds: float,
+               per_device: Optional[list] = None) -> None:
+        """``per_device``: the engine's actual per-device item counts for
+        this launch (the strided-placement engine reports them exactly);
+        None falls back to the contiguous-placement model (items fill
+        devices front to back, padding on the tail)."""
         super().record(n_sigs, n_slots, seconds)
         pad = max(n_slots - n_sigs, 0)
         self.pad_slots += pad
         per_dev = max(1, n_slots // max(1, self.devices))
-        fills = []
-        for d in range(self.devices):
-            got = min(max(n_sigs - d * per_dev, 0), per_dev)
-            fills.append(round(100.0 * got / per_dev, 1))
+        if per_device is not None:
+            fills = [round(100.0 * got / per_dev, 1) for got in per_device]
+        else:
+            fills = []
+            for d in range(self.devices):
+                got = min(max(n_sigs - d * per_dev, 0), per_dev)
+                fills.append(round(100.0 * got / per_dev, 1))
         self.last_device_fill_pct = fills
-        if fills and fills[-1] > 0:
+        if fills and min(fills) > 0:
             self.launches_spanning_all_devices += 1
         m = self.metrics
         if m is not None and hasattr(m, "count_mesh_launches"):
@@ -283,6 +291,112 @@ class ShardAttribution:
                 for tag, st in sorted(self.per_tag.items(), key=lambda kv: str(kv[0]))
             },
         }
+
+
+@dataclass
+class FlushHoldStats:
+    """Occupancy-aware flush-gating accounting (ISSUE 11 tentpole a).
+
+    Every decision the gate takes is exported (``mesh_snapshot``'s
+    ``hold`` block rides every bench row): how many waves were held, for
+    how long, how many items the holds actually gained (``depth_gain``
+    — the wave-deepening payoff), and the two bounded-latency outs —
+    holds that ran out the hard ``verify_flush_hold`` deadline and
+    flushes that skipped the hold because the breaker was open (host
+    fallback must never wait on device-occupancy predictions)."""
+
+    waves_held: int = 0
+    held_ms: float = 0.0
+    depth_gain_items: int = 0
+    deadline_expired: int = 0
+    breaker_bypass: int = 0
+
+    def snapshot(self, hold_s: float) -> dict:
+        return {
+            "hold_s": float(hold_s),
+            "waves_held": self.waves_held,
+            "held_ms": round(self.held_ms, 2),
+            "depth_gain_items": self.depth_gain_items,
+            "deadline_expired": self.deadline_expired,
+            "breaker_bypass": self.breaker_bypass,
+        }
+
+
+class TagRateTracker:
+    """Per-tag submit-cadence tracking: the occupancy signal behind
+    flush gating (the PR 8 drain-rate-EWMA idiom, pointed at ARRIVALS).
+
+    Each ``submit(tag=...)`` notes wall time; the inter-submit gap per
+    tag folds into an EWMA.  :meth:`any_imminent` answers the gate's one
+    question — does any recently-live tag plausibly deliver another wave
+    within the remaining hold budget?  A tag is *live* while the time
+    since its last submit is within ``slack`` expected gaps (cold tags
+    borrow the coalescer window as their gap estimate), and *imminent*
+    while its predicted next arrival fits in the remaining budget.
+    Untagged submissions track under ``None`` — single-group
+    deployments still deepen their waves."""
+
+    __slots__ = ("_last", "_ewma", "slack", "default_gap")
+
+    #: tags silent this long are evicted outright — far beyond any
+    #: plausible hold budget (sub-second), so eviction can never hide a
+    #: tag a live hold could still be waiting for.  Bounds both memory
+    #: and the any_imminent scan under shard churn (the PR 7 autoscaler
+    #: retires shard ids over a long-lived process's life).
+    EVICT_AFTER = 60.0
+    #: dict size that triggers an eviction sweep in note() — sweeps are
+    #: O(tags) but amortized across at least this many submits
+    EVICT_SWEEP_AT = 128
+
+    def __init__(self, default_gap: float = 0.002, slack: float = 4.0):
+        self._last: dict = {}
+        self._ewma: dict = {}
+        self.slack = slack
+        self.default_gap = default_gap
+
+    def note(self, tag, now: float) -> None:
+        prev = self._last.get(tag)
+        if prev is not None:
+            gap = max(now - prev, 1e-6)
+            # sub-window gaps are the SAME logical wave (a shard's n
+            # replicas submit the same quorum check within microseconds)
+            # — folding them in would teach the tracker a microsecond
+            # "cadence" and make every tag look quiet the moment its
+            # burst ends; only inter-wave gaps carry cadence signal
+            if gap >= self.default_gap:
+                old = self._ewma.get(tag)
+                self._ewma[tag] = gap if old is None \
+                    else 0.5 * old + 0.5 * gap
+        elif len(self._last) >= self.EVICT_SWEEP_AT:
+            # a NEW tag on a full tracker: sweep out long-dead tags so
+            # retired shards can never grow the dict without bound
+            dead = [t for t, ts in self._last.items()
+                    if now - ts > self.EVICT_AFTER]
+            for t in dead:
+                del self._last[t]
+                self._ewma.pop(t, None)
+        self._last[tag] = now
+
+    def any_imminent(self, now: float, remaining: float,
+                     budget: Optional[float] = None) -> bool:
+        if budget is None:
+            budget = self.slack * self.default_gap
+        for tag, last in self._last.items():
+            gap = self._ewma.get(tag)
+            if gap is None:
+                # cold tag (one submit seen, no cadence yet): stay
+                # optimistic within the hold budget — the hard deadline
+                # bounds the cost, and a second wave teaches the cadence
+                if now - last <= budget:
+                    return True
+                continue
+            if now - last > self.slack * gap:
+                continue  # tag went quiet — stop predicting it
+            # overdue counts as "any moment now"; otherwise the predicted
+            # arrival must fit inside what is left of the hold budget
+            if last + gap <= now + remaining:
+                return True
+        return False
 
 
 @dataclass
@@ -551,6 +665,28 @@ class JaxVerifyEngine:
         return [bool(v) for v in mask[:n]]
 
 
+def prewarm_verify_engine(engine, scheme=None,
+                          sizes: Optional[Sequence[int]] = None) -> None:
+    """Compile every pad-ladder shape of ``engine`` with a generated
+    probe item — the device-rig prewarm helper (ISSUE 11 satellite).
+
+    Pair with :func:`smartbft_tpu.utils.jaxenv.enable_compile_cache`:
+    with the persistent compilation cache pointed at a durable directory
+    (``SMARTBFT_JAX_CACHE_DIR``), the first process pays each mesh
+    shape's XLA compile ONCE and every later process — each bench
+    subprocess, each sweep point — loads it from disk, so the 2–3 min
+    per-process compile tax (PERF.md "cold-compile budget") stops
+    poisoning device bench rows.  No-op for engines without a pad ladder
+    (host engines compile nothing)."""
+    prewarm = getattr(engine, "prewarm_shapes", None)
+    if prewarm is None:
+        return
+    scheme = scheme if scheme is not None else engine.scheme
+    sk, pub = scheme.keygen(b"smartbft-prewarm-probe")
+    item = scheme.make_item(b"p", scheme.sign_raw(sk, b"p"), pub)
+    prewarm(item, sizes)
+
+
 class AsyncBatchCoalescer:
     """Merges concurrent verify calls into shared kernel launches.
 
@@ -564,7 +700,8 @@ class AsyncBatchCoalescer:
     def __init__(self, engine, window: float = 0.002, max_batch: int = 2048,
                  dedupe: bool = False,
                  policy: Optional[VerifyFaultPolicy] = None,
-                 fallback_engine=None, metrics=None):
+                 fallback_engine=None, metrics=None,
+                 hold: Optional[float] = None):
         """``dedupe``: verify each DISTINCT item once per flush and fan the
         verdict out to every submitter.  Verification is a pure function of
         (message, signature, key), so this is sound; it pays off when many
@@ -584,7 +721,19 @@ class AsyncBatchCoalescer:
         committing at CPU speed), and only a wave that exhausts retries AND
         the fallback raises :class:`~smartbft_tpu.types.VerifyPlaneDown`.
         ``metrics``: an optional TPUCryptoMetrics bundle counting launch
-        failures/timeouts/retries and breaker transitions."""
+        failures/timeouts/retries and breaker transitions.
+
+        ``hold``: occupancy-aware flush gating (the
+        ``Configuration.verify_flush_hold`` knob).  When > 0, a flush
+        whose wave is below a pad-ladder rung briefly HOLDS — up to
+        ``hold`` wall-clock seconds, the hard latency bound — while the
+        per-tag submit-rate tracker predicts more waves inbound, so one
+        deeper launch replaces several shallow ones (the fixed-launch-
+        overhead economics of PAPERS.md [7]).  The hold never engages
+        when the breaker is open (host fallback must not wait), never
+        past ``max_batch``, and flushes the moment the wave lands
+        exactly on a rung (zero pad waste beats more depth).  None/0
+        keeps the legacy eager-window contract."""
         self.engine = engine
         self.window = window
         self.max_batch = max_batch
@@ -599,6 +748,15 @@ class AsyncBatchCoalescer:
             metrics.breaker_state.set(0.0)  # healthy until proven otherwise
         self.fault_stats = VerifyFaultStats()
         self.shard_stats = ShardAttribution()
+        #: occupancy-aware flush gating (ISSUE 11): hold budget seconds
+        #: (0 = eager legacy flushing), per-tag arrival tracker, and the
+        #: exported decision accounting.  A constructor-supplied hold is
+        #: EXPLICIT like a constructor policy (configure_hold never
+        #: overrides it); config-wired holds stay re-wirable.
+        self.hold = float(hold) if hold else 0.0
+        self._hold_explicit = hold is not None
+        self.hold_stats = FlushHoldStats()
+        self._tag_rates = TagRateTracker(default_gap=max(window, 0.001))
         #: mesh graduation accounting (CryptoProvider.configure_verify_mesh
         #: writes these; they live on the coalescer because the coalescer
         #: is the ONE shared object in sharded mode — like the breaker)
@@ -639,6 +797,19 @@ class AsyncBatchCoalescer:
         if metrics is not None and self.metrics is None:
             self.metrics = metrics
             self.metrics.breaker_state.set(1.0 if self._breaker_is_open else 0.0)
+
+    def configure_hold(self, hold: Optional[float],
+                       explicit: bool = False) -> None:
+        """Late flush-gating wiring (``Consensus._wire_verify_plane``
+        applies ``Configuration.verify_flush_hold`` here at start and on
+        every reconfig).  Same precedence contract as :meth:`configure`:
+        a constructor-supplied hold is explicit and never overridden; a
+        defaulted or previously config-wired one IS replaced."""
+        if hold is None:
+            return
+        if explicit or not self._hold_explicit:
+            self.hold = max(0.0, float(hold))
+            self._hold_explicit = self._hold_explicit or explicit
 
     @property
     def breaker_open(self) -> bool:
@@ -681,6 +852,10 @@ class AsyncBatchCoalescer:
             "devices": devices if devices > 0 else 1,
             "configured_devices": self.mesh_configured,
             "downgrades": self.mesh_downgrades,
+            "topology": getattr(eng, "topology", "1d"),
+            # occupancy-aware flush gating decisions (ISSUE 11): every
+            # hold the gate took, its cost, and its depth payoff
+            "hold": self.hold_stats.snapshot(self.hold),
         }
         try:
             from ..parallel.engine import shard_map_available
@@ -704,6 +879,7 @@ class AsyncBatchCoalescer:
             return []
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
+        self._tag_rates.note(tag, time.monotonic())
         async with self._lock:
             start = len(self._pending)
             self._pending.extend(items)
@@ -730,9 +906,66 @@ class AsyncBatchCoalescer:
                 )
         return await fut
 
+    def _rung_exact(self, n: int) -> bool:
+        """A wave sitting exactly on a pad-ladder rung has zero pad
+        waste — holding it can only trade guaranteed-perfect fill for
+        speculative depth, so the gate flushes it immediately."""
+        sizes = getattr(self.engine, "pad_sizes", None)
+        return bool(sizes) and n in sizes
+
+    async def _maybe_hold(self) -> None:
+        """Occupancy-aware flush gating: briefly hold this flush while
+        the per-tag arrival tracker predicts more waves inbound, bounded
+        by the hard ``hold`` deadline.  See the constructor docstring
+        for the never-hold conditions (breaker open, full batch,
+        rung-exact wave)."""
+        budget = self.hold
+        if budget <= 0.0:
+            return
+        start = time.monotonic()
+        start_depth: Optional[int] = None
+        quantum = max(min(self.window, budget / 4.0), 0.001)
+        expired = False
+        while True:
+            now = time.monotonic()
+            held = now - start
+            async with self._lock:
+                if self._launch_inflight or not self._pending:
+                    break  # another flush task took the batch
+                n = len(self._pending)
+                if self._breaker_is_open:
+                    if start_depth is None:
+                        self.hold_stats.breaker_bypass += 1
+                    break  # host fallback must not wait on predictions
+                if n >= self.max_batch or self._rung_exact(n):
+                    break
+                if held >= budget:
+                    expired = True
+                    break
+                if not self._tag_rates.any_imminent(now, budget - held,
+                                                    budget):
+                    break
+                if start_depth is None:
+                    start_depth = n
+            await asyncio.sleep(quantum)
+        if start_depth is not None:
+            held_s = time.monotonic() - start
+            self.hold_stats.waves_held += 1
+            self.hold_stats.held_ms += 1e3 * held_s
+            async with self._lock:
+                gain = max(len(self._pending) - start_depth, 0)
+            self.hold_stats.depth_gain_items += gain
+            if expired:
+                self.hold_stats.deadline_expired += 1
+            if self.metrics is not None \
+                    and hasattr(self.metrics, "count_waves_held"):
+                self.metrics.count_waves_held.add(1)
+                self.metrics.count_hold_depth_gain.add(gain)
+
     async def _flush_after(self, delay: float) -> None:
         if delay:
             await asyncio.sleep(delay)
+        await self._maybe_hold()
         # swap under the lock, verify outside it — submissions arriving
         # during the kernel launch accumulate into the NEXT batch
         async with self._lock:
@@ -1188,7 +1421,24 @@ class CryptoProvider:
             policy=policy, fallback_engine=fallback_engine, metrics=metrics
         )
 
-    def configure_verify_mesh(self, devices: int, metrics=None) -> None:
+    def configure_flush_hold(self, hold: Optional[float],
+                             explicit: bool = False) -> None:
+        """Late occupancy-gating wiring: apply the
+        ``Configuration.verify_flush_hold`` knob to the (possibly
+        shared) coalescer.  Same precedence as the fault policy — an
+        explicitly constructed hold wins over config-wired values."""
+        self._coalescer.configure_hold(hold, explicit=explicit)
+
+    def _quorum_threshold(self) -> int:
+        """ceil((n+f+1)/2) over this keyring's membership — the quorum
+        the 2D engine's psum'd vote counts decide against (the same
+        expression every View uses; verdicts do NOT depend on it)."""
+        n = len(self.keyring.public_keys)
+        f = (n - 1) // 3
+        return (n + f + 2) // 2
+
+    def configure_verify_mesh(self, devices: int, metrics=None,
+                              topology: str = "1d") -> None:
         """Graduate the coalescer's engine onto an N-device mesh — the
         ``Configuration.verify_mesh_devices`` knob, wired by
         ``Consensus._wire_verify_plane`` at start and on every reconfig.
@@ -1203,9 +1453,19 @@ class CryptoProvider:
         the breaker degrades every shard to the host fallback together and
         the canary recovers them back onto the mesh.
 
+        ``topology`` selects the mesh shape (the
+        ``Configuration.verify_mesh_topology`` knob): ``"1d"`` (default)
+        is the batch-axis :class:`~smartbft_tpu.parallel.MeshVerifyEngine`;
+        ``"2d"`` graduates onto the seq×vote
+        :class:`~smartbft_tpu.parallel.QuorumMeshVerifyEngine`, whose
+        per-sequence quorum counts ``psum`` across the 'vote' mesh axis —
+        quorum counting rides the collective instead of the host — while
+        per-item verdicts stay bit-identical to the 1D engine.
+
         **Degraded mode**: when the mesh is unbuildable (fewer visible
-        devices than configured) the current single-device engine stays,
-        LOUDLY, with a counted downgrade (``coalescer.mesh_downgrades`` +
+        devices than configured, or — for the 2D topology — no usable
+        shard_map API) the current single-device engine stays, LOUDLY,
+        with a counted downgrade (``coalescer.mesh_downgrades`` +
         ``consensus.tpu.count_mesh_downgrades``) — a mis-provisioned host
         serves at reduced width instead of dying."""
         if devices <= 0:
@@ -1220,17 +1480,35 @@ class CryptoProvider:
             co.configure(metrics=metrics)
         metrics = co.metrics if co.metrics is not None else metrics
         current = co.engine
-        if int(getattr(current, "devices", 0)) == int(devices):
+        if int(getattr(current, "devices", 0)) == int(devices) \
+                and getattr(current, "topology", "1d") == topology:
             self.engine = current
             return  # already this mesh (possibly FaultyEngine-wrapped)
-        from ..parallel.engine import MeshUnavailable, MeshVerifyEngine
+        from ..parallel.engine import (
+            MeshUnavailable,
+            MeshVerifyEngine,
+            QuorumMeshVerifyEngine,
+        )
 
         try:
-            engine = MeshVerifyEngine(
-                devices=int(devices), scheme=self.scheme,
-                pad_sizes=getattr(current, "pad_sizes", None),
-                metrics=metrics,
-            )
+            if topology == "2d":
+                engine = QuorumMeshVerifyEngine(
+                    devices=int(devices), scheme=self.scheme,
+                    quorum=self._quorum_threshold(), metrics=metrics,
+                )
+            else:
+                # the current engine donates its pad ladder ONLY when it
+                # actually carries a batch ladder: a 2D engine's
+                # pad_sizes is the single seq_tile*vote_tile rung, and
+                # inheriting it on a 2d->1d reconfig would silently cap
+                # the rebuilt 1D mesh far below the derived
+                # MESH_PER_DEVICE_LANES ladder
+                donor = None if getattr(current, "topology", "1d") == "2d" \
+                    else getattr(current, "pad_sizes", None)
+                engine = MeshVerifyEngine(
+                    devices=int(devices), scheme=self.scheme,
+                    pad_sizes=donor, metrics=metrics,
+                )
         except MeshUnavailable as exc:
             co.mesh_downgrades += 1
             if metrics is not None and hasattr(metrics, "count_mesh_downgrades"):
@@ -1251,6 +1529,7 @@ class CryptoProvider:
             current.scheme = engine.scheme
             current.pad_sizes = engine.pad_sizes
             current.devices = engine.devices
+            current.topology = engine.topology
             engine = current
         else:
             co.engine = engine

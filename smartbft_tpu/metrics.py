@@ -512,6 +512,12 @@ class TPUCryptoMetrics:
         self.count_mesh_pad_slots = _c(p, "tpu", "count_mesh_pad_slots")
         self.count_mesh_downgrades = _c(p, "tpu", "count_mesh_downgrades")
         self.mesh_device_fill_percent = _h(p, "tpu", "mesh_device_fill_percent")
+        # occupancy-aware flush gating (ISSUE 11): how many flushes held
+        # for predicted-inbound waves, and how many items those holds
+        # actually gained — the wave-deepening payoff, mirrored in the
+        # `hold` sub-block of every bench row's `mesh` block
+        self.count_waves_held = _c(p, "tpu", "count_waves_held")
+        self.count_hold_depth_gain = _c(p, "tpu", "count_hold_depth_gain")
 
 
 def tpu_counters_aggregate(providers: Sequence[InMemoryProvider]) -> dict:

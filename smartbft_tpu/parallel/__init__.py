@@ -17,6 +17,11 @@ Two products:
   verifies its (sequences, votes) tile, vote counts reduce with a `psum`
   over the 'vote' axis, and the decided mask shards over 'seq'.  This is
   the flagship multi-chip step `__graft_entry__.dryrun_multichip` compiles.
+* :class:`QuorumMeshVerifyEngine` — that quorum step as a LIVE verify
+  engine (ISSUE 11): selectable through ``Configuration.
+  verify_mesh_topology = "2d"`` on the same ``verify_mesh_devices`` knob
+  path as :class:`MeshVerifyEngine`, with per-item verdicts bit-identical
+  to the 1D engine and per-sequence vote counts psum'd on device.
 """
 
 from .engine import (

@@ -16,6 +16,7 @@ Design notes (TPU-first):
 
 from __future__ import annotations
 
+import time
 from typing import Optional
 
 import numpy as np
@@ -185,7 +186,21 @@ class MeshVerifyEngine(ShardedVerifyEngine):
     :class:`~smartbft_tpu.crypto.provider.MeshVerifyStats`: per-launch
     per-device fill and pad waste ride every record, exported through
     ``AsyncBatchCoalescer.mesh_snapshot`` into the bench ``mesh`` block.
+
+    **Strided placement** (ISSUE 11 satellite): items round-robin over
+    devices (item *j* lands in device ``j % D``'s tile) instead of
+    filling devices front to back, so pad slots spread EVENLY — round 13
+    measured one contiguous launch running 6 devices at 100 % and 2 at
+    0 %; strided, per-device item counts differ by at most one.
+    Verification lanes are independent, so the permutation cannot change
+    any verdict; results un-permute before slicing, keeping the output
+    bit-identical to the single-device engine.
     """
+
+    #: bench/wiring marker: which mesh shape this engine runs (the 2D
+    #: seq×vote engine says "2d"); configure_verify_mesh keys idempotence
+    #: on (devices, topology)
+    topology = "1d"
 
     def __init__(self, devices: Optional[int] = None, mesh=None,
                  pad_sizes: Optional[tuple[int, ...]] = None, scheme=p256,
@@ -214,7 +229,36 @@ class MeshVerifyEngine(ShardedVerifyEngine):
     def mesh_snapshot(self) -> dict:
         """JSON-able block: devices, per-launch fill per device, pad
         waste — the engine half of the bench ``mesh`` block."""
-        return self.stats.mesh_block(capacity=self.pad_sizes[-1])
+        out = self.stats.mesh_block(capacity=self.pad_sizes[-1])
+        out["topology"] = self.topology
+        return out
+
+    def _verify_chunk(self, items) -> list[bool]:
+        """Strided chunk verify: scatter item *j* to padded row
+        ``(j % D) * per_dev + j // D`` — device *d*'s tile holds items
+        ``d, d+D, d+2D, ...`` — run ONE mesh launch, then un-permute the
+        mask back to submission order.  Pad rows stay zero (they verify
+        False and are never read back)."""
+        n = len(items)
+        size = self._pad_to(n)
+        d_count = self.devices
+        per_dev = size // d_count
+        idx = np.arange(n)
+        rows = (idx % d_count) * per_dev + idx // d_count
+        t0 = time.perf_counter()
+        arrays = self.scheme.verify_inputs(items)
+
+        def scatter(a):
+            out = np.zeros((size,) + a.shape[1:], a.dtype)
+            out[rows] = a
+            return self._place(out)
+
+        mask = np.asarray(self._kernel(*(scatter(a) for a in arrays)))
+        dt = time.perf_counter() - t0
+        counts = [len(range(d, n, d_count)) for d in range(d_count)]
+        with self._lock:
+            self.stats.record(n, size, dt, per_device=counts)
+        return [bool(v) for v in mask[rows]]
 
 
 class QuorumMeshVerifyEngine(JaxVerifyEngine):
@@ -233,28 +277,57 @@ class QuorumMeshVerifyEngine(JaxVerifyEngine):
 
     Padding cells replicate a real item of the same block with weight 0,
     so they cannot inflate counts and the compiled shape is static.
+
+    GRADUATED into the live path (ISSUE 11 tentpole b): selectable
+    through ``Configuration.verify_mesh_topology = "2d"`` via the same
+    ``CryptoProvider.configure_verify_mesh`` seam as the 1D engine —
+    construction from a ``devices`` count builds the (seq × vote) mesh
+    (vote axis 2-wide on even widths), raises :class:`MeshUnavailable`
+    on narrower hosts OR when this jax build has no usable shard_map
+    (both downgrade loudly at the seam), and the PR 3
+    deadline/retry/breaker/canary contract wraps ``verify`` per mesh
+    launch exactly like the 1D engine's.
     """
 
     supports_pallas = False  # mesh-placed lanes stay on the XLA kernel
+    topology = "2d"
 
-    def __init__(self, mesh=None, quorum: int = 3, seq_tile: int = 8,
-                 vote_tile: int = 16, scheme=p256):
+    def __init__(self, devices: Optional[int] = None, mesh=None,
+                 quorum: int = 3, seq_tile: int = 8,
+                 vote_tile: int = 16, scheme=p256, metrics=None):
         if mesh is None:
             import jax
 
-            n = len(jax.devices())
-            vote_par = 2 if n % 2 == 0 else 1
-            mesh = build_mesh((n // vote_par, vote_par), ("seq", "vote"))
+            avail = list(jax.devices())
+            want = len(avail) if not devices else int(devices)
+            if want < 1 or want > len(avail):
+                raise MeshUnavailable(
+                    f"2d verify mesh wants {want} device(s), host has "
+                    f"{len(avail)}"
+                )
+            vote_par = 2 if want % 2 == 0 else 1
+            mesh = build_mesh((want // vote_par, vote_par), ("seq", "vote"),
+                              devices=avail[:want])
         if tuple(mesh.axis_names) != ("seq", "vote"):
             raise ValueError("QuorumMeshVerifyEngine wants a ('seq','vote') mesh")
+        if resolve_shard_map() is None:
+            raise MeshUnavailable(
+                "2d verify mesh needs a shard_map API (neither jax.shard_map "
+                "nor jax.experimental.shard_map is usable in this build)"
+            )
         self.mesh = mesh
         seq_par, vote_par = (int(x) for x in mesh.devices.shape)
+        self._seq_par, self._vote_par = seq_par, vote_par
         self.seq_tile = -(-seq_tile // seq_par) * seq_par
         self.vote_tile = -(-vote_tile // vote_par) * vote_par
         self.quorum = quorum
         super().__init__(pad_sizes=(self.seq_tile * self.vote_tile,),
-                         scheme=scheme)
-        self._step = None
+                         scheme=scheme, metrics=metrics)
+        #: mesh width — the attribute configure_verify_mesh keys
+        #: idempotence on (together with ``topology``)
+        self.devices = seq_par * vote_par
+        self.stats = MeshVerifyStats(devices=self.devices, metrics=metrics)
+        self._steps: dict[tuple[int, ...], object] = {}
         #: sharded quorum steps executed (each = one psum over 'vote')
         self.psum_steps = 0
         #: message bytes -> psum'd valid-vote count, from the last flush
@@ -262,7 +335,19 @@ class QuorumMeshVerifyEngine(JaxVerifyEngine):
         #: message bytes -> count >= quorum, the mesh-side quorum decision
         self.last_decided: dict[bytes, bool] = {}
 
-    def _build_step(self):
+    def mesh_snapshot(self) -> dict:
+        """The engine half of the bench ``mesh`` block (same schema as
+        the 1D engine, plus the psum-step count)."""
+        out = self.stats.mesh_block(capacity=self.pad_sizes[-1])
+        out["topology"] = self.topology
+        out["psum_steps"] = self.psum_steps
+        return out
+
+    def _build_step(self, ranks: tuple[int, ...]):
+        """One jitted shard_map step per input-rank tuple: kernel inputs
+        may be per-vote vectors (rank 3 as a quorum block) or per-vote
+        scalars (rank 2, e.g. the toy scheme's key column) — specs are
+        derived from the actual ranks like :func:`quorum_decide`."""
         import jax
         import jax.numpy as jnp
         from jax.sharding import PartitionSpec as P
@@ -274,9 +359,9 @@ class QuorumMeshVerifyEngine(JaxVerifyEngine):
             counts = jax.lax.psum(jnp.sum(local * w, axis=-1), "vote")
             return local, counts
 
-        nargs = len(scheme.verify_inputs([self._probe_item()]))
         in_specs = (P("seq", "vote"),) + tuple(
-            P("seq", "vote", None) for _ in range(nargs)
+            P("seq", "vote", None) if r == 3 else P("seq", "vote")
+            for r in ranks
         )
         shard_map = resolve_shard_map(required=True)
         sharded = shard_map(step, mesh=self.mesh, in_specs=in_specs,
@@ -294,8 +379,6 @@ class QuorumMeshVerifyEngine(JaxVerifyEngine):
 
         import jax.numpy as jnp
 
-        if self._step is None:
-            self._step = self._build_step()
         # group the flush into rows by message; rows with more votes than
         # the tile split across rows (verdicts stay exact; the split rows'
         # counts are partial and merged host-side below)
@@ -327,6 +410,13 @@ class QuorumMeshVerifyEngine(JaxVerifyEngine):
         self.last_counts = {}
         t0 = _time.perf_counter()
         lanes = 0
+        # exact per-device REAL-item counts under the (seq x vote) tile
+        # mapping: device (r-tile, v-tile) owns rows_per_dev x
+        # votes_per_dev cells of each block — the honest fill vector
+        # (the contiguous 1D model would fabricate idle devices here)
+        dev_counts = [0] * self.devices
+        rows_per_dev = self.seq_tile // self._seq_par
+        votes_per_dev = self.vote_tile // self._vote_par
         for off in range(0, len(rows), self.seq_tile):
             block = rows[off : off + self.seq_tile]
             flat: list = []
@@ -348,7 +438,11 @@ class QuorumMeshVerifyEngine(JaxVerifyEngine):
             blocks = tuple(
                 jnp.asarray(a.reshape(shape + a.shape[1:])) for a in arrays
             )
-            mask2d, counts = self._step(jnp.asarray(weights), *blocks)
+            ranks = tuple(b.ndim for b in blocks)
+            fn = self._steps.get(ranks)
+            if fn is None:
+                fn = self._steps[ranks] = self._build_step(ranks)
+            mask2d, counts = fn(jnp.asarray(weights), *blocks)
             mask2d = np.asarray(mask2d)
             counts = np.asarray(counts)
             self.psum_steps += 1
@@ -356,13 +450,16 @@ class QuorumMeshVerifyEngine(JaxVerifyEngine):
             for r, (msg, idxs) in enumerate(block):
                 for v, idx in enumerate(idxs):
                     out[idx] = bool(mask2d[r, v])
+                    dev_counts[(r // rows_per_dev) * self._vote_par
+                               + (v // votes_per_dev)] += 1
                 self.last_counts[msg] = (
                     self.last_counts.get(msg, 0) + int(counts[r])
                 )
         self.last_decided = {
             m: c >= self.quorum for m, c in self.last_counts.items()
         }
-        self.stats.record(len(items), lanes, _time.perf_counter() - t0)
+        self.stats.record(len(items), lanes, _time.perf_counter() - t0,
+                          per_device=dev_counts)
         return out
 
 

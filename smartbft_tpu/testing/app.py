@@ -230,6 +230,10 @@ class App(Application, Assembler, Comm, Signer, Verifier, RequestInspector,
             # mesh-graduation seam: Configuration.verify_mesh_devices
             # reaches the shared coalescer through the same facade wiring
             self.configure_verify_mesh = crypto.configure_verify_mesh
+        if crypto is not None and hasattr(crypto, "configure_flush_hold"):
+            # occupancy-gating seam: Configuration.verify_flush_hold
+            # reaches the shared coalescer the same way
+            self.configure_flush_hold = crypto.configure_flush_hold
 
     # ------------------------------------------------------------------ app
 

@@ -65,9 +65,11 @@ class FaultyEngine:
             self.pad_sizes = inner.pad_sizes
         # ...and a wrapped MESH engine must still look mesh-shaped: the
         # configure_verify_mesh idempotence check and the bench `mesh`
-        # block key off `devices` / `mesh_snapshot`
+        # block key off `devices` / `topology` / `mesh_snapshot`
         if hasattr(inner, "devices"):
             self.devices = inner.devices
+        if hasattr(inner, "topology"):
+            self.topology = inner.topology
         self._lock = threading.Lock()
         self._fail_next = 0
         self._slow_s = 0.0
@@ -233,3 +235,10 @@ class CoalescedTrivialCrypto:
         self._coalescer.configure(
             policy=policy, fallback_engine=fallback_engine, metrics=metrics
         )
+
+    def configure_flush_hold(self, hold=None, explicit: bool = False) -> None:
+        """Forward the ``verify_flush_hold`` knob to the shared coalescer
+        (same explicit-wins precedence as the real CryptoProvider), so
+        chaos/trivial clusters exercise occupancy gating through the
+        Configuration path too."""
+        self._coalescer.configure_hold(hold, explicit=explicit)

@@ -36,6 +36,7 @@ _MS_FIELDS = (
     "request_pool_submit_timeout",
     "verify_launch_timeout",
     "verify_probe_interval",
+    "verify_flush_hold",
     "transport_reconnect_backoff_base",
     "transport_reconnect_backoff_max",
     "reshard_drain_deadline",
@@ -77,6 +78,7 @@ _INT_FIELDS = (
 # receipt via Configuration.with_node_locals.
 _STR_FIELDS = (
     "rotation_granularity",
+    "verify_mesh_topology",
 )
 
 _BOOL_FIELDS = (
@@ -111,6 +113,7 @@ class ConfigMirror:
     autoscale_low_occupancy_bp: int = 1500
     admission_high_water_bp: int = 10000
     rotation_granularity: str = "decision"
+    verify_mesh_topology: str = "1d"
     request_batch_max_interval_ms: int = 0
     request_forward_timeout_ms: int = 0
     request_complain_timeout_ms: int = 0
@@ -122,6 +125,7 @@ class ConfigMirror:
     request_pool_submit_timeout_ms: int = 0
     verify_launch_timeout_ms: int = 30000
     verify_probe_interval_ms: int = 2000
+    verify_flush_hold_ms: int = 0
     transport_reconnect_backoff_base_ms: int = 50
     transport_reconnect_backoff_max_ms: int = 2000
     reshard_drain_deadline_ms: int = 30000

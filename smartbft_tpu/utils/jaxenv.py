@@ -23,12 +23,20 @@ import sys
 def cache_dir() -> str:
     """Persistent-compilation-cache dir, keyed by a machine fingerprint.
 
+    ``SMARTBFT_JAX_CACHE_DIR`` overrides the location outright (device
+    rigs point it at durable storage so the 2–3 min per-process mesh
+    compile is paid once per shape, not once per bench subprocess — the
+    PERF.md "cold-compile budget").  Otherwise:
+
     XLA:CPU stores AOT-compiled code keyed only by the computation; loading
     a cache entry compiled on a host with different CPU features (the
     driver's machine vs this one) emits `cpu_aot_loader.cc` feature-mismatch
     warnings and can SIGILL mid-suite.  Keying the directory by the host's
     CPU-flags hash confines each cache to machines that can execute it.
     """
+    override = os.environ.get("SMARTBFT_JAX_CACHE_DIR")
+    if override:
+        return os.path.expanduser(override)
     src = ""
     try:
         with open("/proc/cpuinfo") as f:

@@ -42,6 +42,19 @@ def require_shard_map() -> None:
         )
 
 
+def tight_verify_policy(**kw):
+    """Sub-100ms verify-plane fault policy shared by the mesh/gating
+    suites: the deadline → retry → breaker → canary cycle completes in
+    well under a second of wall clock.  Override any knob per test."""
+    from smartbft_tpu.crypto.provider import VerifyFaultPolicy
+
+    base = dict(launch_timeout=0.08, launch_retries=2, backoff_base=0.01,
+                backoff_max=0.04, backoff_jitter=0.0, breaker_threshold=3,
+                probe_interval=0.02, probe_backoff_max=0.05)
+    base.update(kw)
+    return VerifyFaultPolicy(**base)
+
+
 def require_native(available: bool, what: str) -> None:
     """Gate a test on a native backend — loudly.
 

@@ -39,7 +39,6 @@ from smartbft_tpu.crypto.provider import (
     JaxVerifyEngine,
     Keyring,
     P256CryptoProvider,
-    VerifyFaultPolicy,
 )
 from smartbft_tpu.metrics import InMemoryProvider, TPUCryptoMetrics
 from smartbft_tpu.parallel import MeshUnavailable, MeshVerifyEngine
@@ -50,12 +49,9 @@ from smartbft_tpu.testing.engine_faults import FaultyEngine, always_valid_engine
 from smartbft_tpu.testing.sharded import ShardedCluster, sharded_config
 
 
-def tight_policy(**kw) -> VerifyFaultPolicy:
-    base = dict(launch_timeout=0.08, launch_retries=2, backoff_base=0.01,
-                backoff_max=0.04, backoff_jitter=0.0, breaker_threshold=3,
-                probe_interval=0.02, probe_backoff_max=0.05)
-    base.update(kw)
-    return VerifyFaultPolicy(**base)
+from tests.conftest import tight_verify_policy as tight_policy  # noqa: E402
+# (shared with test_flush_gating / test_mesh_2d — one fault-policy
+# default for every mesh-plane suite)
 
 
 async def wait_until(cond, timeout: float = 10.0, step: float = 0.01) -> None:
@@ -155,6 +151,30 @@ def test_mesh_verdicts_match_single_device_bitwise():
     snap = mesh.mesh_snapshot()
     assert snap["devices"] == 8 and snap["launches"] >= 3
     assert snap["pad_slots"] > 0 and len(snap["device_fill_pct_last"]) == 8
+
+
+def test_strided_placement_spreads_pad_slots_evenly():
+    """ISSUE 11 satellite: items round-robin over devices, so per-device
+    item counts differ by AT MOST ONE — round 13's pathology (6 devices
+    at 100 %, 2 at 0 in one launch) cannot recur for any wave of >= D
+    items — while verdict ORDER stays bit-identical."""
+    eng = MeshVerifyEngine(devices=8, pad_sizes=(64,), scheme=toy_scheme)
+    single = JaxVerifyEngine(pad_sizes=(64,), scheme=toy_scheme)
+    for n in (8, 21, 37, 50):  # odd sizes: pad slots at every width
+        items, expect = toy_items(n, forge_every=3)
+        assert eng.verify(items) == single.verify(items) == expect
+        fills = eng.stats.last_device_fill_pct
+        assert len(fills) == 8
+        per_dev = eng.pad_sizes[0] // 8
+        counts = [round(f * per_dev / 100.0) for f in fills]
+        assert sum(counts) == n
+        # the satellite's pinned variance bound: round-robin placement
+        # can never skew per-device counts by more than one item
+        assert max(counts) - min(counts) <= 1, (n, counts)
+        if n >= 8:
+            assert min(counts) >= 1  # no zeroed device while others fill
+    # a launch with items on every device counts as spanning
+    assert eng.stats.launches_spanning_all_devices >= 3
 
 
 def test_mesh_coalescer_slices_tagged_submitters_exactly():
@@ -424,6 +444,33 @@ def test_faulty_engine_mesh_device_faults_are_transient_class():
     assert eng.verify([("a",)]) == [True]
 
 
+# --------------------------------------------- compile-cache persistence
+
+def test_compile_cache_dir_env_override(monkeypatch):
+    """ISSUE 11 satellite: SMARTBFT_JAX_CACHE_DIR points the persistent
+    XLA compilation cache at durable storage on device rigs, so the 2-3
+    min per-process mesh compile is paid once per shape, not per bench
+    subprocess; unset, the fingerprinted default applies."""
+    from smartbft_tpu.utils import jaxenv
+
+    monkeypatch.setenv("SMARTBFT_JAX_CACHE_DIR", "/tmp/rig-cache")
+    assert jaxenv.cache_dir() == "/tmp/rig-cache"
+    monkeypatch.delenv("SMARTBFT_JAX_CACHE_DIR")
+    assert "smartbft_jax_cache" in jaxenv.cache_dir()
+
+
+def test_prewarm_verify_engine_compiles_every_rung():
+    from smartbft_tpu.crypto.provider import prewarm_verify_engine
+    from smartbft_tpu.testing import toy_scheme
+
+    eng = MeshVerifyEngine(devices=8, pad_sizes=(16, 64),
+                           scheme=toy_scheme)
+    prewarm_verify_engine(eng)
+    assert eng.stats.launches == 2            # one launch per rung
+    assert eng.stats.slots_used == 16 + 64    # every shape compiled
+    prewarm_verify_engine(always_valid_engine())  # no ladder: no-op
+
+
 # ------------------------------------------------------ bench row schema pin
 
 def _synthetic_mesh_rows():
@@ -431,15 +478,22 @@ def _synthetic_mesh_rows():
         return {
             "bench": "mesh", "devices": d, "shards": 2, "crypto": "toy",
             "nodes_per_shard": 4, "pipeline": 8, "decisions": 24,
+            "hold_s": 0.25, "pace_s": 0.03,
             "tx_per_sec": 100.0 * d, "launches": 8 // d,
             "items_per_launch": 12.0 * d,
             "capacity_items_per_launch": 16 * d,
-            "batch_fill_pct": 75.0, "pad_waste_pct": 25.0, "mixed_waves": 1,
+            "batch_fill_pct": 95.0, "pad_waste_pct": 5.0, "mixed_waves": 1,
             "launch_probe_ms": 0.5, "elapsed_s": 1.0,
+            "launches_ungated": 12, "batch_fill_ungated_pct": 24.0,
+            "tx_per_sec_ungated": 110.0 * d,
             "mesh": {"enabled": True, "devices": d, "configured_devices": d,
-                     "downgrades": 0, "shard_map_available": True,
+                     "downgrades": 0, "topology": "1d",
+                     "shard_map_available": True,
+                     "hold": {"hold_s": 0.25, "waves_held": 2,
+                              "held_ms": 350.0, "depth_gain_items": 240,
+                              "deadline_expired": 1, "breaker_bypass": 0},
                      "launches": 8 // d, "items": 96,
-                     "pad_slots": 4, "pad_waste_pct": 25.0,
+                     "pad_slots": 4, "pad_waste_pct": 5.0,
                      "capacity_items_per_launch": 16 * d,
                      "device_fill_pct_last": [100.0] * d,
                      "launches_spanning_all_devices": 1},
@@ -449,6 +503,9 @@ def _synthetic_mesh_rows():
         point(1), point(8),
         {"metric": "mesh_parity", "crypto": "toy",
          "devices_checked": [1, 8], "items": 23, "match": True},
+        {"metric": "mesh_parity_2d", "crypto": "toy",
+         "devices_checked": [8], "items": 23, "match": True,
+         "counts_match": True},
         {"metric": "mesh_scaling", "value": 8.0, "devices": [1, 8],
          "tx_ratio": 8.0, "items_per_launch_ratio": 8.0,
          "launch_ratio": 0.125},
@@ -474,17 +531,31 @@ def test_assemble_mesh_row_schema_pinned():
     mesh = row["mesh"]
     for key in ("fixed_shards", "crypto", "sweep", "capacity_scaling",
                 "items_per_launch_ratio", "tx_ratio", "verdict_parity",
+                "verdict_parity_2d", "gating", "topology",
                 "shard_map_available", "downgrades", "top"):
         assert key in mesh, mesh.keys()
     assert mesh["capacity_scaling"] == 8.0
     assert mesh["verdict_parity"]["match"] is True
+    assert mesh["verdict_parity_2d"]["match"] is True
+    assert mesh["verdict_parity_2d"]["counts_match"] is True
     assert mesh["shard_map_available"] is True
+    assert mesh["topology"] == "1d"
+    # the ISSUE 11 wave-deepening claim rides the row: gated fill and a
+    # strict launch reduction vs the ungated control, hold decisions in
+    gating = mesh["gating"]
+    assert gating["hold_s"] == 0.25
+    assert gating["launches"] < gating["launches_ungated"]
+    assert gating["fill_pct"] >= 90.0 > gating["fill_ungated_pct"]
+    for key in ("waves_held", "held_ms", "depth_gain_items",
+                "deadline_expired", "breaker_bypass"):
+        assert key in gating["hold"], gating["hold"].keys()
     assert len(mesh["sweep"]) == 2
     for pt in mesh["sweep"]:
         for key in ("devices", "tx_per_sec", "launches", "items_per_launch",
                     "capacity_items_per_launch", "batch_fill_pct",
                     "pad_waste_pct", "mixed_waves", "elapsed_s",
-                    "launch_probe_ms"):
+                    "launch_probe_ms", "hold_s", "launches_ungated",
+                    "batch_fill_ungated_pct", "tx_per_sec_ungated"):
             assert key in pt, pt.keys()
 
     with pytest.raises(RuntimeError, match="no rows"):
