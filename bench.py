@@ -526,6 +526,10 @@ def assemble_open_loop_row(rows: list) -> dict:
         "shards": anchor.get("shards"),
         "zipf_skew": anchor.get("zipf_skew"),
         "admission_high_water": anchor.get("admission_high_water"),
+        # ISSUE 12: the degraded run's measured VC sub-phase decomposition
+        # + merged flight-recorder summary ride every open-loop row
+        "viewchange": degraded.get("viewchange"),
+        "trace": degraded.get("trace"),
         "sweep": [
             {k: r.get(k) for k in ("offered_per_sec", "goodput_per_sec")}
             | {"p99_ms": r["latency"]["p99_ms"],

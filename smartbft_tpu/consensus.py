@@ -62,6 +62,7 @@ class Consensus:
         metrics: Optional[MetricsBundle] = None,
         viewchanger_tick_interval: float = 1.0,
         heartbeat_tick_interval: float = 1.0,
+        recorder=None,
     ):
         self.config = config
         self.application = application
@@ -80,6 +81,18 @@ class Consensus:
         self.last_signatures = list(last_signatures)
         self.metrics = metrics or MetricsBundle()
         self.scheduler = scheduler if scheduler is not None else Scheduler()
+        # flight recorder (ISSUE 12): the embedder passes an
+        # obs.TraceRecorder to trace this replica; the default nop
+        # recorder keeps every instrumentation site at one attribute
+        # read.  The VC phase tracker rides the SAME injectable clock as
+        # every other timer and outlives reconfig-rebuilt components.
+        from .obs import NOP_RECORDER, ViewChangePhaseTracker
+
+        self.recorder = recorder if recorder is not None else NOP_RECORDER
+        self.vc_phases = ViewChangePhaseTracker(
+            clock=self.scheduler.now, node=f"n{config.self_id}",
+            recorder=self.recorder, metrics=self.metrics.view_change,
+        )
         self._own_scheduler = scheduler is None
         self._clock_driver: Optional[WallClockDriver] = None
         self.viewchanger_tick_interval = viewchanger_tick_interval
@@ -454,6 +467,8 @@ class Consensus:
             metrics_view_change=self.metrics.view_change,
             metrics_blacklist=self.metrics.blacklist,
             metrics_view=self.metrics.view,
+            vc_phases=self.vc_phases,
+            recorder=self.recorder,
         )
         self.collector = StateCollector(
             self_id=self.config.self_id,
@@ -490,6 +505,8 @@ class Consensus:
             view_sequences=view_sequences,
             metrics_view=self.metrics.view,
             metrics_consensus=self.metrics.consensus,
+            recorder=self.recorder,
+            vc_phases=self.vc_phases,
         )
         # ViewChanger wiring (consensus.go:445-450,466-470)
         self.view_changer.application = self.controller.deliver
@@ -543,6 +560,7 @@ class Consensus:
             ),
             self.scheduler,
             metrics=self.metrics.pool,
+            recorder=self.recorder,
         )
         self.controller.request_pool = self.pool
 

@@ -130,6 +130,8 @@ class Controller(RequestTimeoutHandler):
         view_sequences: ViewSequencesHolder,
         metrics_view: Optional[ViewMetrics] = None,
         metrics_consensus: Optional[ConsensusMetrics] = None,
+        recorder=None,
+        vc_phases=None,
     ):
         self.id = self_id
         self.n = n
@@ -159,6 +161,14 @@ class Controller(RequestTimeoutHandler):
         self.view_sequences = view_sequences
         self.metrics_view = metrics_view
         self.metrics_consensus = metrics_consensus
+        #: flight recorder (obs.TraceRecorder; the nop singleton when
+        #: tracing is off — every hot-path site guards on .enabled)
+        from ..obs.recorder import NOP_RECORDER
+
+        self.recorder = recorder if recorder is not None else NOP_RECORDER
+        #: obs.ViewChangePhaseTracker — the first delivery in a new view
+        #: closes an open view-change round's `first_commit` phase
+        self.vc_phases = vc_phases
 
         self.quorum = 0
         self.curr_view = None
@@ -379,6 +389,10 @@ class Controller(RequestTimeoutHandler):
         view = self.curr_view
         if view is None:
             return
+        rec = self.recorder
+        if rec.enabled:
+            rec.record("wave.ingest", view=self.curr_view_number,
+                       extra={"count": len(run)})
         ingest = getattr(view, "ingest_batch", None)
         if ingest is not None:
             ingest(run)
@@ -584,6 +598,12 @@ class Controller(RequestTimeoutHandler):
             return  # view changed while batching
         metadata = view.get_metadata()
         proposal = self.assembler.assemble_proposal(metadata, next_batch)
+        rec = self.recorder
+        if rec.enabled:
+            md = decode(ViewMetadata, metadata)
+            rec.record("batch.propose", view=md.view_id,
+                       seq=md.latest_sequence,
+                       extra={"count": len(next_batch)})
         view.propose(proposal)
         if window_has_room is not None:
             # pipelined mode: reserve the batch until delivery removes it —
@@ -673,6 +693,17 @@ class Controller(RequestTimeoutHandler):
             return
         self.curr_decisions_in_view += 1
         md = decode(ViewMetadata, d.proposal.metadata)
+        vp = self.vc_phases
+        if vp is not None and vp.open:
+            vp.decision(md.view_id)  # first commit closes an open VC round
+        rec = self.recorder
+        if rec.enabled:
+            rec.record("decision.deliver", view=md.view_id,
+                       seq=md.latest_sequence,
+                       extra={"count": len(d.requests)})
+            for info in d.requests:
+                rec.record("req.deliver", key=str(info), view=md.view_id,
+                           seq=md.latest_sequence)
         if self._check_if_rotate(list(md.black_list)):
             self.logger.debugf("Restarting view to rotate the leader")
             await self._change_view(

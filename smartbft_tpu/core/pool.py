@@ -151,6 +151,7 @@ class Pool:
         scheduler: Scheduler,
         metrics: Optional[RequestPoolMetrics] = None,
         on_submitted: Optional[Callable[[], None]] = None,
+        recorder=None,
     ):
         self._log = logger
         self._inspector = inspector
@@ -159,6 +160,11 @@ class Pool:
         self._scheduler = scheduler
         self._metrics = metrics
         self._on_submitted = on_submitted or (lambda: None)
+        # flight recorder (obs.TraceRecorder; nop singleton when tracing
+        # is off — submit's sites guard on .enabled, one attr read each)
+        from ..obs.recorder import NOP_RECORDER
+
+        self._recorder = recorder if recorder is not None else NOP_RECORDER
 
         self._items: "OrderedDict[RequestInfo, _Item]" = OrderedDict()
         self._size_bytes = 0
@@ -226,6 +232,10 @@ class Pool:
           loses a race re-parks at the HEAD, keeping its place).
         """
         info = self._inspector.request_id(request)
+        rec = self._recorder
+        if rec.enabled:
+            rec.record("req.submit", key=str(info),
+                       extra={"forwarded": forwarded} if forwarded else None)
         if self._closed:
             raise PoolClosedError(f"pool closed, request rejected: {info}")
         if len(request) > self._opts.request_max_bytes:
@@ -244,6 +254,9 @@ class Pool:
             self.shed_admission += 1
             if self._metrics:
                 self._metrics.count_of_failed_add_requests.with_labels("admission").add(1)
+            if rec.enabled:
+                rec.record("req.shed", key=str(info),
+                           extra={"kind": "admission"})
             raise AdmissionRejected(
                 f"admission control: pool at "
                 f"{len(self._items)}+{len(self._space_waiters)} of "
@@ -255,13 +268,20 @@ class Pool:
 
         deadline = self._scheduler.now() + self._opts.submit_timeout
         at_head = False
+        parked_at: Optional[float] = None
         while len(self._items) + self._reserved_slots >= self._opts.queue_size \
                 or (self._space_waiters and not at_head):
+            if parked_at is None:
+                parked_at = self._scheduler.now()
             remaining = deadline - self._scheduler.now()
             if remaining <= 0:
                 self.shed_timeout += 1
                 if self._metrics:
                     self._metrics.count_of_failed_add_requests.with_labels("semaphore").add(1)
+                if rec.enabled:
+                    rec.record("req.shed", key=str(info),
+                               dur=self._scheduler.now() - parked_at,
+                               extra={"kind": "timeout"})
                 raise SubmitTimeoutError(
                     f"timeout submitting to request pool: {info}"
                 )
@@ -284,6 +304,10 @@ class Pool:
                 self.shed_timeout += 1
                 if self._metrics:
                     self._metrics.count_of_failed_add_requests.with_labels("semaphore").add(1)
+                if rec.enabled:
+                    rec.record("req.shed", key=str(info),
+                               dur=self._scheduler.now() - parked_at,
+                               extra={"kind": "timeout"})
                 raise
             finally:
                 timer.cancel()
@@ -323,6 +347,12 @@ class Pool:
             timer = None
         self._items[info] = _Item(request, timer, self._scheduler.now())
         self._size_bytes += len(request)
+        if rec.enabled:
+            # dur = time spent parked on space (0 for an immediate add)
+            rec.record("req.pool", key=str(info),
+                       dur=(self._scheduler.now() - parked_at)
+                       if parked_at is not None else 0.0,
+                       extra={"size": len(self._items)})
         if self._metrics:
             self._metrics.count_of_requests.set(len(self._items))
         # the fairness rule parks fresh submitters behind existing waiters

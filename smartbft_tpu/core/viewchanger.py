@@ -320,6 +320,8 @@ class ViewChanger:
         metrics_view_change: Optional[ViewChangeMetrics] = None,
         metrics_blacklist: Optional[BlacklistMetrics] = None,
         metrics_view: Optional[ViewMetrics] = None,
+        vc_phases=None,
+        recorder=None,
     ):
         self.self_id = self_id
         self.n = n
@@ -341,6 +343,15 @@ class ViewChanger:
         self.metrics = metrics_view_change
         self.metrics_blacklist = metrics_blacklist
         self.metrics_view = metrics_view
+        #: optional obs.ViewChangePhaseTracker — marks the complain →
+        #: depose → ViewData → new-view pipeline's transition points so
+        #: the flight recorder can decompose failover time (ISSUE 12);
+        #: None = no decomposition (unit tests constructing a bare
+        #: ViewChanger pay nothing)
+        self.vc_phases = vc_phases
+        from ..obs.recorder import NOP_RECORDER
+
+        self.recorder = recorder if recorder is not None else NOP_RECORDER
 
         # wired later by the Consensus facade (consensus.go:445-450,466-470)
         self.comm = None  # Controller (broadcast + send)
@@ -558,6 +569,8 @@ class ViewChanger:
                     self._start_view_change(evt[1], evt[2])
                 elif kind == "tick":
                     self._last_tick = evt[1]
+                    if self.vc_phases is not None:
+                        self.vc_phases.note_tick()  # live in-VC gauge
                     self._check_if_resend_view_change(evt[1])
                     self._check_if_timeout(evt[1])
                 elif kind == "inform":
@@ -586,6 +599,8 @@ class ViewChanger:
             return
         if self._check_timeout:
             self.comm.broadcast_consensus(ViewChange(next_view=self.next_view))
+            if self.metrics:
+                self.metrics.count_complaints_sent.add(1)
             self.logger.debugf(
                 "Node %d resent a view change message with next view %d",
                 self.self_id, self.next_view,
@@ -604,6 +619,11 @@ class ViewChanger:
         )
         self._check_timeout = False
         self._back_off_factor += 1
+        if self.metrics:
+            self.metrics.count_sync_escalations.add(1)
+        rec = self.recorder
+        if rec.enabled:
+            rec.record("vc.timeout_sync", view=self.curr_view)
         self.synchronizer.sync()
         self.start_view_change(self.curr_view, False)
         return True
@@ -613,6 +633,8 @@ class ViewChanger:
     async def _process_msg(self, sender: int, m: Message) -> None:
         """viewchanger.go:272-326."""
         if isinstance(m, ViewChange):
+            if self.metrics:
+                self.metrics.count_complaints_received.add(1)
             self.nvs.register_next(m.next_view, sender)
             if m.next_view == self.curr_view + 1:
                 self.view_change_msgs.register_vote(sender, m)
@@ -626,6 +648,8 @@ class ViewChanger:
             ):
                 # help the lagging nodes
                 self.comm.broadcast_consensus(ViewChange(next_view=m.next_view))
+                if self.metrics:
+                    self.metrics.count_complaints_sent.add(1)
                 self.logger.warnf(
                     "Node %d got viewChange from %d with view %d, expected view %d, helping lagging nodes",
                     self.self_id, sender, m.next_view, self.curr_view + 1,
@@ -659,6 +683,9 @@ class ViewChanger:
         if view < self.curr_view:
             return
         self.logger.debugf("Node %d was informed of a new view %d", self.self_id, view)
+        if self.vc_phases is not None:
+            # a sync installed the view around the VC pipeline
+            self.vc_phases.abandoned_by_sync(view)
         self.curr_view = view
         self.real_view = view
         self.next_view = view
@@ -684,6 +711,9 @@ class ViewChanger:
         self.next_view = self.curr_view + 1
         if self.metrics:
             self.metrics.next_view.set(self.next_view)
+            self.metrics.count_complaints_sent.add(1)
+        if self.vc_phases is not None:
+            self.vc_phases.armed(self.next_view)
         self.requests_timer.stop_timers()
         self.comm.broadcast_consensus(ViewChange(next_view=self.next_view))
         self.logger.debugf(
@@ -715,6 +745,9 @@ class ViewChanger:
         self.curr_view = self.next_view
         if self.metrics:
             self.metrics.current_view.set(self.curr_view)
+        if self.vc_phases is not None:
+            # complaint quorum reached: this node committed to next view
+            self.vc_phases.joined(self.curr_view)
         self.view_change_msgs.clear()
         self.view_data_msgs.clear()
         msg = self._prepare_view_data_msg()
@@ -723,6 +756,8 @@ class ViewChanger:
             self.view_data_msgs.register_vote(self.self_id, msg)
         else:
             self.comm.send_consensus(leader, msg)
+        if self.vc_phases is not None:
+            self.vc_phases.viewdata_sent(self.curr_view)
         self.logger.debugf(
             "Node %d sent view data msg, with next view %d, to the new leader %d",
             self.self_id, self.curr_view, leader,
@@ -938,6 +973,9 @@ class ViewChanger:
         if not ok:
             self.logger.debugf("Node %d checked the in flight and it was invalid", self.self_id)
             return
+        if self.vc_phases is not None:
+            # new leader: quorum of ViewData validated, NewView going out
+            self.vc_phases.viewdata_quorum(self.curr_view)
         my_msg = self._prepare_view_data_msg()  # it might have changed by now
         signed_msgs = [my_msg]
         for vote in self.view_data_msgs.votes:
@@ -1117,6 +1155,9 @@ class ViewChanger:
         self.real_view = self.curr_view
         if self.metrics:
             self.metrics.real_view.set(self.real_view)
+        if self.vc_phases is not None:
+            # NewView validated + persisted; first_commit starts here
+            self.vc_phases.newview_done(self.curr_view)
         self.nvs.clear()
         self.controller.view_changed(self.curr_view, my_sequence + 1)
         self.requests_timer.restart_timers()

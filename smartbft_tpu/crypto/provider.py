@@ -762,6 +762,14 @@ class AsyncBatchCoalescer:
         #: is the ONE shared object in sharded mode — like the breaker)
         self.mesh_configured = 0   # Configuration.verify_mesh_devices wired
         self.mesh_downgrades = 0   # loud unbuildable-mesh downgrades
+        #: flight recorder (obs.TraceRecorder; nop singleton when tracing
+        #: is off) — verify enqueue/hold/launch spans + breaker
+        #: transitions, correlated by a per-coalescer launch id.  Shared
+        #: like the breaker: ONE recorder serves every colocated shard.
+        from ..obs.recorder import NOP_RECORDER
+
+        self.recorder = NOP_RECORDER
+        self._launch_seq = 0
         self._pending: list[tuple] = []
         self._futures: list[tuple[asyncio.Future, int, int, object]] = []
         self._flush_scheduled = False
@@ -797,6 +805,14 @@ class AsyncBatchCoalescer:
         if metrics is not None and self.metrics is None:
             self.metrics = metrics
             self.metrics.breaker_state.set(1.0 if self._breaker_is_open else 0.0)
+
+    def attach_recorder(self, recorder) -> None:
+        """Point the verify plane's trace events at ``recorder`` (the
+        harness/embedder wires this when tracing is on; the default nop
+        recorder keeps the hot path at one attribute read per site)."""
+        from ..obs.recorder import NOP_RECORDER
+
+        self.recorder = recorder if recorder is not None else NOP_RECORDER
 
     def configure_hold(self, hold: Optional[float],
                        explicit: bool = False) -> None:
@@ -879,6 +895,10 @@ class AsyncBatchCoalescer:
             return []
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
+        rec = self.recorder
+        if rec.enabled:
+            rec.record("verify.enqueue",
+                       extra={"items": len(items), "tag": str(tag)})
         self._tag_rates.note(tag, time.monotonic())
         async with self._lock:
             start = len(self._pending)
@@ -961,6 +981,10 @@ class AsyncBatchCoalescer:
                     and hasattr(self.metrics, "count_waves_held"):
                 self.metrics.count_waves_held.add(1)
                 self.metrics.count_hold_depth_gain.add(gain)
+            rec = self.recorder
+            if rec.enabled:
+                rec.record("verify.hold", dur=held_s,
+                           extra={"depth_gain": gain, "expired": expired})
 
     async def _flush_after(self, delay: float) -> None:
         if delay:
@@ -983,9 +1007,17 @@ class AsyncBatchCoalescer:
         # attribution happens when the wave's composition is fixed, so a
         # failed launch still counts its shard mix
         self.shard_stats.note_wave(futures)
+        self._launch_seq += 1
+        launch_id = self._launch_seq
+        rec = self.recorder
+        t_launch = time.monotonic() if rec.enabled else 0.0
         try:
             results = await self._launch_wave(pending)
         except Exception as exc:
+            if rec.enabled:
+                rec.record("verify.launch", launch=launch_id,
+                           dur=time.monotonic() - t_launch,
+                           extra={"items": len(pending), "failed": True})
             err = exc if isinstance(exc, VerifyPlaneDown) else RuntimeError(
                 f"batch verify failed: {exc!r}"
             )
@@ -994,6 +1026,10 @@ class AsyncBatchCoalescer:
                     fut.set_exception(err)
             await self._launch_done()
             return
+        if rec.enabled:
+            rec.record("verify.launch", launch=launch_id,
+                       dur=time.monotonic() - t_launch,
+                       extra={"items": len(pending)})
         for fut, start, count, _tag in futures:
             if not fut.done():
                 fut.set_result(results[start : start + count])
@@ -1164,6 +1200,9 @@ class AsyncBatchCoalescer:
         if self.metrics is not None:
             self.metrics.count_breaker_open.add(1)
             self.metrics.breaker_state.set(1.0)
+        if self.recorder.enabled:
+            self.recorder.record("ctl.breaker_open",
+                                 extra={"reason": reason})
         self._log.warning(
             "verify-plane circuit breaker OPEN (%s); %s",
             reason,
@@ -1184,6 +1223,8 @@ class AsyncBatchCoalescer:
         if self.metrics is not None:
             self.metrics.count_breaker_close.add(1)
             self.metrics.breaker_state.set(0.0)
+        if self.recorder.enabled:
+            self.recorder.record("ctl.breaker_close")
         self._log.warning(
             "verify-plane circuit breaker CLOSED: device engine recovered"
         )

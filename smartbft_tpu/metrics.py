@@ -462,12 +462,33 @@ class ViewMetrics:
 
 
 class ViewChangeMetrics:
-    """metrics.go:520-548."""
+    """metrics.go:520-548 — plus the VC-health instrumentation ISSUE 12
+    wires for real: complaint traffic, rounds, sync escalations, and a
+    live time-in-view-change gauge, fed from the ViewChanger (and its
+    phase tracker) so Prometheus/statsd providers see failover health
+    without the flight recorder enabled."""
 
     def __init__(self, p: Provider):
         self.current_view = _g(p, "viewchange", "current_view")
         self.next_view = _g(p, "viewchange", "next_view")
         self.real_view = _g(p, "viewchange", "real_view")
+        #: ViewChange messages this node broadcast (starts + resends +
+        #: lagging-node help)
+        self.count_complaints_sent = _c(
+            p, "viewchange", "count_complaints_sent")
+        #: ViewChange messages received from peers
+        self.count_complaints_received = _c(
+            p, "viewchange", "count_complaints_received")
+        #: view-change rounds armed on this node (a timeout escalation
+        #: toward a higher view is a new round)
+        self.count_view_change_rounds = _c(p, "viewchange", "count_rounds")
+        #: timeout escalations that forced a sync mid-view-change
+        self.count_sync_escalations = _c(
+            p, "viewchange", "count_sync_escalations")
+        #: seconds in the CURRENT view change (live, tick-updated) —
+        #: freezes at the end-to-end total when the round completes
+        self.time_in_view_change = _g(
+            p, "viewchange", "time_in_view_change_seconds")
 
 
 class TPUCryptoMetrics:
@@ -616,6 +637,24 @@ class LogScaleHistogram:
             if self.count else 0.0,
             "max_ms": round(self.max_seen * ms, 3),
         }
+
+    def merge_from(self, other: "LogScaleHistogram") -> None:
+        """Fold ``other``'s observations into this histogram EXACTLY —
+        same-geometry fixed buckets sum element-wise, so a merge over N
+        per-replica histograms is the true combined distribution (the
+        obs.assemble_trace_block roll-up), never a
+        percentile-of-percentiles."""
+        if (other.low != self.low or other.growth != self.growth
+                or len(other.buckets) != len(self.buckets)):
+            raise ValueError("cannot merge histograms of different geometry")
+        for i, n in enumerate(other.buckets):
+            self.buckets[i] += n
+        self.count += other.count
+        self.total += other.total
+        if other.max_seen > self.max_seen:
+            self.max_seen = other.max_seen
+        if other.min_seen < self.min_seen:
+            self.min_seen = other.min_seen
 
     def nonzero_buckets(self) -> dict:
         """Sparse bucket dump for the bench row's ``histogram`` block:

@@ -140,6 +140,8 @@ class SocketCluster:
         config_overrides: Optional[dict] = None,
         cluster_key: bytes = b"smartbft-cluster-key",
         env: Optional[dict] = None,
+        trace: bool = False,
+        trace_capacity: int = 2048,
     ):
         if transport not in ("uds", "tcp"):
             raise ValueError(f"transport must be 'uds' or 'tcp', got {transport!r}")
@@ -148,6 +150,11 @@ class SocketCluster:
         self.n = n
         self.transport = transport
         self.cluster_key = cluster_key
+        #: flight recorder armed per replica (ISSUE 12): each process
+        #: keeps a bounded TraceRecorder the parent can pull with
+        #: cmd=trace and dump as run artifacts on invariant failure
+        self.trace = trace
+        self.trace_capacity = trace_capacity
         self.env = dict(os.environ, JAX_PLATFORMS="cpu", **(env or {}))
         self._sockdir = (
             tempfile.mkdtemp(prefix="sbft-", dir="/tmp")
@@ -170,6 +177,8 @@ class SocketCluster:
                 "wal_dir": os.path.join(self.root, f"wal-{i}"),
                 "ledger_path": os.path.join(self.root, f"ledger-{i}.bin"),
                 "config": dict(config_overrides or {}),
+                "trace": bool(trace),
+                "trace_capacity": int(trace_capacity),
             }
             spec_path = os.path.join(self.root, f"spec-{i}.json")
             with open(spec_path, "w") as fh:
@@ -443,6 +452,51 @@ class SocketCluster:
         self.control(node_id).call(cmd="fault", action=action, peer=peer,
                                    delay=delay)
 
+    # ------------------------------------------------------------ observability
+
+    def trace_pull(self, node_id: int, last: Optional[int] = None) -> dict:
+        """Pull one replica's flight-recorder state over the control
+        channel: ``{"node", "trace": <summary block>, "events": [...]}``
+        — the per-replica timeline a SocketCluster run can fetch without
+        touching the consensus transport."""
+        req = {"cmd": "trace"}
+        if last is not None:
+            req["last"] = last
+        return self.control(node_id).call(**req)
+
+    def metrics_text(self, node_id: int) -> str:
+        """One replica's Prometheus text exposition (cmd=metrics)."""
+        return self.control(node_id).call(cmd="metrics")["text"]
+
+    def dump_flight_recorders(self, out_dir: Optional[str] = None,
+                              last: int = 2048) -> list[str]:
+        """Write each LIVE replica's last ``last`` spans to
+        ``out_dir`` (default: the cluster root) as ``flight-n<i>.json``
+        — the dump shape ``python -m smartbft_tpu.obs.report`` renders.
+        Replicas that are down or untraced are skipped; returns the
+        written paths."""
+        if not self.trace:
+            return []
+        out_dir = out_dir or self.root
+        os.makedirs(out_dir, exist_ok=True)
+        paths = []
+        for i in self.live_ids():
+            try:
+                resp = self.trace_pull(i, last=last)
+            except (OSError, ControlError):
+                continue
+            path = os.path.join(out_dir, f"flight-n{i}.json")
+            with open(path, "w") as fh:
+                json.dump({
+                    "node": resp.get("node", f"n{i}"),
+                    "capacity": resp.get("trace", {}).get("capacity", 0),
+                    "recorded": resp.get("trace", {}).get("recorded", 0),
+                    "dropped": resp.get("dropped", 0),
+                    "events": resp.get("events", []),
+                }, fh)
+            paths.append(path)
+        return paths
+
 
 def _free_port() -> int:
     s = socket.socket()
@@ -585,39 +639,53 @@ def run_socket_schedule(
     # client contract prescribes on request timeout)
     expected = {f"chaos:chaos-{k}" for k in range(submitted)}
     deadline = time.monotonic() + settle_timeout
-    while True:
-        cluster.wait_quiescent(
-            timeout=max(deadline - time.monotonic(), 1.0),
-            nodes=[i for i in cluster.live_ids() if i not in faulted],
-        )
-        probe = [i for i in cluster.live_ids() if i not in faulted][0]
-        missing = sorted(expected - set(cluster.committed_ids(probe)))
-        if not missing:
-            break
-        if time.monotonic() > deadline:
-            raise TimeoutError(
-                f"requests never committed after resubmission: {missing}"
-            )
-        healthy = [i for i in cluster.live_ids() if i not in faulted]
-        for j, rid in enumerate(missing):
-            cluster.submit(healthy[j % len(healthy)], "chaos",
-                           rid.split(":", 1)[1])
-        time.sleep(0.5)
-    cluster.wait_committed(submitted, timeout=settle_timeout,
-                           nodes=[i for i in cluster.live_ids()
-                                  if i not in faulted])
-    # stragglers that healed late (e.g. a restarted replica) get a
-    # bounded grace window to catch up before the invariant checks
     try:
-        cluster.wait_committed(submitted, timeout=settle_timeout / 2)
-    except TimeoutError:
-        pass
-    cluster.check_fork_free()
+        while True:
+            cluster.wait_quiescent(
+                timeout=max(deadline - time.monotonic(), 1.0),
+                nodes=[i for i in cluster.live_ids() if i not in faulted],
+            )
+            probe = [i for i in cluster.live_ids() if i not in faulted][0]
+            missing = sorted(expected - set(cluster.committed_ids(probe)))
+            if not missing:
+                break
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"requests never committed after resubmission: {missing}"
+                )
+            healthy = [i for i in cluster.live_ids() if i not in faulted]
+            for j, rid in enumerate(missing):
+                cluster.submit(healthy[j % len(healthy)], "chaos",
+                               rid.split(":", 1)[1])
+            time.sleep(0.5)
+        cluster.wait_committed(submitted, timeout=settle_timeout,
+                               nodes=[i for i in cluster.live_ids()
+                                      if i not in faulted])
+        # stragglers that healed late (e.g. a restarted replica) get a
+        # bounded grace window to catch up before the invariant checks
+        try:
+            cluster.wait_committed(submitted, timeout=settle_timeout / 2)
+        except TimeoutError:
+            pass
+        cluster.check_fork_free()
+        live = cluster.live_ids()
+        # exactly-once: resubmission must never double-deliver
+        ids = cluster.committed_ids(live[0])
+        dupes = {i for i in ids if ids.count(i) > 1}
+        assert not dupes, \
+            f"duplicate deliveries after resubmission: {sorted(dupes)}"
+    except (AssertionError, TimeoutError):
+        # invariant failure: preserve each replica's flight recorder as a
+        # run artifact (no-op unless the cluster was built with trace=True)
+        try:
+            paths = cluster.dump_flight_recorders()
+            if paths:
+                print(f"flight-recorder dumps written: {paths}",
+                      file=sys.stderr)
+        except Exception:  # noqa: BLE001 — never mask the real failure
+            pass
+        raise
     live = cluster.live_ids()
-    # exactly-once: resubmission must never double-deliver
-    ids = cluster.committed_ids(live[0])
-    dupes = {i for i in ids if ids.count(i) > 1}
-    assert not dupes, f"duplicate deliveries after resubmission: {sorted(dupes)}"
     report.final_committed = cluster.committed(live[0]) if live else 0
     report.heights = cluster.heights()
     return report

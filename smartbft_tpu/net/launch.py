@@ -173,6 +173,24 @@ class ReplicaApp(Application, Assembler, Comm, Signer, Verifier,
             logger=self.logger,
         )
         self.transport.sync_server = self._serve_sync
+        # per-replica pull-based observability (ISSUE 12): a Prometheus
+        # text-exposition provider ALWAYS (counters are cheap and the
+        # control channel's cmd=metrics needs something to read), the
+        # flight recorder only when the spec asks (cmd=trace then serves
+        # the per-replica timeline to SocketCluster / operators)
+        from ..metrics import MetricsBundle, PrometheusProvider
+        from ..obs import NOP_RECORDER, TraceRecorder
+
+        self.metrics_provider = PrometheusProvider()
+        self.metrics = MetricsBundle(self.metrics_provider)
+        if spec.get("trace"):
+            self.recorder = TraceRecorder(
+                node=f"n{self.id}",
+                capacity=int(spec.get("trace_capacity", 2048)),
+            )
+        else:
+            self.recorder = NOP_RECORDER
+        self.transport.recorder = self.recorder
         self.ledger_file = LedgerFile(spec["ledger_path"])
         self.lock = threading.Lock()
         self.ledger: list[Decision] = []
@@ -402,8 +420,10 @@ class ReplicaApp(Application, Assembler, Comm, Signer, Verifier,
             last_proposal=last_proposal,
             last_signatures=last_sigs,
             scheduler=None,  # own wall-clock driver: this is production mode
+            metrics=self.metrics,
             viewchanger_tick_interval=0.1,
             heartbeat_tick_interval=0.1,
+            recorder=self.recorder,
         )
         self.transport.attach(self.consensus)
         await self.transport.start()
@@ -625,6 +645,24 @@ class ControlServer:
             return {"ok": True, "transport": r.transport.transport_snapshot(),
                     "height": r.height(),
                     "committed": r.committed_requests()}
+        if cmd == "metrics":
+            # Prometheus text exposition over the control channel: the
+            # per-replica counters finally have a reader in multi-process
+            # deployments (mount behind an HTTP handler in production)
+            return {"ok": True, "text": r.metrics_provider.expose()}
+        if cmd == "trace":
+            # per-replica flight-recorder pull: summary block + the last
+            # N events (all buffered events when "last" is omitted)
+            last = req.get("last")
+            return {
+                "ok": True,
+                "node": f"n{r.id}",
+                "trace": r.recorder.trace_block(),
+                "dropped": r.recorder.dropped,
+                "events": r.recorder.snapshot(
+                    last=int(last) if last is not None else None
+                ),
+            }
         if cmd == "fault":
             return self._fault(req)
         if cmd == "stop":

@@ -175,6 +175,11 @@ class SocketComm(Comm):
         self.logger = logger or StdLogger(f"smartbft.net.{self_id}")
         self.plane = PROTOCOL_PLANE if plane is None else plane
         self.metrics = TransportMetrics()
+        # flight recorder for control-plane transitions (reconnects);
+        # the embedder swaps in a real obs.TraceRecorder when tracing
+        from ..obs.recorder import NOP_RECORDER
+
+        self.recorder = NOP_RECORDER
         self.consensus = None
         #: multi-process sync server hook: (from_height) -> (decisions,
         #: total_height) with decisions a list[framing.WireDecision]
@@ -386,6 +391,9 @@ class SocketComm(Comm):
             self.metrics.connects += 1
             if not first:
                 self.metrics.reconnects += 1
+                if self.recorder.enabled:
+                    self.recorder.record("ctl.reconnect",
+                                         extra={"peer": peer.id})
             first = False
             backoff = self.backoff_base
             peer.connected = True

@@ -1,0 +1,35 @@
+"""Flight recorder: request-scoped protocol tracing + VC decomposition.
+
+The observability plane ISSUE 12 builds: a bounded-memory
+:class:`~smartbft_tpu.obs.recorder.TraceRecorder` of structured span
+events (injectable clock, nop when disabled — the ``DisabledProvider``
+pattern, so the hot path pays one attribute check when tracing is off),
+a :class:`~smartbft_tpu.obs.vcphases.ViewChangePhaseTracker` that
+decomposes the complain → depose → ViewData → new-view → first-commit
+pipeline into measured sub-phases, and the pure ``assemble_*`` helpers
+that fold either into bench-row JSON blocks.  ``python -m
+smartbft_tpu.obs.report`` renders a recorder dump as a text timeline +
+per-span-type percentile summary.
+"""
+
+from .recorder import (  # noqa: F401
+    NOP_RECORDER,
+    NopRecorder,
+    SpanEvent,
+    TraceRecorder,
+    assemble_trace_block,
+)
+from .vcphases import (  # noqa: F401
+    ViewChangePhaseTracker,
+    assemble_viewchange_block,
+)
+
+__all__ = [
+    "NOP_RECORDER",
+    "NopRecorder",
+    "SpanEvent",
+    "TraceRecorder",
+    "assemble_trace_block",
+    "ViewChangePhaseTracker",
+    "assemble_viewchange_block",
+]

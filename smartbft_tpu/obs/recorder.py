@@ -1,0 +1,253 @@
+"""Bounded-memory flight recorder for request-scoped protocol tracing.
+
+A :class:`TraceRecorder` is a fixed ring buffer of :class:`SpanEvent`
+records — submit, park, pool, propose, ingest wave, verify launch,
+deliver, view-change sub-phase marks, control-plane transitions —
+correlated by request key (``"client:rid"``), (view, seq), reshard
+epoch, and verify-launch id.  The memory contract is the whole point:
+
+* the ring never exceeds ``capacity`` events (the oldest is overwritten
+  and counted in ``dropped``);
+* per-kind duration statistics live in fixed-array
+  :class:`~smartbft_tpu.metrics.LogScaleHistogram` buckets, capped at
+  ``span_kinds_cap`` distinct kinds (overflow folds into ``"_other"``);
+* the clock is injectable (``Scheduler.now`` in logical tests, wall
+  ``time.monotonic`` in benches) — the same idiom as
+  ``CommitLatencyTracker``.
+
+When tracing is off, components hold :data:`NOP_RECORDER` (the
+``DisabledProvider`` pattern): every instrumentation site guards with
+``if rec.enabled:`` so a disabled recorder costs one attribute read per
+site and allocates nothing.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Optional, Sequence
+
+from ..metrics import LogScaleHistogram
+
+__all__ = [
+    "SpanEvent",
+    "TraceRecorder",
+    "NopRecorder",
+    "NOP_RECORDER",
+    "assemble_trace_block",
+]
+
+
+class SpanEvent:
+    """One structured trace event.  ``dur`` >= 0 marks a completed span
+    (seconds); -1 marks a point event.  Unset correlators stay at their
+    sentinel (-1 / "") and are omitted from the dict form."""
+
+    __slots__ = ("t", "kind", "node", "key", "view", "seq", "epoch",
+                 "launch", "dur", "extra")
+
+    def __init__(self, t: float, kind: str, node: str = "", key: str = "",
+                 view: int = -1, seq: int = -1, epoch: int = -1,
+                 launch: int = -1, dur: float = -1.0,
+                 extra: Optional[dict] = None):
+        self.t = t
+        self.kind = kind
+        self.node = node
+        self.key = key
+        self.view = view
+        self.seq = seq
+        self.epoch = epoch
+        self.launch = launch
+        self.dur = dur
+        self.extra = extra
+
+    def as_dict(self) -> dict:
+        out = {"t": round(self.t, 6), "kind": self.kind}
+        if self.node:
+            out["node"] = self.node
+        if self.key:
+            out["key"] = self.key
+        if self.view >= 0:
+            out["view"] = self.view
+        if self.seq >= 0:
+            out["seq"] = self.seq
+        if self.epoch >= 0:
+            out["epoch"] = self.epoch
+        if self.launch >= 0:
+            out["launch"] = self.launch
+        if self.dur >= 0:
+            out["dur_ms"] = round(self.dur * 1e3, 3)
+        if self.extra:
+            out["extra"] = self.extra
+        return out
+
+
+class TraceRecorder:
+    """Ring buffer of :class:`SpanEvent` with bounded per-kind stats."""
+
+    enabled = True
+
+    def __init__(self, *, clock=None, node: str = "", capacity: int = 4096,
+                 span_kinds_cap: int = 64):
+        self._clock = clock if clock is not None else time.monotonic
+        self.node = node
+        self.capacity = max(int(capacity), 1)
+        self.span_kinds_cap = max(int(span_kinds_cap), 1)
+        self._buf: list = [None] * self.capacity
+        self._idx = 0
+        self.recorded = 0
+        #: all-time per-kind event counts (bounded like the span dict)
+        self.kind_counts: dict[str, int] = {}
+        #: per-kind duration histograms for events carrying ``dur``
+        self.spans: dict[str, LogScaleHistogram] = {}
+
+    @property
+    def dropped(self) -> int:
+        """Events overwritten by the ring bound (recorded beyond cap)."""
+        return max(0, self.recorded - self.capacity)
+
+    def _bounded_kind(self, store: dict, kind: str) -> str:
+        if kind in store or len(store) < self.span_kinds_cap:
+            return kind
+        return "_other"
+
+    def record(self, kind: str, *, node: str = "", key: str = "",
+               view: int = -1, seq: int = -1, epoch: int = -1,
+               launch: int = -1, dur: float = -1.0,
+               extra: Optional[dict] = None) -> SpanEvent:
+        ev = SpanEvent(self._clock(), kind, node or self.node, key, view,
+                       seq, epoch, launch, dur, extra)
+        self._buf[self._idx] = ev
+        self._idx = (self._idx + 1) % self.capacity
+        self.recorded += 1
+        ck = self._bounded_kind(self.kind_counts, kind)
+        self.kind_counts[ck] = self.kind_counts.get(ck, 0) + 1
+        if dur >= 0.0:
+            sk = self._bounded_kind(self.spans, kind)
+            hist = self.spans.get(sk)
+            if hist is None:
+                hist = self.spans[sk] = LogScaleHistogram()
+            hist.observe(dur)
+        return ev
+
+    # -- reading -----------------------------------------------------------
+
+    def events(self, last: Optional[int] = None) -> list:
+        """The buffered events in chronological (record) order, optionally
+        only the newest ``last``."""
+        if self.recorded >= self.capacity:
+            ordered = self._buf[self._idx:] + self._buf[:self._idx]
+        else:
+            ordered = self._buf[:self._idx]
+        out = [e for e in ordered if e is not None]
+        if last is not None and last >= 0:
+            out = out[-last:] if last else []
+        return out
+
+    def snapshot(self, last: Optional[int] = None) -> list[dict]:
+        return [e.as_dict() for e in self.events(last)]
+
+    def trace_block(self) -> dict:
+        """The JSON-able ``trace`` summary block (bench rows, cmd=trace)."""
+        return {
+            "enabled": True,
+            "node": self.node,
+            "capacity": self.capacity,
+            "recorded": self.recorded,
+            "dropped": self.dropped,
+            "kinds": dict(sorted(self.kind_counts.items())),
+            "spans": {k: h.snapshot()
+                      for k, h in sorted(self.spans.items())},
+        }
+
+    def dump(self) -> dict:
+        """The full JSON-able dump (events + summary) the chaos runner
+        writes per replica and ``python -m smartbft_tpu.obs.report``
+        renders."""
+        return {
+            "node": self.node,
+            "capacity": self.capacity,
+            "recorded": self.recorded,
+            "dropped": self.dropped,
+            "events": self.snapshot(),
+        }
+
+    def dump_to(self, path: str) -> str:
+        with open(path, "w") as fh:
+            json.dump(self.dump(), fh)
+        return path
+
+
+class NopRecorder:
+    """The disabled recorder: every site's ``if rec.enabled:`` guard is
+    False, so tracing off costs one attribute read per instrumentation
+    point and allocates nothing (the ``DisabledProvider`` pattern)."""
+
+    enabled = False
+    node = ""
+    capacity = 0
+    recorded = 0
+    dropped = 0
+
+    def record(self, kind: str, **_kw) -> None:
+        return None
+
+    def events(self, last: Optional[int] = None) -> list:
+        return []
+
+    def snapshot(self, last: Optional[int] = None) -> list:
+        return []
+
+    def trace_block(self) -> dict:
+        return {"enabled": False}
+
+    def dump(self) -> dict:
+        return {"node": "", "capacity": 0, "recorded": 0, "dropped": 0,
+                "events": []}
+
+    def dump_to(self, path: str) -> str:
+        with open(path, "w") as fh:
+            json.dump(self.dump(), fh)
+        return path
+
+
+#: the process-wide disabled singleton components default to
+NOP_RECORDER = NopRecorder()
+
+
+def pct(sorted_vals: Sequence[float], q: float) -> float:
+    """The q-quantile (0..1) of an ALREADY-SORTED value list by index —
+    the one exact-percentile helper the obs modules share (vcphases'
+    pooled VC records, report's span summaries)."""
+    if not sorted_vals:
+        return 0.0
+    return sorted_vals[min(len(sorted_vals) - 1, int(q * len(sorted_vals)))]
+
+
+def assemble_trace_block(recorders: Sequence) -> dict:
+    """Fold N recorders (one per replica + shared-plane recorders) into
+    the ONE ``trace`` block a bench row carries.  Pure function — the
+    PR 8 ``assemble_*`` idiom, schema-pinned by tests/test_obs.py.
+
+    Per-kind duration percentiles are EXACT merges: the per-recorder
+    LogScaleHistograms share one geometry, so bucket-wise summation is
+    the true combined distribution (not a percentile-of-percentiles)."""
+    live = [r for r in recorders if getattr(r, "enabled", False)]
+    kinds: dict[str, int] = {}
+    spans: dict[str, LogScaleHistogram] = {}
+    for r in live:
+        for k, n in r.kind_counts.items():
+            kinds[k] = kinds.get(k, 0) + n
+        for k, h in r.spans.items():
+            agg = spans.get(k)
+            if agg is None:
+                agg = spans[k] = LogScaleHistogram()
+            agg.merge_from(h)
+    return {
+        "enabled": bool(live),
+        "recorders": len(live),
+        "recorded": sum(r.recorded for r in live),
+        "dropped": sum(r.dropped for r in live),
+        "kinds": dict(sorted(kinds.items())),
+        "spans": {k: h.snapshot() for k, h in sorted(spans.items())},
+    }

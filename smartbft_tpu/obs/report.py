@@ -1,0 +1,138 @@
+"""Render flight-recorder dumps: ``python -m smartbft_tpu.obs.report``.
+
+Input: one or more JSON dump files (``TraceRecorder.dump_to``, the chaos
+runner's per-replica ``flight-*.json`` artifacts, or a ``cmd=trace``
+control-channel response saved to disk).  Output: a merged text timeline
+(events from every replica interleaved by timestamp, offsets relative to
+the earliest event) followed by a per-span-type percentile summary over
+the events that carry durations, plus derived submit→deliver spans
+joined by request key when both ends are present.
+
+Usage::
+
+    python -m smartbft_tpu.obs.report run/flight-*.json [--last N]
+    python -m smartbft_tpu.obs.report dump.json --summary-only
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from typing import Optional
+
+from .recorder import pct as _pct
+
+__all__ = ["load_dump", "render", "main"]
+
+
+def load_dump(path: str) -> dict:
+    """Load one dump file; accepts both the recorder's native dump shape
+    and a saved ``cmd=trace`` control response (events under "events")."""
+    with open(path) as fh:
+        data = json.load(fh)
+    if "events" not in data:
+        raise ValueError(f"{path}: not a flight-recorder dump (no 'events')")
+    return data
+
+
+def _fmt_event(ev: dict, t0: float) -> str:
+    parts = [f"+{ev.get('t', 0.0) - t0:10.4f}s",
+             f"[{ev.get('node', '?'):>6}]",
+             f"{ev.get('kind', '?'):<22}"]
+    for field, tag in (("key", ""), ("view", "v"), ("seq", "s"),
+                       ("epoch", "e"), ("launch", "L")):
+        if field in ev:
+            parts.append(f"{tag}{ev[field]}")
+    if "dur_ms" in ev:
+        parts.append(f"{ev['dur_ms']:.3f}ms")
+    if ev.get("extra"):
+        parts.append(json.dumps(ev["extra"], sort_keys=True))
+    return " ".join(parts)
+
+
+def _summary_rows(events: list[dict]) -> list[tuple]:
+    """(kind, count, p50, p95, p99, max) over events carrying dur_ms,
+    plus derived ``req.submit->deliver`` spans joined by request key."""
+    by_kind: dict[str, list] = {}
+    for ev in events:
+        if "dur_ms" in ev:
+            by_kind.setdefault(ev["kind"], []).append(ev["dur_ms"])
+    # derived submit→deliver per (node, key): first submit-ish stamp to
+    # first deliver stamp — the request's protocol-pipeline span
+    first_seen: dict[tuple, float] = {}
+    derived: list = []
+    for ev in events:
+        key = ev.get("key")
+        if not key:
+            continue
+        ident = (ev.get("node", ""), key)
+        if ev["kind"] in ("req.submit", "req.pool") \
+                and ident not in first_seen:
+            first_seen[ident] = ev["t"]
+        elif ev["kind"] == "req.deliver" and ident in first_seen:
+            derived.append((ev["t"] - first_seen.pop(ident)) * 1e3)
+    if derived:
+        by_kind["req.submit->deliver"] = derived
+    rows = []
+    for kind in sorted(by_kind):
+        vals = sorted(by_kind[kind])
+        rows.append((kind, len(vals), _pct(vals, 0.50), _pct(vals, 0.95),
+                     _pct(vals, 0.99), vals[-1]))
+    return rows
+
+
+def render(dumps: list[dict], *, last: Optional[int] = None,
+           summary_only: bool = False) -> str:
+    """Merged text timeline + per-span-type percentile summary."""
+    events: list[dict] = []
+    for d in dumps:
+        node = d.get("node", "")
+        for ev in d.get("events", []):
+            if node and "node" not in ev:
+                ev = dict(ev, node=node)
+            events.append(ev)
+    events.sort(key=lambda e: e.get("t", 0.0))
+    if last is not None and last >= 0:
+        events = events[-last:] if last else []
+    out: list[str] = []
+    header = (f"flight recorder: {len(dumps)} dump(s), "
+              f"{len(events)} event(s)"
+              + (f", dropped {sum(d.get('dropped', 0) for d in dumps)}"
+                 if any(d.get("dropped") for d in dumps) else ""))
+    out.append(header)
+    if events and not summary_only:
+        t0 = events[0].get("t", 0.0)
+        out.append("")
+        out.append("timeline:")
+        out.extend("  " + _fmt_event(ev, t0) for ev in events)
+    rows = _summary_rows(events)
+    if rows:
+        out.append("")
+        out.append("span summary (ms):")
+        out.append(f"  {'kind':<24} {'count':>6} {'p50':>10} {'p95':>10} "
+                   f"{'p99':>10} {'max':>10}")
+        for kind, n, p50, p95, p99, mx in rows:
+            out.append(f"  {kind:<24} {n:>6} {p50:>10.3f} {p95:>10.3f} "
+                       f"{p99:>10.3f} {mx:>10.3f}")
+    return "\n".join(out) + "\n"
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Render SmartBFT flight-recorder dumps as a text "
+                    "timeline + per-span-type percentile summary"
+    )
+    ap.add_argument("dumps", nargs="+", help="flight-recorder JSON dump(s)")
+    ap.add_argument("--last", type=int, default=None,
+                    help="only the newest N merged events")
+    ap.add_argument("--summary-only", action="store_true",
+                    help="skip the timeline, print only the span summary")
+    args = ap.parse_args(argv)
+    dumps = [load_dump(p) for p in args.dumps]
+    print(render(dumps, last=args.last, summary_only=args.summary_only),
+          end="")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

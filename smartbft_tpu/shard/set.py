@@ -185,7 +185,8 @@ class ShardSet:
                  coalescer=None, *, journal: Optional[EpochJournal] = None,
                  drain_deadline: float = 30.0, retention: int = 4096,
                  on_deliver: Optional[Callable] = None,
-                 clock: Optional[Callable[[], float]] = None):
+                 clock: Optional[Callable[[], float]] = None,
+                 recorder=None):
         """``shards``: shard handles, one per group; their ``shard_id``
         must be 0..S-1 (the router's bucket space).  ``coalescer``: the
         SHARED AsyncBatchCoalescer all shards verify through — optional,
@@ -229,6 +230,11 @@ class ShardSet:
         #: behavior"): ``submit(..., request_key=...)`` stamps arrivals,
         #: ``poll_committed`` resolves them against the combined stream
         self.latency = CommitLatencyTracker(clock=clock)
+        #: flight recorder for control-plane transitions (reshard epochs);
+        #: the nop singleton when tracing is off (obs.recorder contract)
+        from ..obs.recorder import NOP_RECORDER
+
+        self.recorder = recorder if recorder is not None else NOP_RECORDER
         self._epoch = self.router.epoch
         self._next_epoch = self._epoch + 1
         self._transition: Optional[_Transition] = None
@@ -562,6 +568,9 @@ class ShardSet:
         deadline = time.monotonic() + (drain_deadline or self.drain_deadline)
         self._journal({"t": "prepare", "epoch": epoch,
                        "old": s_old, "new": s_new})
+        if self.recorder.enabled:
+            self.recorder.record("ctl.reshard_prepare", epoch=epoch,
+                                 extra={"old": s_old, "new": s_new})
         tr = _Transition(epoch=epoch, old_s=s_old, new_s=s_new,
                          deadline=deadline)
         self._transition = tr
@@ -607,6 +616,13 @@ class ShardSet:
                 self.retired[sid] = h
                 stopping.append(h)
             self._epoch = epoch
+            if self.recorder.enabled:
+                self.recorder.record(
+                    "ctl.reshard_flip", epoch=epoch,
+                    dur=time.monotonic() - tr.started,
+                    extra={"old": s_old, "new": s_new,
+                           "drain_ms": round(tr.drain_ms, 2)},
+                )
             tr.flip_event.set()
             try:
                 self._journal({"t": "done", "epoch": epoch})
@@ -650,6 +666,9 @@ class ShardSet:
                 # neither journal an abort nor un-flip live state
                 raise
             tr.failed = f"{type(exc).__name__}: {exc}"
+            if self.recorder.enabled:
+                self.recorder.record("ctl.reshard_abort", epoch=epoch,
+                                     extra={"reason": tr.failed})
             try:
                 self._journal({"t": "abort", "epoch": epoch,
                                "reason": tr.failed})

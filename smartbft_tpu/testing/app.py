@@ -179,6 +179,7 @@ class App(Application, Assembler, Comm, Signer, Verifier, RequestInspector,
         crypto=None,
         wal_file_size_bytes: Optional[int] = None,
         comm=None,
+        recorder=None,
     ):
         self.id = node_id
         self.network = network
@@ -213,6 +214,9 @@ class App(Application, Assembler, Comm, Signer, Verifier, RequestInspector,
             self.node.consensus = self
         shared.register(node_id)
         self.metrics = MetricsBundle(InMemoryProvider()) if use_metrics else None
+        #: flight recorder handed to this node's Consensus (None = nop):
+        #: the chaos/sharded harnesses wire one per replica when tracing
+        self.recorder = recorder
         self.clock = scheduler
         # optional real-crypto provider (smartbft_tpu.crypto.provider.
         # P256CryptoProvider); when set, Signer/Verifier crypto methods
@@ -453,6 +457,7 @@ class App(Application, Assembler, Comm, Signer, Verifier, RequestInspector,
             metrics=self.metrics,
             viewchanger_tick_interval=0.2,
             heartbeat_tick_interval=0.2,
+            recorder=self.recorder,
         )
         if self.comm is not None:
             # real transport: point ingest at the fresh Consensus and open

@@ -260,6 +260,8 @@ class ChaosCluster:
         seed: int = 101,
         config_fn: Optional[Callable[[int], Configuration]] = None,
         engine_faults: bool = False,
+        trace: bool = False,
+        trace_capacity: int = 4096,
     ):
         self.wal_root = str(wal_root)
         self.n = n
@@ -321,10 +323,29 @@ class ChaosCluster:
                     verify_breaker_threshold=3, verify_probe_interval=0.05,
                 )
         cfg = config_fn or (lambda i: chaos_config(i, depth=depth, rotation=rotation))
+        #: per-replica flight recorders (ISSUE 12): armed with trace=True,
+        #: dumped to the run dir on any invariant failure so a failed soak
+        #: leaves a timeline, not just an assertion message
+        self.trace = trace
+        self.recorders: dict[int, object] = {}
+        if trace:
+            from ..obs import TraceRecorder
+
+            self.recorders = {
+                i: TraceRecorder(clock=self.scheduler.now, node=f"n{i}",
+                                 capacity=trace_capacity)
+                for i in range(1, n + 1)
+            }
+            if self.coalescer is not None:
+                self.recorders[0] = TraceRecorder(
+                    clock=self.scheduler.now, node="verify",
+                    capacity=trace_capacity,
+                )
+                self.coalescer.attach_recorder(self.recorders[0])
         self.apps = [
             App(i, self.network, self.shared, self.scheduler,
                 wal_dir=f"{self.wal_root}/wal-{i}", config=cfg(i),
-                crypto=crypto_fn(i))
+                crypto=crypto_fn(i), recorder=self.recorders.get(i))
             for i in range(1, n + 1)
         ]
         self.down: set[int] = set()
@@ -508,6 +529,35 @@ class ChaosCluster:
                 self.latency.on_committed(str(info), 0)
         self._latency_scan_pos = len(ledger)
 
+    def _dump_on_failure(self) -> None:
+        """Best-effort artifact dump on an invariant/liveness failure —
+        must never mask the failure it documents."""
+        try:
+            paths = self.dump_flight_recorders()
+            if paths:
+                print(f"flight-recorder dumps written: {paths}")
+        except Exception:  # noqa: BLE001
+            pass
+
+    def dump_flight_recorders(self, out_dir: Optional[str] = None) -> list:
+        """Write each replica's last spans to ``out_dir`` (default: the
+        SIBLING dir ``<wal_root>-flight`` — soaks run under a
+        TemporaryDirectory whose cleanup would delete an in-tree dump
+        while the failure propagates) as ``flight-<node>.json`` — the
+        dump shape ``python -m smartbft_tpu.obs.report`` renders.
+        No-op (returns []) unless the cluster was built with
+        ``trace=True``."""
+        if not self.recorders:
+            return []
+        import os
+
+        out_dir = out_dir or (self.wal_root.rstrip("/") + "-flight")
+        os.makedirs(out_dir, exist_ok=True)
+        return [
+            rec.dump_to(os.path.join(out_dir, f"flight-{rec.node}.json"))
+            for rec in self.recorders.values()
+        ]
+
     def _corruptor(self, fraction: float):
         """Per-target message corruption.
 
@@ -674,12 +724,14 @@ class ChaosCluster:
                 break
             if deadline is not None and now > deadline:
                 live = self.live_apps()
+                self._dump_on_failure()  # liveness timeout: keep the trace
                 raise TimeoutError(
                     f"chaos run did not drain within {settle_timeout}s of the "
                     f"last event: committed="
                     f"{[self.committed(a) for a in live]} of {requests}"
                 )
             if now > 3600.0:
+                self._dump_on_failure()
                 raise TimeoutError("chaos run exceeded the hard 1h logical cap")
             # 5. advance logical time in lockstep with the loop
             await asyncio.sleep(0)
@@ -809,6 +861,24 @@ class Invariants:
         cls.liveness_within_windows(cluster, report, slack_windows)
 
 
+def check_with_flight_dump(cluster: ChaosCluster, check: Callable[[], None],
+                           out_dir: Optional[str] = None) -> None:
+    """Run an invariant ``check``; on failure (AssertionError or
+    TimeoutError) dump every replica's flight recorder to the run dir
+    first, then re-raise — a failed soak leaves a timeline the
+    ``obs.report`` tool can render, not just an assertion message."""
+    try:
+        check()
+    except (AssertionError, TimeoutError):
+        try:
+            paths = cluster.dump_flight_recorders(out_dir)
+            if paths:
+                print(f"flight-recorder dumps written: {paths}")
+        except Exception:  # noqa: BLE001 — never mask the real failure
+            pass
+        raise
+
+
 # ---------------------------------------------------------------------- soak
 
 def random_schedule(
@@ -879,7 +949,7 @@ async def soak(
         with tempfile.TemporaryDirectory(prefix="chaos-soak-") as wal_root:
             cluster = ChaosCluster(
                 wal_root, n=n, depth=depth, rotation=rotation, seed=seed + r,
-                engine_faults=engine_faults,
+                engine_faults=engine_faults, trace=True,
             )
             schedule = random_schedule(rng, n, engine_faults=engine_faults)
             await cluster.start()
@@ -887,9 +957,19 @@ async def soak(
                 report = await cluster.run_schedule(
                     schedule, requests=requests, settle_timeout=600.0
                 )
-                Invariants.fork_free(cluster)
-                Invariants.exactly_once(cluster, expected=requests)
-                Invariants.liveness_within_windows(cluster, report, slack_windows=8)
+
+                def checks() -> None:
+                    Invariants.fork_free(cluster)
+                    Invariants.exactly_once(cluster, expected=requests)
+                    Invariants.liveness_within_windows(
+                        cluster, report, slack_windows=8
+                    )
+
+                # invariant failures leave per-replica flight-recorder
+                # dumps in a SIBLING dir (rendered by obs.report) — the
+                # temp run dir itself is deleted on the way out
+                check_with_flight_dump(cluster, checks,
+                                       out_dir=wal_root + "-flight")
                 if engine_faults:
                     await Invariants.breaker_recovered(cluster)
             finally:

@@ -92,7 +92,8 @@ class AppShard(ShardHandle):
                  crypto_fn: Callable[[int], Optional[object]],
                  plane: Optional[ProtocolPlaneTimers] = None,
                  group_key: Optional[int] = None,
-                 wal_subdir: Optional[str] = None):
+                 wal_subdir: Optional[str] = None,
+                 recorder_fn: Optional[Callable[[int], object]] = None):
         self.shard_id = int(shard_id)
         self.plane = plane if plane is not None \
             else ProtocolPlaneTimers(name=f"shard-{shard_id}")
@@ -104,7 +105,8 @@ class AppShard(ShardHandle):
         self.apps = [
             App(i, self.net, self.shared, scheduler,
                 wal_dir=f"{wal_root}/{subdir}/wal-{i}",
-                config=config_fn(i), crypto=crypto_fn(i))
+                config=config_fn(i), crypto=crypto_fn(i),
+                recorder=recorder_fn(i) if recorder_fn is not None else None)
             for i in range(1, n + 1)
         ]
         self.down: set[int] = set()
@@ -297,6 +299,8 @@ class ShardedCluster:
         mux_retention: int = 4096,
         collect_entries: bool = False,
         journal: bool = True,
+        trace: bool = False,
+        trace_capacity: int = 4096,
     ):
         """``crypto``: "trivial" | "p256" | "ed25519" | "toy" (see module
         docstring; "toy" is the real provider stack over the array-math
@@ -315,6 +319,29 @@ class ShardedCluster:
         self.network = Network(seed=seed, naive=naive)
         self.verify_metrics_provider = InMemoryProvider()
         tpu_metrics = TPUCryptoMetrics(self.verify_metrics_provider)
+
+        # flight recorder (ISSUE 12): one bounded TraceRecorder per
+        # replica (keyed "s<shard>n<node>") plus one for the shared
+        # verify plane and one for the set's control plane, all on the
+        # cluster's injectable clock.  trace=False keeps every component
+        # on the nop recorder — the hot path pays one attribute read.
+        self.trace = trace
+        self._recorders: dict[str, object] = {}
+
+        def recorder_for(label: str):
+            if not trace:
+                return None
+            from ..obs import TraceRecorder
+
+            rec = self._recorders.get(label)
+            if rec is None:
+                rec = self._recorders[label] = TraceRecorder(
+                    clock=self.scheduler.now, node=label,
+                    capacity=trace_capacity,
+                )
+            return rec
+
+        self._recorder_for = recorder_for
 
         policy = None
         fallback = None
@@ -391,6 +418,8 @@ class ShardedCluster:
         else:
             raise ValueError(f"unknown crypto mode {crypto!r}")
 
+        if trace:
+            self.coalescer.attach_recorder(recorder_for("verify"))
         cfg = config_fn or (
             lambda s, i: sharded_config(i, depth=depth, rotation=rotation)
         )
@@ -409,6 +438,7 @@ class ShardedCluster:
                 s, self.network, self.scheduler, self.wal_root, n=n,
                 config_fn=lambda i, _s=s: cfg(_s, i),
                 crypto_fn=lambda i, _s=s: crypto_for(_s, i),
+                recorder_fn=lambda i, _s=s: recorder_for(f"s{_s}n{i}"),
             )
             for s in range(shards)
         ]
@@ -427,6 +457,7 @@ class ShardedCluster:
             # commit latency on the SHARED clock: logical seconds in
             # manually-advanced tests, wall seconds under WallClockDriver
             clock=self.scheduler.now,
+            recorder=recorder_for("set"),
         )
         self._client_ids: dict[int, list[str]] = {}
         self._client_scan_pos: dict[int, int] = {}
@@ -469,6 +500,9 @@ class ShardedCluster:
             wal_subdir=f"shard-{sid}" if inc == 0
             else f"shard-{sid}-gen{inc}",
             plane=ProtocolPlaneTimers(name=f"shard-{sid}-gen{inc}"),
+            recorder_fn=lambda i, _s=sid, _g=inc: self._recorder_for(
+                f"s{_s}n{i}" if _g == 0 else f"s{_s}g{_g}n{i}"
+            ),
         )
 
     async def reshard(self, new_shards: int, **kw) -> dict:
@@ -546,3 +580,43 @@ class ShardedCluster:
     def stats_block(self) -> dict:
         self.set.poll_committed()
         return self.set.stats_block()
+
+    # -- flight recorder (ISSUE 12) ----------------------------------------
+
+    def trace_recorders(self) -> list:
+        """Every live recorder (per-replica + shared-plane), or [] when
+        the cluster was built without ``trace=True``."""
+        return list(self._recorders.values())
+
+    def trace_block(self) -> dict:
+        """The merged ``trace`` bench-row block (pure assemble helper)."""
+        from ..obs import assemble_trace_block
+
+        return assemble_trace_block(self.trace_recorders())
+
+    def vc_trackers(self) -> list:
+        """Every live replica's view-change phase tracker — the
+        ``viewchange`` bench-row block's input (always available; the
+        tracker runs whether or not event tracing is on)."""
+        return [
+            a.consensus.vc_phases
+            for sh in self.shard_list
+            for a in sh.live_apps()
+            if a.consensus is not None
+        ]
+
+    def viewchange_block(self) -> dict:
+        from ..obs import assemble_viewchange_block
+
+        return assemble_viewchange_block(self.vc_trackers())
+
+    def dump_flight_recorders(self, out_dir: str) -> list:
+        """Write each recorder's buffered spans to ``out_dir`` as
+        ``flight-<label>.json`` (the obs.report dump shape)."""
+        import os
+
+        os.makedirs(out_dir, exist_ok=True)
+        return [
+            rec.dump_to(os.path.join(out_dir, f"flight-{label}.json"))
+            for label, rec in sorted(self._recorders.items())
+        ]
