@@ -530,6 +530,10 @@ def assemble_open_loop_row(rows: list) -> dict:
         # + merged flight-recorder summary ride every open-loop row
         "viewchange": degraded.get("viewchange"),
         "trace": degraded.get("trace"),
+        # ISSUE 13: the per-request critical-path decomposition (segment
+        # sums == end-to-end within the stated residual; per-phase
+        # sub-blocks name each degraded phase's dominant segment)
+        "critical_path": degraded.get("critical_path"),
         "sweep": [
             {k: r.get(k) for k in ("offered_per_sec", "goodput_per_sec")}
             | {"p99_ms": r["latency"]["p99_ms"],
@@ -593,7 +597,8 @@ def transport_bench(flavor: str) -> None:
     nodes = os.environ.get("SMARTBFT_BENCH_TRANSPORT_NODES", "4")
     requests = os.environ.get("SMARTBFT_BENCH_TRANSPORT_REQUESTS", "120")
     cmd = [sys.executable, os.path.join(here, "benchmarks", "transport.py"),
-           "--flavors", flavors, "--nodes", nodes, "--requests", requests]
+           "--flavors", flavors, "--nodes", nodes, "--requests", requests,
+           "--cluster-trace"]
     timeout = float(os.environ.get("SMARTBFT_BENCH_TRANSPORT_TIMEOUT", "560"))
     proc = subprocess.run(
         cmd, timeout=timeout, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
@@ -607,6 +612,9 @@ def transport_bench(flavor: str) -> None:
     rows = [json.loads(l) for l in proc.stdout.decode().splitlines() if l.strip()]
     by_flavor = {r["flavor"]: r for r in rows if r.get("bench") == "transport"}
     paired = next((r for r in rows if r.get("metric") == "transport_paired"), {})
+    cluster_trace = next(
+        (r for r in rows if r.get("metric") == "cluster_timeline"), None
+    )
     main_row = by_flavor.get(flavor) or next(iter(by_flavor.values()))
     inproc = by_flavor.get("inproc", {})
     print(json.dumps({
@@ -621,6 +629,11 @@ def transport_bench(flavor: str) -> None:
         "inproc_tx_per_sec": inproc.get("tx_per_sec"),
         "protocol_plane": main_row.get("protocol_plane"),
         "inproc_protocol_plane": inproc.get("protocol_plane"),
+        # ISSUE 13: the per-request critical-path decomposition of the
+        # measured flavor, and the multi-process merged cluster timeline
+        # (clock offsets + per-link network time + merged critical path)
+        "critical_path": main_row.get("critical_path"),
+        "cluster_trace": cluster_trace,
     }), flush=True)
 
 

@@ -96,7 +96,7 @@ def openloop_config(pool_size: int, batch: int, admission: float):
 
 
 def build_cluster(tmp: str, args, *, engine_faults: bool = False,
-                  trace: bool = False):
+                  trace: bool = False, trace_capacity: int = 4096):
     from smartbft_tpu.testing.sharded import ShardedCluster
 
     return ShardedCluster(
@@ -104,7 +104,7 @@ def build_cluster(tmp: str, args, *, engine_faults: bool = False,
         engine_faults=engine_faults, window=0.005, seed=17,
         config_fn=openloop_config(args.pool_size, args.batch,
                                   args.admission),
-        trace=trace,
+        trace=trace, trace_capacity=trace_capacity,
     )
 
 
@@ -228,7 +228,10 @@ async def run_degraded(args) -> dict:
     # whole degraded run, and the per-phase VC decomposition comes out in
     # the row's `viewchange` block — the scheduler is wall-driven here,
     # so span durations are real seconds
-    cluster = build_cluster(tmp, args, engine_faults=True, trace=True)
+    # deep rings (16k/recorder): the critical-path decomposition joins a
+    # request's submit with its deliver — both must survive the run
+    cluster = build_cluster(tmp, args, engine_faults=True, trace=True,
+                            trace_capacity=16384)
     # the transition's bounded drain shares the per-phase salvage budget
     # (same convention as benchmarks/sharded.py's live resize)
     cluster.set.drain_deadline = PHASE_TIMEOUT
@@ -351,6 +354,13 @@ async def run_degraded(args) -> dict:
         # the merged flight-recorder summary
         viewchange = cluster.viewchange_block()
         trace = cluster.trace_block()
+        # ISSUE 13: the per-request critical-path decomposition over the
+        # merged timeline, grouped by the phase prefix each request key
+        # carries — names the dominant segment of the degraded phases
+        critical = cluster.critical_path_block(
+            phases=["healthy", "breaker_open", "view_change", "reshard",
+                    "recovered"],
+        )
         return {
             "metric": "open_loop_degraded",
             "offered_per_sec": rate,
@@ -360,6 +370,7 @@ async def run_degraded(args) -> dict:
             "notes": notes,
             "viewchange": viewchange,
             "trace": trace,
+            "critical_path": critical,
             "latency": snap,
         }
     finally:

@@ -38,6 +38,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from smartbft_tpu.metrics import protocol_plane_snapshot
 from smartbft_tpu.net.cluster import _free_port
 from smartbft_tpu.net.transport import SocketComm, TransportMetrics
+from smartbft_tpu.obs import TraceRecorder, assemble_critical_path_block
 from smartbft_tpu.testing.app import App, SharedLedgers, fast_config, wait_for
 from smartbft_tpu.testing.network import Network
 from smartbft_tpu.utils.clock import Scheduler
@@ -52,16 +53,31 @@ def _socket_addrs(n: int, flavor: str, root: str) -> dict[int, str]:
     return {i: f"tcp://127.0.0.1:{_free_port()}" for i in range(1, n + 1)}
 
 
-def _build_apps(flavor: str, n: int, wal_root: str):
+def _build_apps(flavor: str, n: int, wal_root: str, *, trace: bool = False):
+    """``trace=True`` arms one flight recorder per node (ONE process, so
+    time.monotonic is one shared clock — no offset estimation needed) and,
+    on socket flavors, the FT_TRACE wire sidecar + request-key hook; the
+    recorders come back for the critical-path assemble."""
     scheduler = Scheduler()
     shared = SharedLedgers()
     apps: list[App] = []
+    recorders: list[TraceRecorder] = []
+
+    def recorder_for(i: int):
+        if not trace:
+            return None
+        rec = TraceRecorder(clock=time.monotonic, node=f"n{i}",
+                            capacity=16384)
+        recorders.append(rec)
+        return rec
+
     if flavor == "inproc":
         network = Network(scheduler)
         for i in range(1, n + 1):
             apps.append(App(i, network, shared, scheduler,
                             wal_dir=os.path.join(wal_root, f"wal-{i}"),
-                            config=fast_config(i)))
+                            config=fast_config(i),
+                            recorder=recorder_for(i)))
     else:
         addrs = _socket_addrs(n, flavor, wal_root)
         for i in range(1, n + 1):
@@ -69,10 +85,16 @@ def _build_apps(flavor: str, n: int, wal_root: str):
                 i, addrs[i], {j: a for j, a in addrs.items() if j != i},
                 cluster_key=b"bench", backoff_base=0.01, backoff_max=0.2,
             )
-            apps.append(App(i, None, shared, scheduler,
-                            wal_dir=os.path.join(wal_root, f"wal-{i}"),
-                            config=fast_config(i), comm=comm))
-    return apps, scheduler
+            rec = recorder_for(i)
+            app = App(i, None, shared, scheduler,
+                      wal_dir=os.path.join(wal_root, f"wal-{i}"),
+                      config=fast_config(i), comm=comm, recorder=rec)
+            if rec is not None:
+                comm.recorder = rec
+                comm.request_key_fn = \
+                    lambda raw, a=app: str(a.request_id(raw))
+            apps.append(app)
+    return apps, scheduler, recorders
 
 
 def _aggregate_transport(apps: list[App], flavor: str) -> dict:
@@ -130,9 +152,10 @@ async def _drive(apps: list[App], scheduler: Scheduler, requests: int,
 
 
 def run_flavor(flavor: str, n: int, requests: int, payload: int,
-               timeout: float) -> dict:
+               timeout: float, *, trace: bool = True) -> dict:
     with tempfile.TemporaryDirectory(prefix=f"sbft-tb-{flavor}-") as root:
-        apps, scheduler = _build_apps(flavor, n, root)
+        apps, scheduler, recorders = _build_apps(flavor, n, root,
+                                                 trace=trace)
         plane0 = protocol_plane_snapshot()
 
         async def run():
@@ -159,7 +182,58 @@ def run_flavor(flavor: str, n: int, requests: int, payload: int,
                 for k in plane1 if isinstance(plane1[k], (int, float))
             },
         }
+        if recorders:
+            # every recorder shares one process clock: merge directly and
+            # decompose (the ISSUE 13 per-request critical-path block —
+            # in EVERY --transport row, the same pure fn the tests pin)
+            events = [e for r in recorders for e in r.snapshot()]
+            events.sort(key=lambda e: e.get("t", 0.0))
+            row["critical_path"] = assemble_critical_path_block(events)
         return row
+
+
+def run_cluster_trace(n: int = 4, requests: int = 24,
+                      transport: str = "uds",
+                      timeout: float = 120.0) -> dict:
+    """The ISSUE 13 socket-cluster timeline row: a REAL process-per-
+    replica cluster with wire tracing armed commits a small workload,
+    then the parent pulls every replica's flight recorder plus control-
+    channel clock offsets and merges ONE causally-ordered cluster
+    timeline — skew-adjusted timestamps, per-directed-link network
+    times, and the merged per-request critical path."""
+    from smartbft_tpu.net.cluster import SocketCluster
+
+    with tempfile.TemporaryDirectory(prefix="sbft-ct-") as root:
+        cluster = SocketCluster(root, n=n, transport=transport, trace=True,
+                                trace_capacity=16384)
+        try:
+            cluster.start()
+            cluster.wait_leader()
+            live = cluster.live_ids()
+            for k in range(requests):
+                cluster.submit(live[k % len(live)], "ct", f"ct-{k}")
+            cluster.wait_committed(requests, timeout=timeout)
+            timeline = cluster.cluster_timeline()
+        finally:
+            cluster.stop()
+    # residual tolerance = the merge's stated error bound: 2x the worst
+    # per-replica midpoint error (two clocks touch every cross-node delta)
+    err = max((o["err_bound_s"] for o in timeline["offsets"].values()),
+              default=0.0)
+    critical = assemble_critical_path_block(
+        timeline["merged"],
+        residual_tolerance_ms=max(1.0, 2e3 * err),
+    )
+    return {
+        "metric": "cluster_timeline",
+        "nodes": n,
+        "transport": transport,
+        "requests": requests,
+        "merged_events": timeline["events"],
+        "offsets": timeline["offsets"],
+        "hops": timeline["hops"],
+        "critical_path": critical,
+    }
 
 
 def main(argv=None) -> int:
@@ -170,6 +244,14 @@ def main(argv=None) -> int:
     ap.add_argument("--requests", type=int, default=120)
     ap.add_argument("--payload", type=int, default=256)
     ap.add_argument("--timeout", type=float, default=120.0)
+    ap.add_argument("--untraced", action="store_true",
+                    help="disable the flight recorders + FT_TRACE sidecar "
+                         "(drops the critical_path block from the rows)")
+    ap.add_argument("--cluster-trace", action="store_true",
+                    help="additionally run a process-per-replica socket "
+                         "cluster with wire tracing and emit the merged "
+                         "cluster_timeline row (clock offsets, per-link "
+                         "network time, merged critical path)")
     args = ap.parse_args(argv)
 
     flavors = [f.strip() for f in args.flavors.split(",") if f.strip()]
@@ -179,9 +261,14 @@ def main(argv=None) -> int:
     rows = {}
     for flavor in flavors:
         row = run_flavor(flavor, args.nodes, args.requests, args.payload,
-                         args.timeout)
+                         args.timeout, trace=not args.untraced)
         rows[flavor] = row
         print(json.dumps(row), flush=True)
+    if args.cluster_trace:
+        try:
+            print(json.dumps(run_cluster_trace(n=args.nodes)), flush=True)
+        except Exception as exc:  # noqa: BLE001 — timeline row is additive
+            print(f"cluster-trace run failed: {exc!r}", file=sys.stderr)
     socket_rows = [rows[f] for f in flavors if f != "inproc"]
     if "inproc" in rows and socket_rows:
         base = rows["inproc"]["tx_per_sec"]

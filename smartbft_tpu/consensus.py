@@ -227,6 +227,12 @@ class Consensus:
         self._loop = asyncio.get_running_loop()
         self.validate_configuration(self.comm.nodes())
         self._wire_verify_plane()
+        # WAL persistence spans (ISSUE 13): the log records wal.append /
+        # wal.fsync durations into this replica's recorder (and its own
+        # bounded histograms either way); WALs without the seam no-op
+        attach_wal_recorder = getattr(self.wal, "attach_recorder", None)
+        if attach_wal_recorder is not None:
+            attach_wal_recorder(self.recorder)
 
         self._set_nodes(self.comm.nodes())
         self.in_flight = InFlightData()
@@ -541,6 +547,7 @@ class Consensus:
             view_sequences=view_sequences,
             pipeline_depth=self.config.pipeline_depth,
             backpressure=self.config.inbox_backpressure,
+            recorder=self.recorder,
         )
 
     def _create_pool(self) -> None:
@@ -584,6 +591,11 @@ class Consensus:
             self.controller.view_sequences,
             self.config.num_of_ticks_behind_before_syncing,
             pipeline_depth=self.config.pipeline_depth,
+            # detection instrumentation (ROADMAP item 1): the silence-to-
+            # complain interval lands in the VC phase tracker + the
+            # viewchange metric bundle — round 15 showed DETECTION, not
+            # the VC protocol, owns ~99% of the failover cliff
+            vc_phases=self.vc_phases,
         )
         self.controller.batcher = batcher
         self.controller.leader_monitor = leader_monitor

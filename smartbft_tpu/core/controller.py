@@ -695,7 +695,9 @@ class Controller(RequestTimeoutHandler):
         md = decode(ViewMetadata, d.proposal.metadata)
         vp = self.vc_phases
         if vp is not None and vp.open:
-            vp.decision(md.view_id)  # first commit closes an open VC round
+            # first commit closes an open VC round; the pool depth at this
+            # flip is the stalled backlog the new view now drains
+            vp.decision(md.view_id, backlog=self.request_pool.size())
         rec = self.recorder
         if rec.enabled:
             rec.record("decision.deliver", view=md.view_id,

@@ -37,6 +37,7 @@ class HeartbeatMonitor:
         view_sequences: ViewSequencesHolder,
         num_of_ticks_behind_before_syncing: int,
         pipeline_depth: int = 1,
+        vc_phases=None,
     ):
         self._log = logger
         self._hb_timeout = heartbeat_timeout
@@ -46,6 +47,10 @@ class HeartbeatMonitor:
         self._handler = handler  # Controller: on_heartbeat_timeout / sync
         self._view_sequences = view_sequences
         self._ticks_behind_limit = num_of_ticks_behind_before_syncing
+        #: optional obs.ViewChangePhaseTracker: heartbeat-timeout firings
+        #: report their ARM-TO-FIRE interval (last heartbeat seen -> the
+        #: complain) — the detection latency that dominates failover
+        self._vc_phases = vc_phases
         # pipelined mode: a healthy follower may trail the leader by up to
         # TWO window depths (base window + launch shadow) while quorums it
         # is not part of complete — lagging inside that span is the
@@ -151,6 +156,10 @@ class HeartbeatMonitor:
                 "Heartbeat timeout (%s) from %d expired; last heartbeat was observed %s ago",
                 self._hb_timeout, self._leader_id, delta,
             )
+            if self._vc_phases is not None:
+                # delta IS the complain-timer arm-to-fire time: the timer
+                # armed at the last observed heartbeat and fired now
+                self._vc_phases.detection(delta)
             self._handler.on_heartbeat_timeout(self._view, self._leader_id)
             self._timed_out = True
             return

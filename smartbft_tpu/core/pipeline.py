@@ -220,6 +220,7 @@ class WindowedView:
         decisions_per_leader: int = 0,
         membership_notifier=None,
         metrics_blacklist: Optional[BlacklistMetrics] = None,
+        recorder=None,
     ):
         self.self_id = self_id
         self.n = n
@@ -242,6 +243,12 @@ class WindowedView:
         self.window = max(2, int(window))
         self.in_flight = in_flight
         self.metrics = metrics_view
+        # flight recorder: per-slot quorum-completion + WAL-persist marks
+        # for the critical-path decomposition (obs.critpath); the nop
+        # singleton keeps every site at one attribute read when off
+        from ..obs.recorder import NOP_RECORDER
+
+        self.recorder = recorder if recorder is not None else NOP_RECORDER
         #: one dense signer-id index shared by every slot's vote sets
         self._signer_index = SignerIndex(nodes_list)
         #: called (no args) when propose capacity re-opens WITHOUT a
@@ -883,6 +890,22 @@ class WindowedView:
         already registered (direct ingest), so the witness sweep is just the
         counting pass (PreparesFrom is liveness evidence)."""
         self._count_prepares(slot)
+        rec = self.recorder
+        if rec.enabled:
+            # ingest-wave granularity, like View._process_prepares: ties
+            # within the quorum-completing sweep resolve in signer-index
+            # order
+            rec.record(
+                "quorum.prepare", view=self.number, seq=slot.seq,
+                # quorum == 1: no peer votes, no voter to name (the [-1]
+                # empty-list index would crash the view otherwise)
+                extra={"slowest_voter":
+                       slot.prepare_voters[self.quorum - 2]
+                       if self.quorum >= 2
+                       and len(slot.prepare_voters) >= self.quorum - 1
+                       else -1,
+                       "voters": len(slot.prepare_voters)},
+            )
         prp_from = encode(PreparesFrom(ids=slot.prepare_voters))
         sig = self.signer.sign_proposal(slot.proposal, prp_from)
         slot.my_sig = sig
@@ -896,6 +919,11 @@ class WindowedView:
         self._commit_frontier = slot.seq
 
         def finalize() -> None:
+            if rec.enabled:
+                # runs after the shared durability wave: the commit
+                # record is on disk (the WAL-first rule), so this is the
+                # wal_persist mark of the critical path
+                rec.record("wal.persist", view=self.number, seq=slot.seq)
             if self.in_flight is not None:
                 self.in_flight.store_prepares_at(slot.seq)
             slot.commit_sent = replace(commit, assist=True)
@@ -1011,6 +1039,12 @@ class WindowedView:
             slot.valid_sigs.append(sig)
         if slot.valid_sigs and len(slot.valid_sigs) >= self.quorum - 1 and slot.phase == PREPARED:
             slot.phase = READY
+            rec = self.recorder
+            if rec.enabled:
+                rec.record(
+                    "quorum.commit", view=self.number, seq=seq,
+                    extra={"slowest_voter": slot.valid_sigs[-1].signer},
+                )
             self.logger.infof(
                 "%d collected %d commits for seq %d from %s",
                 self.self_id, len(slot.valid_sigs), seq,

@@ -36,6 +36,7 @@ class ProposalMaker:
         metrics_blacklist: Optional[BlacklistMetrics] = None,
         pipeline_depth: int = 1,
         backpressure: bool = False,
+        recorder=None,
     ):
         self.decisions_per_leader = decisions_per_leader
         self.n = n
@@ -57,6 +58,7 @@ class ProposalMaker:
         self.metrics_blacklist = metrics_blacklist
         self.pipeline_depth = pipeline_depth
         self.backpressure = backpressure
+        self.recorder = recorder
         self._restored_from_wal = False
 
     def new_proposer(
@@ -97,6 +99,7 @@ class ProposalMaker:
             metrics_view=self.metrics_view,
             metrics_blacklist=self.metrics_blacklist,
             backpressure=self.backpressure,
+            recorder=self.recorder,
         )
         self._restore_once_and_publish(view, proposal_sequence)
         if proposal_sequence > view.proposal_sequence:
@@ -168,6 +171,7 @@ class ProposalMaker:
             in_flight=getattr(self.state, "in_flight", None),
             metrics_view=self.metrics_view,
             capacity_cb=getattr(self.decider, "on_window_capacity", None),
+            recorder=self.recorder,
         )
         self._restore_once_and_publish(view, proposal_sequence)
         self._publish_metrics(view)

@@ -173,6 +173,7 @@ class View:
         metrics_blacklist: Optional[BlacklistMetrics] = None,
         in_msg_q_size: int = 200,
         backpressure: bool = False,
+        recorder=None,
     ):
         self.self_id = self_id
         self.n = n
@@ -197,6 +198,12 @@ class View:
         self.metrics = metrics_view
         self.metrics_blacklist = metrics_blacklist
         self.in_msg_q_size = in_msg_q_size
+        # flight recorder (obs.TraceRecorder; nop singleton when tracing
+        # is off): quorum-completion + WAL-persist marks for the per-
+        # request critical-path decomposition (obs.critpath)
+        from ..obs.recorder import NOP_RECORDER
+
+        self.recorder = recorder if recorder is not None else NOP_RECORDER
 
         self.phase = COMMITTED
         # runtime
@@ -611,6 +618,26 @@ class View:
                 break
             await self._next_event()
 
+        rec = self.recorder
+        if rec.enabled:
+            # the voter whose prepare COMPLETED the quorum — "the slowest
+            # f+1-th voter", the critical-path table's named straggler.
+            # Granularity is the INGEST WAVE: votes landing in one
+            # coalesced wave are observationally simultaneous here, and
+            # ties within the completing wave resolve in signer-index
+            # order (the mask sweep's iteration order)
+            rec.record(
+                "quorum.prepare", view=self.number,
+                seq=self.proposal_sequence,
+                # quorum == 1 (n == 1) needs no peer votes: there is no
+                # completing voter to name (and [-1] on the empty list
+                # would crash the view — tracing must never break it)
+                extra={"slowest_voter": voter_ids[self.quorum - 2]
+                       if self.quorum >= 2
+                       and len(voter_ids) >= self.quorum - 1 else -1,
+                       "voters": len(voter_ids)},
+            )
+
         # sweep prepares that are already queued/registered into the witness
         # list before signing: PreparesFrom is the liveness evidence behind
         # blacklist redemption (util.go:502-541), and crediting only the
@@ -641,6 +668,8 @@ class View:
         )
         # Save our commit before broadcasting it (group-commit durability).
         await self._save_state(CommitRecord(commit=commit))
+        if rec.enabled:
+            rec.record("wal.persist", view=self.number, seq=seq)
         self._curr_commit_sent = replace(commit, assist=True)
         self.last_broadcast_sent = commit
         self.logger.infof("Processed prepares for proposal with seq %d", seq)
@@ -653,6 +682,13 @@ class View:
         signatures = await self._process_commits(proposal)
 
         seq = self.proposal_sequence
+        rec = self.recorder
+        if rec.enabled:
+            rec.record(
+                "quorum.commit", view=self.number, seq=seq,
+                extra={"slowest_voter": signatures[-1].signer
+                       if signatures else -1},
+            )
         self.logger.infof("%d processed commits for proposal with seq %d", self.self_id, seq)
         if self.metrics:
             self.metrics.count_batch_all.add(1)

@@ -489,6 +489,18 @@ class ViewChangeMetrics:
         #: freezes at the end-to-end total when the round completes
         self.time_in_view_change = _g(
             p, "viewchange", "time_in_view_change_seconds")
+        #: complain-timer arm-to-fire time of the LAST heartbeat-timeout
+        #: firing (seconds): the detection latency PERF round 15 blamed
+        #: for ~99% of the failover cliff, now a first-class gauge
+        self.heartbeat_detection_seconds = _g(
+            p, "viewchange", "heartbeat_detection_seconds")
+        #: heartbeat-timeout firings (each arms/rearms a complain)
+        self.count_heartbeat_timeouts = _c(
+            p, "viewchange", "count_heartbeat_timeouts")
+        #: request-pool depth at the view flip (the stalled backlog the
+        #: new view must drain before request p99 recovers)
+        self.backlog_at_view_flip = _g(
+            p, "viewchange", "backlog_at_view_flip")
 
 
 class TPUCryptoMetrics:

@@ -454,15 +454,121 @@ class SocketCluster:
 
     # ------------------------------------------------------------ observability
 
-    def trace_pull(self, node_id: int, last: Optional[int] = None) -> dict:
+    def trace_pull(self, node_id: int, last: Optional[int] = None,
+                   since: Optional[int] = None) -> dict:
         """Pull one replica's flight-recorder state over the control
-        channel: ``{"node", "trace": <summary block>, "events": [...]}``
-        — the per-replica timeline a SocketCluster run can fetch without
-        touching the consensus transport."""
+        channel: ``{"node", "trace": <summary block>, "events": [...],
+        "next_since": <cursor>}`` — the per-replica timeline a
+        SocketCluster run can fetch without touching the consensus
+        transport.  Pass ``since`` (a previous pull's ``next_since``) to
+        ship only NEW events: repeated pulls cost O(new), never a re-send
+        of the whole ring."""
         req = {"cmd": "trace"}
         if last is not None:
             req["last"] = last
+        if since is not None:
+            req["since"] = since
         return self.control(node_id).call(**req)
+
+    def estimate_clock_offsets(self, samples: int = 5) -> dict:
+        """Per-replica monotonic-clock offset vs THIS process's clock,
+        over the existing control-channel ping (line JSON, PR 6).
+
+        Classic request/response-midpoint estimation: the replica's
+        ``now`` (monotonic, returned by cmd=ping) is assumed to have been
+        read at the midpoint of the round trip; ``offset = now_replica -
+        midpoint_parent``, and any replica timestamp maps onto the
+        parent's timeline as ``t - offset``.  The LOWEST-RTT sample of
+        ``samples`` wins (least queueing noise) and the error is bounded
+        by RTT/2 — reported per node so the merged timeline's precision
+        is stated, not implied.  Returns ``{"n<i>": {"offset_s",
+        "rtt_s", "err_bound_s"}}`` for every live, answering replica."""
+        out: dict = {}
+        for i in self.live_ids():
+            best: Optional[tuple[float, float]] = None
+            for _ in range(max(1, samples)):
+                t0 = time.monotonic()
+                try:
+                    resp = self.control(i).call(cmd="ping")
+                except (OSError, ControlError, json.JSONDecodeError):
+                    break
+                t1 = time.monotonic()
+                now = resp.get("now")
+                if now is None:
+                    break  # pre-offset replica build: skip
+                rtt = t1 - t0
+                if best is None or rtt < best[1]:
+                    best = (float(now) - (t0 + t1) / 2.0, rtt)
+            if best is not None:
+                out[f"n{i}"] = {
+                    "offset_s": best[0],
+                    "rtt_s": round(best[1], 6),
+                    "err_bound_s": round(best[1] / 2.0, 6),
+                }
+        return out
+
+    def cluster_timeline(self, out_dir: Optional[str] = None,
+                         last: Optional[int] = None) -> dict:
+        """Pull every live replica's flight recorder plus clock offsets
+        and merge them into ONE causally-ordered cluster timeline:
+        skew-adjusted timestamps (each dump carries its
+        ``clock_offset_s``; the merge subtracts it) and per-directed-link
+        network time (receiver ingest minus sender send, both mapped onto
+        the parent clock).  ``last=None`` (default) pulls each replica's
+        WHOLE ring: a deep (e.g. 16k) ring would otherwise be silently
+        tail-trimmed, dropping early requests' submit marks from the
+        critical-path join with no truncation signal.  Returns
+        ``{"offsets", "dumps", "events",
+        "hops"}``; with ``out_dir`` the dumps (and an ``offsets.json``)
+        are also written in the ``obs.report`` shape so ``python -m
+        smartbft_tpu.obs.report out/flight-*.json`` renders the merged
+        timeline offline."""
+        from ..obs.report import link_summary, merged_events
+
+        offsets = self.estimate_clock_offsets()
+        dumps: list[dict] = []
+        offsets_missing: list[str] = []
+        for i in self.live_ids():
+            try:
+                resp = self.trace_pull(i, last=last)
+            except (OSError, ControlError):
+                continue
+            node = resp.get("node", f"n{i}")
+            known = node in offsets
+            if not known:
+                # a replica whose ping failed mid-estimation merges with
+                # an UNKNOWN clock: flag it loudly (offset_known) instead
+                # of silently pretending 0.0 skew — on a real multi-host
+                # deployment that skew is unbounded, and link_summary
+                # excludes the node's hop rows rather than polluting them
+                offsets_missing.append(node)
+            dumps.append({
+                "node": node,
+                "capacity": resp.get("trace", {}).get("capacity", 0),
+                "recorded": resp.get("trace", {}).get("recorded", 0),
+                "dropped": resp.get("dropped", 0),
+                "clock_offset_s": offsets.get(node, {}).get("offset_s", 0.0),
+                "offset_known": known,
+                "events": resp.get("events", []),
+            })
+        events = merged_events(dumps)
+        hops = link_summary(
+            events, {n: o["offset_s"] for n, o in offsets.items()}
+        )
+        if out_dir is not None:
+            os.makedirs(out_dir, exist_ok=True)
+            for d in dumps:
+                with open(os.path.join(out_dir,
+                                       f"flight-{d['node']}.json"), "w") as fh:
+                    json.dump(d, fh)
+            with open(os.path.join(out_dir, "offsets.json"), "w") as fh:
+                json.dump(offsets, fh)
+        return {"offsets": offsets, "offsets_missing": offsets_missing,
+                "dumps": dumps, "events": len(events), "hops": hops,
+                # the merged (skew-adjusted, sorted) event list itself —
+                # callers feeding the critical-path assemble must not pay
+                # a second O(E log E) merge over the same dumps
+                "merged": events}
 
     def metrics_text(self, node_id: int) -> str:
         """One replica's Prometheus text exposition (cmd=metrics)."""

@@ -32,6 +32,17 @@ Frame types:
   which fronts the client — gets the PR 8 admission contract (shed kind,
   retry-after hint, occupancy snapshot) instead of silence.  Advisory:
   the protocol's forward/complain timers keep running either way.
+* ``FT_TRACE``      — cluster-tracing SIDECAR (ISSUE 13): a batch of
+  compact correlation contexts (request key / (view, seq), origin node,
+  monotonic hop counter) describing the data frames of the SAME
+  write-coalesced flush, stamped with the sender's monotonic clock at
+  flush time.  Strictly advisory telemetry: it rides only when the
+  sender's flight recorder is armed, the canonical signed consensus
+  encoding is untouched (same rule as FT_REJECT — the sidecar is a
+  separate untagged frame, never a trailer on a consensus frame), and a
+  receiver without tracing just updates its hop memory and moves on.
+  Loss is tolerated by construction — a dropped sidecar frame costs
+  timeline coverage, never correctness.
 
 The handshake / sync payloads are encoded with the UNTAGGED canonical
 codec (``codec.encode`` / ``codec.decode``): the frame type already
@@ -62,10 +73,11 @@ FT_REQUEST = 3
 FT_SYNC_REQ = 4
 FT_SYNC_RESP = 5
 FT_REJECT = 6
+FT_TRACE = 7
 
 _KNOWN_TYPES = frozenset(
     (FT_HELLO, FT_CONSENSUS, FT_REQUEST, FT_SYNC_REQ, FT_SYNC_RESP,
-     FT_REJECT)
+     FT_REJECT, FT_TRACE)
 )
 
 
@@ -169,6 +181,45 @@ class RejectFrame:
     occupancy: int = 0
     high_water: int = 0
     request_digest: bytes = b""
+
+
+@wiremsg
+class TraceCtx:
+    """One correlation context riding an FT_TRACE sidecar (untagged
+    encoding).  ``kind`` is the traced frame's flavor — the consensus
+    message class name (``"PrePrepare"``/``"Prepare"``/``"Commit"``/…)
+    or ``"request"`` for an FT_REQUEST — ``key`` the request key
+    (``"client:rid"``) when the embedder supplied a
+    ``request_key_fn``, ``(view, seq)`` the consensus correlator,
+    ``origin`` the node that CREATED the context (not necessarily the
+    sender of this hop), and ``hop`` the monotonic wire-hop counter:
+    1 for a first send, incremented each time a replica re-forwards a
+    request whose inbound context it remembered."""
+
+    kind: str = ""
+    key: str = ""
+    view: int = 0
+    seq: int = 0
+    origin: int = 0
+    hop: int = 0
+
+
+@wiremsg
+class TraceFrame:
+    """The FT_TRACE sidecar payload: every data frame of ONE
+    write-coalesced flush described in one batch, stamped with the
+    sender's ``time.monotonic`` at flush time (microseconds).  The
+    receiver's ingest timestamp minus ``sent_us`` — after the control-
+    channel clock-offset alignment maps both onto one timeline — is the
+    per-hop network time."""
+
+    origin: int = 0
+    sent_us: int = 0
+    entries: list[TraceCtx] = None  # type: ignore[assignment]
+
+    def __post_init__(self):
+        if self.entries is None:
+            object.__setattr__(self, "entries", [])
 
 
 @wiremsg

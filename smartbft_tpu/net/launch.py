@@ -191,6 +191,11 @@ class ReplicaApp(Application, Assembler, Comm, Signer, Verifier,
         else:
             self.recorder = NOP_RECORDER
         self.transport.recorder = self.recorder
+        # FT_TRACE sidecars carry the SAME "client:rid" correlator the
+        # recorder stamps on req.submit/req.deliver (request_id memoizes,
+        # so the per-forward cost is a dict hit once warm)
+        self.transport.request_key_fn = \
+            lambda raw: str(self.request_id(raw))
         self.ledger_file = LedgerFile(spec["ledger_path"])
         self.lock = threading.Lock()
         self.ledger: list[Decision] = []
@@ -566,8 +571,15 @@ class ControlServer:
         r = self.replica
         cmd = req.get("cmd")
         if cmd == "ping":
+            import time
+
             running = r.consensus is not None and r.consensus._running
-            return {"ok": True, "running": running, "node_id": r.id}
+            # "now" is this process's monotonic clock — the parent's
+            # request/response midpoint against it estimates the clock
+            # offset that aligns per-replica trace timestamps onto ONE
+            # cluster timeline (SocketCluster.estimate_clock_offsets)
+            return {"ok": True, "running": running, "node_id": r.id,
+                    "now": time.monotonic()}
         if cmd == "leader":
             lead = r.consensus.get_leader_id() if r.consensus else 0
             return {"ok": True, "leader": lead}
@@ -651,17 +663,31 @@ class ControlServer:
             # deployments (mount behind an HTTP handler in production)
             return {"ok": True, "text": r.metrics_provider.expose()}
         if cmd == "trace":
-            # per-replica flight-recorder pull: summary block + the last
-            # N events (all buffered events when "last" is omitted)
+            # per-replica flight-recorder pull: summary block + events.
+            # "since" (an event-sequence cursor from a previous pull's
+            # "next_since") ships only NEW events — repeated pulls are
+            # O(new), never a re-send of the whole ring; "last" keeps the
+            # newest-N semantics.  since wins when both are present.
             last = req.get("last")
+            since = req.get("since")
+            if since is not None:
+                events, cursor = r.recorder.snapshot_since(int(since))
+            else:
+                # the full/newest-N pull rides the same exact-seqno path
+                # (events_since) so next_since can never cover an event
+                # the snapshot raced past (recorders are fed from
+                # executor threads too — the torn-pair hazard)
+                evs, cursor = r.recorder.events_since(0)
+                if last is not None:
+                    evs = evs[-int(last):] if int(last) else []
+                events = [e.as_dict() for e in evs]
             return {
                 "ok": True,
                 "node": f"n{r.id}",
                 "trace": r.recorder.trace_block(),
                 "dropped": r.recorder.dropped,
-                "events": r.recorder.snapshot(
-                    last=int(last) if last is not None else None
-                ),
+                "events": events,
+                "next_since": cursor,
             }
         if cmd == "fault":
             return self._fault(req)

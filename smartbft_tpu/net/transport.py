@@ -32,7 +32,15 @@ paid; this module adds the sockets:
 * **Malformed frames drop the connection, loudly** — a bad length
   prefix, unknown frame type, or undecodable consensus payload counts
   in metrics and closes THAT connection; the replica and the intern LRU
-  (which only caches successful decodes) are untouched.
+  (which only caches successful decodes) are untouched;
+* **Wire tracing sidecar (ISSUE 13)** — while this node's flight
+  recorder is armed, each coalesced flush appends at most ONE untagged
+  ``FT_TRACE`` frame batching the flush's correlation contexts (request
+  key / (view, seq), origin, hop counter) plus the sender's monotonic
+  flush stamp; the receive side records one ``net.recv`` event per
+  context and remembers request hop chains so re-forwards continue
+  them.  Data-frame counts and the canonical consensus encoding are
+  untouched; sidecar loss costs timeline coverage, never correctness.
 
 Connections are DIRECTED: each node dials every peer and uses that
 connection only for its own sends; inbound connections only receive.
@@ -47,7 +55,8 @@ from __future__ import annotations
 import asyncio
 import contextlib
 import random
-from collections import deque
+import time
+from collections import OrderedDict, deque
 from time import perf_counter
 from typing import Callable, Optional
 
@@ -65,12 +74,15 @@ from .framing import (
     FT_REQUEST,
     FT_SYNC_REQ,
     FT_SYNC_RESP,
+    FT_TRACE,
     FrameDecoder,
     FrameError,
     Hello,
     RejectFrame,
     SyncBatch,
     SyncRequest,
+    TraceCtx,
+    TraceFrame,
     encode_frame,
     parse_addr,
     reject_digest,
@@ -91,6 +103,11 @@ HANDSHAKE_TIMEOUT = 5.0
 #: the requester loops until caught up
 MAX_SYNC_DECISIONS = 256
 
+#: bounded memory of inbound request trace contexts (key -> (origin, hop))
+#: used to continue the hop chain when this node re-forwards a request;
+#: beyond the cap the OLDEST entry is evicted (telemetry, never state)
+REQ_HOP_CAP = 1024
+
 
 class TransportMetrics:
     """Per-transport counters, exported as the ``transport`` block in
@@ -105,6 +122,7 @@ class TransportMetrics:
         "connect_failures", "outbox_dropped", "link_dropped",
         "malformed_frames", "connections_dropped", "handshake_rejected",
         "sync_requests", "sync_responses", "rejects_sent", "rejects_received",
+        "trace_frames_sent", "trace_frames_received", "trace_ctxs_sent",
     )
 
     def __init__(self) -> None:
@@ -123,7 +141,8 @@ class TransportMetrics:
 class _Peer:
     """Sender-side state for one outbound (directed) link."""
 
-    __slots__ = ("id", "addr", "outbox", "wake", "task", "connected")
+    __slots__ = ("id", "addr", "outbox", "wake", "task", "connected",
+                 "trace_pending")
 
     def __init__(self, peer_id: int, addr: str):
         self.id = peer_id
@@ -132,6 +151,9 @@ class _Peer:
         self.wake: Optional[asyncio.Event] = None  # created on start()
         self.task: Optional[asyncio.Task] = None
         self.connected = False
+        #: correlation contexts for data frames awaiting the next flush's
+        #: FT_TRACE sidecar (only populated while wire tracing is armed)
+        self.trace_pending: deque = deque()
 
 
 class SocketComm(Comm):
@@ -180,6 +202,15 @@ class SocketComm(Comm):
         from ..obs.recorder import NOP_RECORDER
 
         self.recorder = NOP_RECORDER
+        #: optional embedder hook mapping raw request bytes -> the request
+        #: key ("client:rid") so FT_TRACE sidecars carry the SAME
+        #: correlator the flight recorder stamps on req.submit/req.deliver
+        #: (the transport itself is payload-agnostic); failures fall back
+        #: to an empty key — the context still carries origin + hop
+        self.request_key_fn: Optional[Callable[[bytes], object]] = None
+        #: inbound request contexts (key -> (origin, hop)) so a re-forward
+        #: of the same request continues its hop chain; bounded LRU
+        self._req_hops: "OrderedDict[str, tuple[int, int]]" = OrderedDict()
         self.consensus = None
         #: multi-process sync server hook: (from_height) -> (decisions,
         #: total_height) with decisions a list[framing.WireDecision]
@@ -322,6 +353,8 @@ class SocketComm(Comm):
         self.plane.sends += 1
         wire = wire_of(msg, self.plane)
         self._enqueue(target_id, encode_frame(FT_CONSENSUS, wire))
+        if self.recorder.enabled:
+            self._trace_ctx(target_id, self._consensus_ctx(msg))
 
     def broadcast_consensus(self, msg: Message,
                             targets: Optional[list[int]] = None) -> None:
@@ -333,10 +366,15 @@ class SocketComm(Comm):
         t0 = perf_counter()
         codec0 = self.plane.codec_us
         frame = encode_frame(FT_CONSENSUS, wire_of(msg, self.plane))
+        ctx = self._consensus_ctx(msg) if self.recorder.enabled else None
         for target in (targets if targets is not None else self._peers):
             if target == self.self_id:
                 continue
             self._enqueue(target, frame)
+            if ctx is not None:
+                # ONE frozen context object shared across every sidecar,
+                # mirroring the encode-once data frame
+                self._trace_ctx(target, ctx)
         # disjoint accounting: encode time is already in codec_us
         self.plane.route_us += (
             (perf_counter() - t0) * 1e6 - (self.plane.codec_us - codec0)
@@ -346,6 +384,85 @@ class SocketComm(Comm):
         if self.muted:
             return
         self._enqueue(target_id, encode_frame(FT_REQUEST, request))
+        if self.recorder.enabled:
+            key = self._request_key(request)
+            # continue the hop chain of a remembered inbound context (a
+            # forward of a forward); otherwise this node originates it
+            origin, hop = self._req_hops.get(key, (self.self_id, 0)) \
+                if key else (self.self_id, 0)
+            self._trace_ctx(target_id, TraceCtx(
+                kind="request", key=key, origin=origin, hop=hop + 1,
+            ))
+
+    # ------------------------------------------------------------ tracing
+
+    def _consensus_ctx(self, msg: Message) -> TraceCtx:
+        """Correlation context for one consensus message: class name +
+        (view, seq) when the message carries them (pre-prepare / prepare /
+        commit / heartbeat do; view-change messages carry other fields and
+        correlate by kind + origin alone)."""
+        view = getattr(msg, "view", 0)
+        seq = getattr(msg, "seq", 0)
+        return TraceCtx(
+            kind=type(msg).__name__,
+            view=view if isinstance(view, int) and view >= 0 else 0,
+            seq=seq if isinstance(seq, int) and seq >= 0 else 0,
+            origin=self.self_id,
+            hop=1,
+        )
+
+    def _request_key(self, request: bytes) -> str:
+        if self.request_key_fn is None:
+            return ""
+        try:
+            return str(self.request_key_fn(request))
+        except Exception:  # noqa: BLE001 — telemetry must never shed traffic
+            return ""
+
+    def _trace_ctx(self, target: int, ctx: TraceCtx) -> None:
+        """Stage one sidecar context for ``target``'s next flush.  Mirrors
+        the outbox's fault surface (dropped links stage nothing) and its
+        bound (oldest context dropped past the cap) — contexts are
+        advisory, so a mismatch after drops costs coverage, not
+        correctness."""
+        peer = self._peers.get(target)
+        if peer is None or target in self._dropped_links:
+            return
+        if len(peer.trace_pending) >= self.outbox_cap:
+            peer.trace_pending.popleft()
+        peer.trace_pending.append(ctx)
+
+    def _on_trace_frame(self, sender: int, payload: bytes,
+                        recv_t: Optional[float] = None) -> None:
+        """Ingest one FT_TRACE sidecar: remember request hop chains and —
+        when this node's recorder is armed — stamp one ``net.recv`` event
+        per context (receiver-ingest side of the per-hop network time;
+        the sender's ``sent_us`` rides in ``extra`` for the clock-aligned
+        merge to subtract).  ``recv_t`` is the socket READ time of the
+        batch the sidecar arrived in (time.monotonic, the recorder's
+        clock domain): the dispatch loop awaits consensus handling of
+        the wave BEFORE reaching this frame, and stamping at record time
+        would book that compute as wire time."""
+        frame = decode(TraceFrame, payload)  # CodecError -> drop conn
+        self.metrics.trace_frames_received += 1
+        rec = self.recorder
+        for e in frame.entries:
+            if e.kind == "request" and e.key:
+                self._req_hops[e.key] = (e.origin, e.hop)
+                self._req_hops.move_to_end(e.key)
+                if len(self._req_hops) > REQ_HOP_CAP:
+                    self._req_hops.popitem(last=False)
+            if rec.enabled:
+                consensus_kind = e.kind != "request"
+                rec.record(
+                    "net.recv",
+                    key=e.key,
+                    view=e.view if consensus_kind else -1,
+                    seq=e.seq if consensus_kind else -1,
+                    extra={"from": sender, "origin": e.origin, "hop": e.hop,
+                           "sent_us": frame.sent_us, "wire": e.kind},
+                    t=recv_t,
+                )
 
     # ------------------------------------------------------------ send path
 
@@ -359,8 +476,15 @@ class SocketComm(Comm):
         if len(peer.outbox) >= self.outbox_cap:
             # loud-but-bounded: drop the OLDEST frame (the protocol's
             # recovery paths — re-sends, view change, sync — are built for
-            # loss; what it cannot survive is unbounded memory growth)
+            # loss; what it cannot survive is unbounded memory growth).
+            # Its staged trace context drops with it (oldest-for-oldest —
+            # approximate, since untraced frame kinds hold no context,
+            # but it keeps the sidecar from advertising frames that never
+            # went out; phantom net.recv events would fabricate coverage
+            # exactly under the overload the recorder exists to diagnose)
             peer.outbox.popleft()
+            if peer.trace_pending:
+                peer.trace_pending.popleft()
             self.metrics.outbox_dropped += 1
             if self.metrics.outbox_dropped % 1000 == 1:
                 self.logger.warnf(
@@ -435,8 +559,30 @@ class SocketComm(Comm):
             batch_len = len(peer.outbox)
             if batch_len:
                 pending = [peer.outbox.popleft() for _ in range(batch_len)]
+                ctxs = None
+                if peer.trace_pending and self.recorder.enabled:
+                    # ONE sidecar frame per flush describing the whole
+                    # batch (the write-coalescing contract).  The sidecar
+                    # stays OUT of `pending`: a mid-flush failure hands
+                    # the contexts back to trace_pending so the retry
+                    # flush re-encodes them with a FRESH sent_us stamp
+                    # (a re-queued stale stamp would book the whole
+                    # reconnect outage as per-link network time) and the
+                    # data-frame accounting below never counts it
+                    ctxs = list(peer.trace_pending)
+                    peer.trace_pending.clear()
+                elif peer.trace_pending:
+                    # tracing disarmed between enqueue and flush: drop the
+                    # stale contexts instead of letting them accumulate
+                    peer.trace_pending.clear()
                 try:
                     blob = b"".join(pending)
+                    if ctxs:
+                        blob += encode_frame(FT_TRACE, encode(TraceFrame(
+                            origin=self.self_id,
+                            sent_us=int(time.monotonic() * 1e6),
+                            entries=ctxs,
+                        )))
                     writer.write(blob)
                     await writer.drain()
                 except BaseException:
@@ -444,10 +590,15 @@ class SocketComm(Comm):
                     # front (new frames may have arrived behind it) so the
                     # reconnect delivers it instead of silently losing it
                     peer.outbox.extendleft(reversed(pending))
+                    if ctxs:
+                        peer.trace_pending.extendleft(reversed(ctxs))
                     raise
                 self.metrics.flush_batches += 1
                 self.metrics.frames_sent += batch_len
                 self.metrics.bytes_sent += len(blob)
+                if ctxs:
+                    self.metrics.trace_frames_sent += 1
+                    self.metrics.trace_ctxs_sent += len(ctxs)
             if self._closing and not peer.outbox:
                 return
 
@@ -515,12 +666,16 @@ class SocketComm(Comm):
             return
         # -- steady state: read -> decode frames -> batch-dispatch
         try:
+            recv_t = time.monotonic()  # covers handshake-leftover frames
             while True:
                 if frames:
-                    await self._dispatch(sender, frames)
+                    await self._dispatch(sender, frames, recv_t)
                 data = await reader.read(READ_CHUNK)
                 if not data:
                     return  # peer closed cleanly (its reconnect, our EOF)
+                # the batch's arrival instant, captured BEFORE dispatch
+                # awaits consensus handling (net.recv timestamps use it)
+                recv_t = time.monotonic()
                 frames = decoder.feed(data)
         except (FrameError, CodecError) as e:
             # poisoned stream: drop THIS connection loudly; the peer's
@@ -533,10 +688,13 @@ class SocketComm(Comm):
                 sender, e,
             )
 
-    async def _dispatch(self, sender: int, frames: list) -> None:
+    async def _dispatch(self, sender: int, frames: list,
+                        recv_t: Optional[float] = None) -> None:
         """Decode (interned) and route one read's frames, preserving
         arrival order across kinds — the socket twin of testing.network.
-        Node._dispatch, with the same disjoint plane accounting."""
+        Node._dispatch, with the same disjoint plane accounting.
+        ``recv_t`` is the batch's socket read time (see
+        :meth:`_on_trace_frame`)."""
         if sender in self._dropped_links:
             self.metrics.link_dropped += len(frames)
             return
@@ -568,6 +726,9 @@ class SocketComm(Comm):
                 elif ftype == FT_REJECT:
                     await self._flush_consensus(run)
                     self._on_reject_frame(sender, payload)
+                elif ftype == FT_TRACE:
+                    await self._flush_consensus(run)
+                    self._on_trace_frame(sender, payload, recv_t)
                 elif ftype == FT_SYNC_REQ:
                     await self._flush_consensus(run)
                     self._serve_sync(sender, payload)

@@ -12,6 +12,10 @@ smartbft_tpu.obs.report`` renders a recorder dump as a text timeline +
 per-span-type percentile summary.
 """
 
+from .critpath import (  # noqa: F401
+    SEGMENTS,
+    assemble_critical_path_block,
+)
 from .recorder import (  # noqa: F401
     NOP_RECORDER,
     NopRecorder,
@@ -27,8 +31,10 @@ from .vcphases import (  # noqa: F401
 __all__ = [
     "NOP_RECORDER",
     "NopRecorder",
+    "SEGMENTS",
     "SpanEvent",
     "TraceRecorder",
+    "assemble_critical_path_block",
     "assemble_trace_block",
     "ViewChangePhaseTracker",
     "assemble_viewchange_block",

@@ -24,6 +24,7 @@ site and allocates nothing.
 from __future__ import annotations
 
 import json
+import threading
 import time
 from typing import Optional, Sequence
 
@@ -41,10 +42,14 @@ __all__ = [
 class SpanEvent:
     """One structured trace event.  ``dur`` >= 0 marks a completed span
     (seconds); -1 marks a point event.  Unset correlators stay at their
-    sentinel (-1 / "") and are omitted from the dict form."""
+    sentinel (-1 / "") and are omitted from the dict form.  ``seqno`` is
+    the recorder-assigned all-time event sequence (1-based) — the
+    incremental-pull cursor compares against it EXACTLY, so a snapshot
+    racing a concurrent record (the WAL executor thread) can never skip
+    or double-ship an event."""
 
     __slots__ = ("t", "kind", "node", "key", "view", "seq", "epoch",
-                 "launch", "dur", "extra")
+                 "launch", "dur", "extra", "seqno")
 
     def __init__(self, t: float, kind: str, node: str = "", key: str = "",
                  view: int = -1, seq: int = -1, epoch: int = -1,
@@ -60,6 +65,7 @@ class SpanEvent:
         self.launch = launch
         self.dur = dur
         self.extra = extra
+        self.seqno = 0
 
     def as_dict(self) -> dict:
         out = {"t": round(self.t, 6), "kind": self.kind}
@@ -96,6 +102,13 @@ class TraceRecorder:
         self._buf: list = [None] * self.capacity
         self._idx = 0
         self.recorded = 0
+        # recorders are fed from the event loop AND executor threads (the
+        # WAL group-commit fsync spans): the ring/seqno update is a
+        # read-modify-write, so it takes a lock — uncontended acquire is
+        # ~100 ns next to the event construction it guards, and without
+        # it two racing records share one slot + seqno, breaking the
+        # events_since exactness contract and the dropped count
+        self._write_lock = threading.Lock()
         #: all-time per-kind event counts (bounded like the span dict)
         self.kind_counts: dict[str, int] = {}
         #: per-kind duration histograms for events carrying ``dur``
@@ -114,38 +127,79 @@ class TraceRecorder:
     def record(self, kind: str, *, node: str = "", key: str = "",
                view: int = -1, seq: int = -1, epoch: int = -1,
                launch: int = -1, dur: float = -1.0,
-               extra: Optional[dict] = None) -> SpanEvent:
-        ev = SpanEvent(self._clock(), kind, node or self.node, key, view,
+               extra: Optional[dict] = None,
+               t: Optional[float] = None) -> SpanEvent:
+        """``t`` overrides the event timestamp (SAME clock domain as the
+        recorder's): for marks whose true instant precedes the record
+        call — the transport stamps ``net.recv`` with the socket READ
+        time so per-hop network time excludes the consensus processing
+        awaited between read and record."""
+        ev = SpanEvent(t if t is not None else self._clock(), kind,
+                       node or self.node, key, view,
                        seq, epoch, launch, dur, extra)
-        self._buf[self._idx] = ev
-        self._idx = (self._idx + 1) % self.capacity
-        self.recorded += 1
-        ck = self._bounded_kind(self.kind_counts, kind)
-        self.kind_counts[ck] = self.kind_counts.get(ck, 0) + 1
-        if dur >= 0.0:
-            sk = self._bounded_kind(self.spans, kind)
-            hist = self.spans.get(sk)
-            if hist is None:
-                hist = self.spans[sk] = LogScaleHistogram()
-            hist.observe(dur)
+        with self._write_lock:
+            seqno = self.recorded + 1
+            ev.seqno = seqno
+            self._buf[self._idx] = ev
+            self._idx = (self._idx + 1) % self.capacity
+            self.recorded = seqno
+            ck = self._bounded_kind(self.kind_counts, kind)
+            self.kind_counts[ck] = self.kind_counts.get(ck, 0) + 1
+            if dur >= 0.0:
+                sk = self._bounded_kind(self.spans, kind)
+                hist = self.spans.get(sk)
+                if hist is None:
+                    hist = self.spans[sk] = LogScaleHistogram()
+                hist.observe(dur)
         return ev
 
     # -- reading -----------------------------------------------------------
 
     def events(self, last: Optional[int] = None) -> list:
         """The buffered events in chronological (record) order, optionally
-        only the newest ``last``."""
-        if self.recorded >= self.capacity:
-            ordered = self._buf[self._idx:] + self._buf[:self._idx]
-        else:
-            ordered = self._buf[:self._idx]
-        out = [e for e in ordered if e is not None]
+        only the newest ``last``.  Takes the write lock: an unlocked read
+        racing a wrapped-ring record() between its slot write and index
+        advance would rotate the newest event to the FRONT of the list,
+        breaking chronological order and the since-cursor exactness
+        (cursor = out[-1].seqno would under-report an already-shipped
+        event).  Reads are control-channel-rate, so the lock never
+        contends the hot path."""
+        with self._write_lock:
+            if self.recorded >= self.capacity:
+                ordered = self._buf[self._idx:] + self._buf[:self._idx]
+            else:
+                ordered = self._buf[:self._idx]
+            out = [e for e in ordered if e is not None]
         if last is not None and last >= 0:
             out = out[-last:] if last else []
         return out
 
     def snapshot(self, last: Optional[int] = None) -> list[dict]:
         return [e.as_dict() for e in self.events(last)]
+
+    def events_since(self, since: int) -> tuple[list, int]:
+        """Incremental read for repeated pulls: the buffered events
+        recorded AFTER cursor ``since``, plus the next cursor.
+
+        The cursor is an event's all-time ``seqno`` (0 means "from the
+        beginning"); the filter compares EXACTLY against each buffered
+        event's own sequence number, so a snapshot racing a concurrent
+        ``record`` (recorders are fed from executor threads too — the
+        WAL fsync spans) can never skip or double-ship: an event that
+        missed this snapshot keeps a seqno above the returned cursor and
+        ships next pull.  Events the ring already overwrote are gone — a
+        puller more than ``capacity`` events behind gets only the
+        surviving tail (the gap is visible as ``dropped`` growth) — and
+        a cursor from the future (stale after a recorder restart) stays
+        at "nothing new".  This is what keeps ``cmd=trace`` pulls O(new
+        events) instead of re-shipping the whole ring every poll."""
+        since = max(0, int(since))
+        out = [e for e in self.events() if e.seqno > since]
+        return out, (out[-1].seqno if out else since)
+
+    def snapshot_since(self, since: int) -> tuple[list[dict], int]:
+        events, cursor = self.events_since(since)
+        return [e.as_dict() for e in events], cursor
 
     def trace_block(self) -> dict:
         """The JSON-able ``trace`` summary block (bench rows, cmd=trace)."""
@@ -197,6 +251,12 @@ class NopRecorder:
 
     def snapshot(self, last: Optional[int] = None) -> list:
         return []
+
+    def events_since(self, since: int) -> tuple[list, int]:
+        return [], 0
+
+    def snapshot_since(self, since: int) -> tuple[list, int]:
+        return [], 0
 
     def trace_block(self) -> dict:
         return {"enabled": False}

@@ -8,10 +8,23 @@ the earliest event) followed by a per-span-type percentile summary over
 the events that carry durations, plus derived submit→deliver spans
 joined by request key when both ends are present.
 
+**Cluster timelines (ISSUE 13).**  Multi-PROCESS dumps live on different
+monotonic clocks; a dump carrying ``clock_offset_s`` (written by
+``SocketCluster.cluster_timeline`` from the control-channel ping
+midpoint estimate) has every event timestamp shifted by ``-offset``
+during the merge, so N replicas' rings interleave on ONE causally-
+ordered timeline with a stated error bound (RTT/2 per replica).  When
+offsets are known, ``net.recv`` sidecar events additionally yield a
+per-directed-link network-time summary: receiver ingest (skew-adjusted)
+minus the sender's flush stamp (``extra.sent_us``, mapped through the
+SENDER's offset).
+
 Usage::
 
     python -m smartbft_tpu.obs.report run/flight-*.json [--last N]
     python -m smartbft_tpu.obs.report dump.json --summary-only
+    python -m smartbft_tpu.obs.report run/flight-*.json \
+        --offsets run/offsets.json   # {"n1": {"offset_s": ...}, ...}
 """
 
 from __future__ import annotations
@@ -22,7 +35,7 @@ from typing import Optional
 
 from .recorder import pct as _pct
 
-__all__ = ["load_dump", "render", "main"]
+__all__ = ["load_dump", "merged_events", "link_summary", "render", "main"]
 
 
 def load_dump(path: str) -> dict:
@@ -33,6 +46,75 @@ def load_dump(path: str) -> dict:
     if "events" not in data:
         raise ValueError(f"{path}: not a flight-recorder dump (no 'events')")
     return data
+
+
+def merged_events(dumps: list[dict]) -> list[dict]:
+    """Fold N dumps into one chronologically-sorted event list.
+
+    Each event gets its dump's ``node`` label (when the event lacks one)
+    and — the clock-alignment step — its timestamp shifted by the dump's
+    ``clock_offset_s`` so every replica's monotonic clock maps onto the
+    estimator's (parent's) timeline: ``t_cluster = t_replica - offset``.
+    Dumps without an offset merge unshifted (the single-process case,
+    where all recorders already share one clock).  Pure function."""
+    events: list[dict] = []
+    for d in dumps:
+        node = d.get("node", "")
+        off = float(d.get("clock_offset_s", 0.0) or 0.0)
+        for ev in d.get("events", []):
+            if (node and "node" not in ev) or off:
+                ev = dict(ev)
+                if node and "node" not in ev:
+                    ev["node"] = node
+                if off:
+                    ev["t"] = ev.get("t", 0.0) - off
+            events.append(ev)
+    events.sort(key=lambda e: e.get("t", 0.0))
+    return events
+
+
+def link_summary(events: list[dict], offsets: dict) -> list[dict]:
+    """Per-directed-link network time from ``net.recv`` sidecar events.
+
+    ``events`` must already be clock-aligned (:func:`merged_events`);
+    ``offsets`` maps node label -> offset seconds (the SENDER's stamp
+    ``extra.sent_us`` is in the sender's clock and needs its own
+    offset).  Per hop: ``net_ms = (t_recv_aligned - (sent_us/1e6 -
+    offset_sender)) * 1e3``.  In a multi-clock merge (``offsets``
+    non-empty) a hop needs BOTH endpoints' offsets known — rows whose
+    sender or receiver clock is unestimated are skipped rather than
+    published with unbounded skew.  Returns one row per directed link
+    with exact percentiles — the WAN-profile work (ROADMAP item 5)
+    reads per-link time straight off this table."""
+    links: dict[tuple, list] = {}
+    for ev in events:
+        if ev.get("kind") != "net.recv":
+            continue
+        extra = ev.get("extra") or {}
+        sent_us = extra.get("sent_us")
+        frm = extra.get("from")
+        if sent_us is None or frm is None:
+            continue
+        sender = f"n{frm}"
+        off = offsets.get(sender)
+        if offsets and (off is None or ev.get("node", "?") not in offsets):
+            continue  # an endpoint's clock was never aligned: skip
+        if off is None:
+            off = 0.0  # single-clock run: no shift needed anywhere
+        net_ms = (ev.get("t", 0.0) - (sent_us / 1e6 - off)) * 1e3
+        links.setdefault((sender, ev.get("node", "?")), []).append(net_ms)
+    rows = []
+    for (a, b), vals in sorted(links.items()):
+        vals.sort()
+        rows.append({
+            "link": f"{a}->{b}",
+            "count": len(vals),
+            "p50_ms": round(_pct(vals, 0.50), 3),
+            "p95_ms": round(_pct(vals, 0.95), 3),
+            "p99_ms": round(_pct(vals, 0.99), 3),
+            "max_ms": round(vals[-1], 3),
+        })
+    return rows
 
 
 def _fmt_event(ev: dict, t0: float) -> str:
@@ -83,20 +165,16 @@ def _summary_rows(events: list[dict]) -> list[tuple]:
 
 def render(dumps: list[dict], *, last: Optional[int] = None,
            summary_only: bool = False) -> str:
-    """Merged text timeline + per-span-type percentile summary."""
-    events: list[dict] = []
-    for d in dumps:
-        node = d.get("node", "")
-        for ev in d.get("events", []):
-            if node and "node" not in ev:
-                ev = dict(ev, node=node)
-            events.append(ev)
-    events.sort(key=lambda e: e.get("t", 0.0))
+    """Merged (clock-aligned when offsets present) text timeline +
+    per-span-type percentile summary + per-link network times."""
+    events = merged_events(dumps)
+    aligned = any(d.get("clock_offset_s") for d in dumps)
     if last is not None and last >= 0:
         events = events[-last:] if last else []
     out: list[str] = []
     header = (f"flight recorder: {len(dumps)} dump(s), "
               f"{len(events)} event(s)"
+              + (", clock-aligned" if aligned else "")
               + (f", dropped {sum(d.get('dropped', 0) for d in dumps)}"
                  if any(d.get("dropped") for d in dumps) else ""))
     out.append(header)
@@ -114,6 +192,20 @@ def render(dumps: list[dict], *, last: Optional[int] = None,
         for kind, n, p50, p95, p99, mx in rows:
             out.append(f"  {kind:<24} {n:>6} {p50:>10.3f} {p95:>10.3f} "
                        f"{p99:>10.3f} {mx:>10.3f}")
+    offsets = {d.get("node", ""): d.get("clock_offset_s", 0.0)
+               for d in dumps
+               if d.get("node") and d.get("offset_known", True)}
+    hops = link_summary(events, offsets if aligned else {})
+    if hops:
+        out.append("")
+        out.append("per-link network time (ms"
+                   + (", skew-adjusted" if aligned else "") + "):")
+        out.append(f"  {'link':<12} {'count':>6} {'p50':>10} {'p95':>10} "
+                   f"{'p99':>10} {'max':>10}")
+        for h in hops:
+            out.append(f"  {h['link']:<12} {h['count']:>6} "
+                       f"{h['p50_ms']:>10.3f} {h['p95_ms']:>10.3f} "
+                       f"{h['p99_ms']:>10.3f} {h['max_ms']:>10.3f}")
     return "\n".join(out) + "\n"
 
 
@@ -127,8 +219,28 @@ def main(argv: Optional[list[str]] = None) -> int:
                     help="only the newest N merged events")
     ap.add_argument("--summary-only", action="store_true",
                     help="skip the timeline, print only the span summary")
+    ap.add_argument("--offsets", default=None,
+                    help="JSON file of per-node clock offsets "
+                         "({\"n1\": {\"offset_s\": ...}, ...} — "
+                         "SocketCluster.cluster_timeline writes one); "
+                         "applied to dumps lacking an embedded offset")
     args = ap.parse_args(argv)
     dumps = [load_dump(p) for p in args.dumps]
+    if args.offsets:
+        with open(args.offsets) as fh:
+            offs = json.load(fh)
+        for d in dumps:
+            if "clock_offset_s" not in d:
+                known = d.get("node", "") in offs
+                entry = offs.get(d.get("node", ""), {})
+                d["clock_offset_s"] = (
+                    entry.get("offset_s", 0.0)
+                    if isinstance(entry, dict) else float(entry)
+                )
+                # a node ABSENT from the offsets file merges with an
+                # UNKNOWN clock — flag it so its per-link rows are
+                # skipped, not published with assumed-zero skew
+                d["offset_known"] = known
     print(render(dumps, last=args.last, summary_only=args.summary_only),
           end="")
     return 0

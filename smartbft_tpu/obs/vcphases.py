@@ -79,6 +79,11 @@ class ViewChangePhaseTracker:
         self._records: deque = deque(maxlen=max(int(keep), 1))
         self.spans = {p: LogScaleHistogram() for p in PHASES}
         self.total_hist = LogScaleHistogram()
+        #: heartbeat-timeout arm-to-fire samples (ms, bounded) — the
+        #: DETECTION latency round 15 blamed for ~99% of the VC cliff,
+        #: now a first-class column of the viewchange bench block
+        self._detections: deque = deque(maxlen=max(int(keep), 1))
+        self.detections_total = 0
 
     # -- marks (ViewChanger) ----------------------------------------------
 
@@ -100,6 +105,21 @@ class ViewChangePhaseTracker:
         rec = self.recorder
         if rec.enabled:
             rec.record("vc.armed", node=self.node, view=next_view)
+
+    def detection(self, seconds: float) -> None:
+        """A heartbeat/complain timer FIRED after ``seconds`` of armed
+        silence (HeartbeatMonitor hook).  No tracing required: the sample
+        feeds the viewchange metrics bundle (gauge + counter) and the
+        bounded pool the bench block summarizes."""
+        ms = max(seconds, 0.0) * 1e3
+        self._detections.append(ms)
+        self.detections_total += 1
+        if self.metrics is not None:
+            self.metrics.heartbeat_detection_seconds.set(max(seconds, 0.0))
+            self.metrics.count_heartbeat_timeouts.add(1)
+        rec = self.recorder
+        if rec.enabled:
+            rec.record("vc.detected", node=self.node, dur=max(seconds, 0.0))
 
     def _mark(self, name: str, kind: str, view: int) -> None:
         if not self.open or view < self._view or name in self._marks:
@@ -125,9 +145,12 @@ class ViewChangePhaseTracker:
 
     # -- closure (Controller) ---------------------------------------------
 
-    def decision(self, view: int) -> None:
+    def decision(self, view: int, backlog: int = -1) -> None:
         """A decision delivered; the first one at/after the VC's view with
-        the NewView processed closes the open round as COMPLETED."""
+        the NewView processed closes the open round as COMPLETED.
+        ``backlog`` (when >= 0) is the caller's request-pool depth at the
+        flip — the stalled work the new view must drain, the other half
+        of the round-15 cliff."""
         if not self.open or "newview" not in self._marks \
                 or view < self._view:
             return
@@ -148,14 +171,19 @@ class ViewChangePhaseTracker:
             self.spans[phase].observe(dt)
         self.total_hist.observe(total)
         self.completed_total += 1
-        self._records.append({
+        record = {
             "view": self._view,
             "node": self.node,
             "total_ms": round(total * 1e3, 3),
             "phases": {p: round(dt * 1e3, 3) for p, dt in phases.items()},
-        })
+        }
+        if backlog >= 0:
+            record["backlog_at_flip"] = backlog
+        self._records.append(record)
         if self.metrics is not None:
             self.metrics.time_in_view_change.set(total)
+            if backlog >= 0:
+                self.metrics.backlog_at_view_flip.set(backlog)
         rec = self.recorder
         if rec.enabled:
             rec.record("vc.complete", node=self.node, view=self._view,
@@ -247,10 +275,31 @@ def assemble_viewchange_block(trackers: Sequence["ViewChangePhaseTracker"]
         (p for p in phases if phases[p]["count"]),
         key=lambda p: phases[p]["share"], default=None,
     )
+    detections = sorted(d for t in trackers
+                        for d in getattr(t, "_detections", ()))
+    backlogs = sorted(r["backlog_at_flip"] for r in recs
+                      if "backlog_at_flip" in r)
     return {
         "count": len(recs),
         "rounds": sum(t.rounds for t in trackers),
         "abandoned": sum(t.abandoned for t in trackers),
+        # ROADMAP item 1 gauges: complain-timer arm-to-fire time (the
+        # detection latency that precedes every armed round) and the
+        # per-replica pool backlog at the view flip (the stalled work the
+        # new view drains) — both measured, no tracing required
+        "detection": {
+            "count": sum(getattr(t, "detections_total", 0)
+                         for t in trackers),
+            "p50_ms": round(_pct(detections, 0.50), 3),
+            "p95_ms": round(_pct(detections, 0.95), 3),
+            "p99_ms": round(_pct(detections, 0.99), 3),
+            "max_ms": round(detections[-1], 3) if detections else 0.0,
+        },
+        "backlog_at_flip": {
+            "count": len(backlogs),
+            "p50": _pct(backlogs, 0.50),
+            "max": backlogs[-1] if backlogs else 0,
+        },
         "end_to_end": {
             "count": len(totals),
             "p50_ms": round(_pct(totals, 0.50), 3),

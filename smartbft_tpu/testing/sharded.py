@@ -594,6 +594,22 @@ class ShardedCluster:
 
         return assemble_trace_block(self.trace_recorders())
 
+    def trace_events(self) -> list[dict]:
+        """Every recorder's buffered events merged chronologically — ONE
+        timeline already (all recorders share the cluster scheduler
+        clock), the input obs.critpath.assemble_critical_path_block
+        decomposes.  [] when untraced."""
+        events = [e for r in self.trace_recorders() for e in r.snapshot()]
+        events.sort(key=lambda e: e.get("t", 0.0))
+        return events
+
+    def critical_path_block(self, **kw) -> dict:
+        """The per-request critical-path decomposition over this
+        cluster's merged timeline (pure assemble; see obs.critpath)."""
+        from ..obs import assemble_critical_path_block
+
+        return assemble_critical_path_block(self.trace_events(), **kw)
+
     def vc_trackers(self) -> list:
         """Every live replica's view-change phase tracker — the
         ``viewchange`` bench-row block's input (always available; the

@@ -224,6 +224,35 @@ def test_socket_cluster_trace_and_metrics_pull(tmp_path):
         tail = cluster.trace_pull(leader, last=2)["events"]
         assert len(tail) == 2
 
+        # incremental pull (ISSUE 13): the since cursor ships only NEW
+        # events on the next poll instead of re-sending the whole ring
+        cursor = resp["next_since"]
+        assert cursor >= len(resp["events"])
+        again = cluster.trace_pull(leader, since=cursor)
+        assert again["events"] == []
+        cluster.submit(leader, "obs", "req-cursor")
+        cluster.wait_committed(4, timeout=60.0)
+        fresh = cluster.trace_pull(leader, since=cursor)
+        assert 0 < len(fresh["events"]) < len(resp["events"]) + 16
+        assert fresh["next_since"] > cursor
+
+        # clock-offset estimation + ONE merged cluster timeline with
+        # per-link network times (the FT_TRACE sidecar's receive side)
+        offsets = cluster.estimate_clock_offsets()
+        assert set(offsets) == {f"n{i}" for i in cluster.live_ids()}
+        for o in offsets.values():
+            assert o["rtt_s"] > 0
+            assert abs(o["err_bound_s"] - o["rtt_s"] / 2.0) <= 1e-6
+        timeline = cluster.cluster_timeline(str(tmp_path / "timeline"))
+        assert timeline["events"] > 0
+        assert timeline["hops"], "no per-link network times measured"
+        for hop in timeline["hops"]:
+            assert hop["count"] > 0
+        assert (tmp_path / "timeline" / "offsets.json").exists()
+        merged = render(timeline["dumps"], summary_only=True)
+        assert "clock-aligned" in merged
+        assert "per-link network time" in merged
+
         # cmd=metrics: Prometheus text exposition with live counters
         text = cluster.metrics_text(leader)
         assert "# TYPE consensus_view_number gauge" in text
