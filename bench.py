@@ -76,6 +76,16 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 REPS = int(os.environ.get("SMARTBFT_BENCH_REPS", "9"))  # tunnel run-to-run
 # variance is +/-15%; a 9-rep median costs ~1.5s and stabilizes the metric
 
+#: every headline row emitted this run, in order — the input to the
+#: longitudinal baseline guard (--check-baseline)
+EMITTED_ROWS: list = []
+
+
+def _emit(row: dict) -> None:
+    """Print one headline JSON row AND retain it for --check-baseline."""
+    EMITTED_ROWS.append(row)
+    print(json.dumps(row), flush=True)
+
 
 def _resolve_batch(cpu: bool) -> int:
     """TPU: batch 131072 on the comb kernel.  Per-launch overhead through
@@ -267,8 +277,18 @@ def e2e_bench(cpu_mode: bool) -> None:
         cpu_mode=cpu_mode, timeout=timeout,
     )
     _log(f"bench: device row {dev_row}")
+    _emit(assemble_e2e_row(dev_row, cpu_row, nodes=nodes,
+                           pipeline=pipeline, decisions=decisions))
+
+
+def assemble_e2e_row(dev_row: dict, cpu_row: dict, *, nodes: int,
+                     pipeline: int, decisions: int) -> dict:
+    """Fold the device + best-CPU throughput rows into the ONE north-star
+    bench line.  Pure function, importable — the schema drift gate
+    (obs.benchschema, tests) pins the ``committed_tx_per_sec_n*`` family
+    through it exactly as tests pin the open-loop and mesh rows."""
     norm_tx = _probe_normalized_tx(dev_row)
-    print(json.dumps({
+    return {
         "metric": f"committed_tx_per_sec_n{nodes}",
         "value": dev_row["tx_per_sec"],
         "unit": "tx/s",
@@ -298,7 +318,7 @@ def e2e_bench(cpu_mode: bool) -> None:
         "vs_baseline_probe_normalized": round(
             norm_tx / cpu_row["tx_per_sec"], 3)
         if norm_tx and cpu_row["tx_per_sec"] else 0.0,
-    }), flush=True)
+    }
 
 
 def sharded_bench(shards: str, cpu_mode: bool) -> None:
@@ -332,13 +352,21 @@ def sharded_bench(shards: str, cpu_mode: bool) -> None:
             f"sharded sweep failed: {proc.stderr.decode(errors='replace')[-400:]}"
         )
     rows = [json.loads(l) for l in proc.stdout.decode().splitlines() if l.strip()]
+    _emit(assemble_sharded_row(rows))
+
+
+def assemble_sharded_row(rows: list) -> dict:
+    """Fold benchmarks/sharded.py's JSON lines into the ONE bench.py
+    sharded row.  Pure function, importable — the schema drift gate pins
+    the ``sharded_committed_tx_per_sec`` family through it (PR 8
+    idiom)."""
     points = [r for r in rows if "shards" in r and "tx_per_sec" in r]
     scaling = next((r for r in rows if r.get("metric") == "sharded_scaling"), {})
     resize = next((r for r in rows if r.get("metric") == "live_resize"), {})
     if not points:
         raise RuntimeError("sharded sweep produced no rows")
     peak = max(points, key=lambda r: r["shards"])
-    print(json.dumps({
+    return {
         "metric": "sharded_committed_tx_per_sec",
         "value": peak["tx_per_sec"],
         "unit": "tx/s",
@@ -366,7 +394,7 @@ def sharded_bench(shards: str, cpu_mode: bool) -> None:
             "tracking_vs_first": resize.get("tracking_vs_first"),
             **(resize.get("reshard") or {}),
         } if resize else None,
-    }), flush=True)
+    }
 
 
 def assemble_mesh_row(rows: list) -> dict:
@@ -479,7 +507,7 @@ def mesh_bench(devices: str, cpu_mode: bool) -> None:
         )
     rows = [json.loads(l) for l in proc.stdout.decode().splitlines()
             if l.strip()]
-    print(json.dumps(assemble_mesh_row(rows)), flush=True)
+    _emit(assemble_mesh_row(rows))
 
 
 def assemble_open_loop_row(rows: list) -> dict:
@@ -534,6 +562,10 @@ def assemble_open_loop_row(rows: list) -> dict:
         # sums == end-to-end within the stated residual; per-phase
         # sub-blocks name each degraded phase's dominant segment)
         "critical_path": degraded.get("critical_path"),
+        # ISSUE 14: the continuous SLO verdict over the degraded walk
+        # (final state + every healthy/degraded/critical transition with
+        # the breaching SLO names)
+        "health": degraded.get("health"),
         "sweep": [
             {k: r.get(k) for k in ("offered_per_sec", "goodput_per_sec")}
             | {"p99_ms": r["latency"]["p99_ms"],
@@ -583,7 +615,7 @@ def open_loop_bench(cpu_mode: bool) -> None:
         )
     rows = [json.loads(l) for l in proc.stdout.decode().splitlines()
             if l.strip()]
-    print(json.dumps(assemble_open_loop_row(rows)), flush=True)
+    _emit(assemble_open_loop_row(rows))
 
 
 def transport_bench(flavor: str) -> None:
@@ -610,14 +642,23 @@ def transport_bench(flavor: str) -> None:
             f"{proc.stderr.decode(errors='replace')[-400:]}"
         )
     rows = [json.loads(l) for l in proc.stdout.decode().splitlines() if l.strip()]
+    _emit(assemble_transport_row(rows, flavor))
+
+
+def assemble_transport_row(rows: list, flavor: str) -> dict:
+    """Fold benchmarks/transport.py's JSON lines into the ONE bench.py
+    transport row.  Pure function, importable — the schema drift gate
+    pins the ``transport_committed_tx_per_sec`` family through it."""
     by_flavor = {r["flavor"]: r for r in rows if r.get("bench") == "transport"}
+    if not by_flavor:
+        raise RuntimeError("transport bench produced no rows")
     paired = next((r for r in rows if r.get("metric") == "transport_paired"), {})
     cluster_trace = next(
         (r for r in rows if r.get("metric") == "cluster_timeline"), None
     )
     main_row = by_flavor.get(flavor) or next(iter(by_flavor.values()))
     inproc = by_flavor.get("inproc", {})
-    print(json.dumps({
+    return {
         "metric": "transport_committed_tx_per_sec",
         "value": main_row["tx_per_sec"],
         "unit": "tx/s",
@@ -634,7 +675,7 @@ def transport_bench(flavor: str) -> None:
         # (clock offsets + per-link network time + merged critical path)
         "critical_path": main_row.get("critical_path"),
         "cluster_trace": cluster_trace,
-    }), flush=True)
+    }
 
 
 def main() -> None:
@@ -671,6 +712,15 @@ def main() -> None:
              "Network and through real sockets on localhost, emitting a "
              "`transport` block (bytes on the wire, frames/flush, "
              "reconnects) in the JSON row",
+    )
+    ap.add_argument(
+        "--check-baseline", nargs="?", const="BASELINE_OBS.json",
+        default=os.environ.get("SMARTBFT_BENCH_CHECK_BASELINE", ""),
+        help="after every selected bench ran, diff the emitted rows (plus "
+             "the deterministic tiny logical-clock row) against the pinned "
+             "baseline file (default BASELINE_OBS.json) and exit non-zero "
+             "on regression or schema drift — the longitudinal guard "
+             "(smartbft_tpu.obs.baseline)",
     )
     args, _unknown = ap.parse_known_args()
 
@@ -712,11 +762,54 @@ def main() -> None:
     if os.environ.get("SMARTBFT_BENCH_E2E", "1") == "1":
         try:
             e2e_bench(cpu_mode)
-            return
         except Exception as exc:  # noqa: BLE001 — any bench failure
             _log(f"bench: e2e cluster bench failed ({type(exc).__name__}: "
                  f"{exc}); falling back to the kernel micro bench")
-    kernel_bench(cpu_mode)
+            kernel_bench(cpu_mode)
+    else:
+        kernel_bench(cpu_mode)
+
+    if args.check_baseline:
+        raise SystemExit(check_baseline(args.check_baseline))
+
+
+def check_baseline(path: str) -> int:
+    """The longitudinal regression gate: diff this run's emitted rows —
+    plus the deterministic tiny logical-clock row, so the gate always
+    has at least one comparable metric — against the pinned baseline.
+    Returns the process exit code (non-zero on regression/drift)."""
+    from smartbft_tpu.obs.baseline import (
+        check_rows, load_baseline, render_check, tiny_logical_row,
+    )
+
+    rows = list(EMITTED_ROWS)
+    tiny_failed = False
+    try:
+        rows.append(tiny_logical_row())
+    except Exception as exc:  # noqa: BLE001 — the gate still checks the
+        _log(f"bench: tiny logical row failed ({exc!r})")  # emitted rows
+        tiny_failed = True
+    result = check_rows(rows, load_baseline(path))
+    _log(render_check(result))
+    # a gate that compared NOTHING verified nothing: an empty comparison
+    # (every bench failed AND the tiny row failed) must read as failure,
+    # not as green — that is exactly the most-broken state
+    vacuous = not result["checked"]
+    ok = result["ok"] and not vacuous and not tiny_failed
+    if vacuous:
+        _log("bench: baseline check compared ZERO metrics — failing the "
+             "gate (a vacuous check is not a passing one)")
+    print(json.dumps({
+        "metric": "baseline_check",
+        "baseline": path,
+        "ok": ok,
+        "vacuous": vacuous,
+        "tiny_row_failed": tiny_failed,
+        "checked": result["checked"],
+        "regressions": result["regressions"],
+        "schema_errors": result["schema_errors"],
+    }), flush=True)
+    return 0 if ok else 1
 
 
 def kernel_bench(cpu_mode: bool) -> None:
@@ -836,7 +929,7 @@ def kernel_bench(cpu_mode: bool) -> None:
 
     from smartbft_tpu.metrics import protocol_plane_snapshot
 
-    print(json.dumps({
+    _emit({
         "metric": "p256_sig_verify_p50_us",
         "value": round(device_us, 2),
         "unit": "us/sig",
@@ -847,7 +940,7 @@ def kernel_bench(cpu_mode: bool) -> None:
         # (all-zero) process snapshot — present in EVERY bench row by
         # contract so downstream tooling can rely on the key
         "protocol_plane": protocol_plane_snapshot(),
-    }), flush=True)
+    })
 
 
 if __name__ == "__main__":

@@ -239,9 +239,26 @@ async def run_degraded(args) -> dict:
     zipf = ZipfClients(args.clients, skew=args.zipf)
     tracker = cluster.set.latency
     notes: dict = {}
+    health_task = None
     try:
         driver.start()
         await cluster.start()
+
+        # continuous SLO evaluation (ISSUE 14): the cluster monitor ticks
+        # on the wall-driven scheduler throughout the degraded walk, so
+        # the row carries the verdict TRANSITIONS (healthy -> degraded
+        # with the breaching SLO named -> healthy) next to the phases
+        # that caused them
+        async def health_loop() -> None:
+            while True:
+                try:
+                    cluster.health.tick()
+                except Exception:  # noqa: BLE001 — judged, never judging
+                    pass
+                await asyncio.sleep(0.1)
+
+        health_task = create_logged_task(health_loop(),
+                                         name="openloop-health")
 
         async def quiesce_stamps() -> bool:
             """Wait until every stamped request has committed (polling the
@@ -371,9 +388,17 @@ async def run_degraded(args) -> dict:
             "viewchange": viewchange,
             "trace": trace,
             "critical_path": critical,
+            # ISSUE 14: the continuous verdict over the whole degraded
+            # walk — final state + every transition with its SLO names
+            "health": {
+                "final": cluster.health.verdict(),
+                "transitions": cluster.health.transition_log(),
+            },
             "latency": snap,
         }
     finally:
+        if health_task is not None:
+            health_task.cancel()
         try:
             await cluster.stop()
         except Exception:

@@ -60,6 +60,18 @@ class Configuration:
     request_complain_timeout: float = 20.0
     request_auto_remove_timeout: float = 180.0
 
+    # RTT-derived forward timing (no reference counterpart — the
+    # reference's forward timeout is a constant; round 16's cluster
+    # timeline measured follower-submitted requests spending 97.6% of
+    # their latency waiting out that constant).  When > 0 and the
+    # transport measures RTT (smartbft_tpu.net.SocketComm does, from
+    # dial and sync round trips), the EFFECTIVE forward timeout becomes
+    # clamp(multiplier * measured_rtt, 10 ms, request_forward_timeout):
+    # the configured constant stays the ceiling and the fallback (no
+    # transport measurement, in-process Comm, cold links).  0 (default)
+    # keeps the constant — reference-faithful.
+    request_forward_rtt_multiplier: float = 0.0
+
     # View change (config.go:47-51)
     view_change_resend_interval: float = 5.0
     view_change_timeout: float = 20.0
@@ -294,6 +306,11 @@ class Configuration:
             )
         if self.verify_launch_retries < 0:
             raise ConfigError("verify_launch_retries should not be negative")
+        if self.request_forward_rtt_multiplier < 0:
+            raise ConfigError(
+                "request_forward_rtt_multiplier should not be negative "
+                "(0 keeps the constant request_forward_timeout)"
+            )
         if self.verify_mesh_devices < 0:
             raise ConfigError(
                 "verify_mesh_devices should not be negative "

@@ -314,6 +314,7 @@ class Consensus:
                 request_max_bytes=self.config.request_max_bytes,
                 submit_timeout=self.config.request_pool_submit_timeout,
                 admission_high_water=self.config.admission_high_water,
+                forward_timeout_fn=self._forward_timeout_fn(),
             ),
         )
         self._continue_create_components()
@@ -550,6 +551,23 @@ class Consensus:
             recorder=self.recorder,
         )
 
+    def _forward_timeout_fn(self):
+        """The RTT-derived forward-timeout provider (ISSUE 14 satellite):
+        ``multiplier * comm.rtt_seconds()`` when the knob is armed and
+        the transport measures RTT (SocketComm does); None otherwise —
+        the pool then keeps the configured constant.  The pool clamps
+        the derived value into [floor, configured constant]."""
+        mult = self.config.request_forward_rtt_multiplier
+        rtt_fn = getattr(self.comm, "rtt_seconds", None)
+        if mult <= 0 or rtt_fn is None:
+            return None
+
+        def derive():
+            rtt = rtt_fn()
+            return None if rtt is None else mult * rtt
+
+        return derive
+
     def _create_pool(self) -> None:
         """consensus.go:139-151."""
         self.pool = Pool(
@@ -564,6 +582,7 @@ class Consensus:
                 request_max_bytes=self.config.request_max_bytes,
                 submit_timeout=self.config.request_pool_submit_timeout,
                 admission_high_water=self.config.admission_high_water,
+                forward_timeout_fn=self._forward_timeout_fn(),
             ),
             self.scheduler,
             metrics=self.metrics.pool,

@@ -624,6 +624,10 @@ class ViewChanger:
         rec = self.recorder
         if rec.enabled:
             rec.record("vc.timeout_sync", view=self.curr_view)
+        if self.vc_phases is not None:
+            # the round is being recycled (sync + restart): close it as
+            # abandoned so its marks don't read as an in-progress VC
+            self.vc_phases.timeout_escalated()
         self.synchronizer.sync()
         self.start_view_change(self.curr_view, False)
         return True

@@ -125,12 +125,22 @@ class DisabledProvider(Provider):
 # ---------------------------------------------------------------------------
 
 
+def escape_label_value(value) -> str:
+    """Prometheus text-format label-value escaping (backslash, quote,
+    newline) — applied when the label pair is FORMED so the exposition
+    stays parseable whatever the embedder labels with."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _label_suffix(label_names: tuple, label_values: tuple) -> str:
     """Label key suffix.  With declared names: Prometheus-style
-    {name="value",...}; without: the legacy {v1,v2} value form."""
+    {name="value",...} with text-format escaping; without: the legacy
+    {v1,v2} value form."""
     if label_names:
         pairs = ",".join(
-            f'{n}="{v}"' for n, v in zip(label_names, label_values)
+            f'{n}="{escape_label_value(v)}"'
+            for n, v in zip(label_names, label_values)
         )
         return "{" + pairs + "}"
     return "{" + ",".join(str(v) for v in label_values) + "}"
@@ -359,9 +369,20 @@ class PrometheusProvider(InMemoryProvider):
 
     @staticmethod
     def _split(key: str) -> tuple[str, str]:
-        """'fq{a,b}' -> (fq, 'a,b'); plain keys have no label suffix."""
+        """'fq{a,b}' -> (fq, 'a,b'); plain keys have no label suffix.
+
+        Legacy value-only label suffixes (metrics built with
+        ``with_labels`` but no declared ``label_names`` — the {v1,v2}
+        store-key form) are rewritten to a parseable
+        ``label="v1,v2"`` pair: the raw form is NOT legal text-format
+        exposition, and a scraper would reject the whole page over it.
+        The test is "does it parse as valid pairs", not "contains =" —
+        a legacy value like ``query=slow`` carries an '=' and is still
+        not exposition grammar."""
         if key.endswith("}") and "{" in key:
             base, labels = key[:-1].split("{", 1)
+            if not _labels_are_valid_pairs(labels):
+                labels = f'label="{escape_label_value(labels)}"'
             return base, labels
         return key, ""
 
@@ -396,6 +417,166 @@ class PrometheusProvider(InMemoryProvider):
             out.append(f"{fq}_count{suffix} {len(vals):g}")
             out.append(f"{fq}_sum{suffix} {sum(vals):g}")
         return "\n".join(out) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition lint (ISSUE 14 satellite): a pure validator of the
+# text format, so cmd=metrics stays SCRAPEABLE as counters keep accreting.
+# ---------------------------------------------------------------------------
+
+import re as _re
+
+_METRIC_NAME_RE = _re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_NAME_RE = _re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+_SAMPLE_RE = _re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>.*)\})?"
+    r"\s+(?P<value>\S+)"
+    r"(?:\s+(?P<ts>-?\d+))?\s*$"
+)
+# one label pair with text-format escapes inside the quoted value; the
+# name charset is deliberately loose here — the strict check happens
+# against _LABEL_NAME_RE so a bad NAME reports as such, not as syntax
+_LABEL_PAIR_RE = _re.compile(
+    r'\s*(?P<name>[^=,"{}\s]+)\s*=\s*'
+    r'"(?P<value>(?:[^"\\\n]|\\\\|\\"|\\n)*)"\s*(?:,|$)'
+)
+_TYPES = {"counter", "gauge", "histogram", "summary", "untyped"}
+#: suffixes a histogram/summary family's samples may carry
+_HIST_SUFFIXES = ("_bucket", "_count", "_sum", "_created")
+
+
+def _labels_are_valid_pairs(labels: str) -> bool:
+    """True when ``labels`` fully parses as text-format label pairs
+    (valid names, quoted + escaped values) — the PrometheusProvider
+    legacy-suffix rewrite keys off this, and the lint uses the same
+    pair grammar."""
+    pos = 0
+    while pos < len(labels):
+        m = _LABEL_PAIR_RE.match(labels, pos)
+        if m is None or not _LABEL_NAME_RE.match(m.group("name")):
+            return False
+        pos = m.end()
+    return pos > 0
+
+
+def _sample_family(name: str, types: dict) -> Optional[str]:
+    """The declared family a sample name belongs to, if any."""
+    if name in types:
+        return name
+    for suffix in _HIST_SUFFIXES:
+        if name.endswith(suffix) and name[: -len(suffix)] in types:
+            return name[: -len(suffix)]
+    return None
+
+
+def lint_prometheus_text(text: str) -> list[str]:
+    """Validate a Prometheus text-format exposition; returns [] when
+    clean, else one message per problem (line-numbered).
+
+    Checks the grammar a strict scraper/promtool enforces: metric/label
+    name charset, quoted + escaped label values, float-parseable sample
+    values, at most ONE ``# TYPE`` (and ``# HELP``) per family with the
+    TYPE preceding that family's first sample, a known type keyword, no
+    duplicate (name, labelset) samples, and histogram/summary samples
+    restricted to the legal suffixes of their declared family."""
+    problems: list[str] = []
+    types: dict[str, str] = {}
+    helps: set[str] = set()
+    sampled_families: set[str] = set()
+    seen_samples: set[tuple] = set()
+    for ln, line in enumerate(text.splitlines(), start=1):
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) < 3 or parts[1] not in ("HELP", "TYPE"):
+                continue  # free-form comment: legal
+            name = parts[2]
+            if not _METRIC_NAME_RE.match(name):
+                problems.append(f"line {ln}: bad metric name {name!r}")
+                continue
+            if parts[1] == "TYPE":
+                kind = parts[3].strip() if len(parts) > 3 else ""
+                if kind not in _TYPES:
+                    problems.append(
+                        f"line {ln}: unknown TYPE {kind!r} for {name}"
+                    )
+                if name in types:
+                    problems.append(
+                        f"line {ln}: duplicate TYPE line for {name}"
+                    )
+                if name in sampled_families:
+                    problems.append(
+                        f"line {ln}: TYPE for {name} after its samples"
+                    )
+                types[name] = kind
+            else:
+                if name in helps:
+                    problems.append(
+                        f"line {ln}: duplicate HELP line for {name}"
+                    )
+                helps.add(name)
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            problems.append(f"line {ln}: unparseable sample {line!r}")
+            continue
+        name = m.group("name")
+        labels_raw = m.group("labels")
+        labelset = ""
+        if labels_raw is not None:
+            pos = 0
+            pairs = []
+            while pos < len(labels_raw):
+                pm = _LABEL_PAIR_RE.match(labels_raw, pos)
+                if pm is None:
+                    problems.append(
+                        f"line {ln}: bad label syntax at {labels_raw[pos:]!r}"
+                        " (unescaped quote/backslash/newline?)"
+                    )
+                    pairs = None
+                    break
+                if not _LABEL_NAME_RE.match(pm.group("name")):
+                    problems.append(
+                        f"line {ln}: bad label name {pm.group('name')!r}"
+                    )
+                pairs.append((pm.group("name"), pm.group("value")))
+                pos = pm.end()
+            if pairs is None:
+                continue
+            labelset = ",".join(f'{n}="{v}"' for n, v in sorted(pairs))
+        try:
+            float(m.group("value"))
+        except ValueError:
+            if m.group("value") not in ("+Inf", "-Inf", "NaN"):
+                problems.append(
+                    f"line {ln}: sample value {m.group('value')!r} is not "
+                    "a float"
+                )
+        key = (name, labelset)
+        if key in seen_samples:
+            problems.append(
+                f"line {ln}: duplicate sample {name}{{{labelset}}}"
+            )
+        seen_samples.add(key)
+        family = _sample_family(name, types)
+        if family is not None:
+            sampled_families.add(family)
+            kind = types.get(family)
+            # summaries deliberately get no bare-sample check: quantile
+            # samples legally use the bare family name
+            if kind == "histogram" and name == family:
+                problems.append(
+                    f"line {ln}: histogram {family} exposes a bare sample "
+                    f"(only {'/'.join(_HIST_SUFFIXES)} are legal)"
+                )
+            if kind in ("counter", "gauge") and name != family:
+                problems.append(
+                    f"line {ln}: {kind} {family} exposes suffixed sample "
+                    f"{name}"
+                )
+    return problems
 
 
 # ---------------------------------------------------------------------------

@@ -574,6 +574,34 @@ class SocketCluster:
         """One replica's Prometheus text exposition (cmd=metrics)."""
         return self.control(node_id).call(cmd="metrics")["text"]
 
+    def health(self, node_id: int) -> dict:
+        """One replica's live SLO verdict (cmd=health)."""
+        return self.control(node_id).call(cmd="health")
+
+    def cluster_health(self) -> dict:
+        """ONE aggregated cluster verdict from a single control-channel
+        sweep (ISSUE 14): poll every live replica's cmd=health, fold the
+        per-replica verdicts with
+        :func:`~smartbft_tpu.obs.health.aggregate_cluster_verdict` —
+        replicas that are down or unreachable degrade the verdict
+        themselves (a majority gone is critical).  Returns ``{"status",
+        "replicas", "reasons", "unreachable"}``."""
+        from ..obs.health import aggregate_cluster_verdict
+
+        verdicts: dict[str, dict] = {}
+        unreachable: list[str] = []
+        for i in self._ids:
+            if i in self.down:
+                unreachable.append(f"n{i}")
+                continue
+            try:
+                resp = self.health(i)
+                verdicts[resp.get("node", f"n{i}")] = resp["health"]
+            except (OSError, ControlError, KeyError,
+                    json.JSONDecodeError):
+                unreachable.append(f"n{i}")
+        return aggregate_cluster_verdict(verdicts, unreachable=unreachable)
+
     def dump_flight_recorders(self, out_dir: Optional[str] = None,
                               last: int = 2048) -> list[str]:
         """Write each LIVE replica's last ``last`` spans to
@@ -623,6 +651,26 @@ class SocketChaosReport:
     final_committed: int = 0
     heights: dict = field(default_factory=dict)
     events_fired: list = field(default_factory=list)
+    #: (t_offset_s, status, [breaching slo names]) — one entry per
+    #: cluster-verdict CHANGE observed by the periodic health sweep
+    verdicts: list = field(default_factory=list)
+    #: (first_event_t, last_event_t) run offsets of the fault window
+    fault_span: Optional[tuple] = None
+    final_health: Optional[dict] = None
+
+
+def assert_no_critical_outside_faults(report: SocketChaosReport,
+                                      *, recovery_s: float = 30.0) -> None:
+    """The soak's health gate (ISSUE 14): a ``critical`` cluster verdict
+    is only acceptable while an injected fault (plus a bounded recovery
+    window) explains it; any other critical sample fails the run.  The
+    final verdict must not be critical at all — the run ends quiesced.
+    (Same rule as the logical-clock runner: testing.chaos
+    assert_health_verdicts.)"""
+    from ..testing.chaos import assert_health_verdicts
+
+    assert_health_verdicts(report.verdicts, report.fault_span,
+                           report.final_health, recovery_s=recovery_s)
 
 
 def run_socket_schedule(
@@ -632,6 +680,7 @@ def run_socket_schedule(
     requests: int = 16,
     submit_every: float = 0.15,
     settle_timeout: float = 90.0,
+    health_every: float = 0.5,
 ) -> SocketChaosReport:
     """Replay a ``testing.chaos`` schedule against real processes.
 
@@ -649,6 +698,25 @@ def run_socket_schedule(
     start = time.monotonic()
     submitted = 0
     next_submit = 0.0
+    next_health = 0.0
+    last_status: Optional[str] = None
+
+    def sample_health(now: float) -> None:
+        """Periodic cluster-verdict sweep; only CHANGES are recorded.
+        Health is advisory — a sweep that fails (replica mid-restart)
+        must never fail the schedule it observes."""
+        nonlocal last_status
+        try:
+            verdict = cluster.cluster_health()
+        except Exception:  # noqa: BLE001 — advisory
+            return
+        report.final_health = verdict
+        if verdict["status"] != last_status:
+            last_status = verdict["status"]
+            report.verdicts.append((
+                round(now, 2), verdict["status"],
+                sorted({r.get("slo", "?") for r in verdict["reasons"]}),
+            ))
 
     def resolve(spec) -> Optional[int]:
         nonlocal faulty_node
@@ -717,6 +785,9 @@ def run_socket_schedule(
         else:
             raise ValueError(f"unsupported socket chaos action: {evt.action}")
         report.events_fired.append((evt.action, node))
+        now = time.monotonic() - start
+        lo, hi = report.fault_span or (now, now)
+        report.fault_span = (min(lo, now), max(hi, now))
 
     while True:
         now = time.monotonic() - start
@@ -733,6 +804,9 @@ def run_socket_schedule(
                     pass  # no leader yet / pool full: retry next tick
             next_submit = now + submit_every
         report.submitted = submitted
+        if now >= next_health:
+            sample_health(now)
+            next_health = now + health_every
         if not pending and submitted >= requests:
             break
         time.sleep(0.02)
@@ -794,6 +868,7 @@ def run_socket_schedule(
     live = cluster.live_ids()
     report.final_committed = cluster.committed(live[0]) if live else 0
     report.heights = cluster.heights()
+    sample_health(time.monotonic() - start)
     return report
 
 
@@ -822,7 +897,11 @@ def socket_soak(*, rounds: int = 2, n: int = 4, transport: str = "uds",
                 requests: int = 16, verbose: bool = True) -> None:
     """``chaos --soak --sockets``: the socket-fault matrix end-to-end.
     Each round runs SIGKILL-and-rejoin then slow-link against a fresh
-    multi-process cluster, checking commit + fork-free invariants."""
+    multi-process cluster, checking commit + fork-free invariants AND
+    the continuous SLO verdict (ISSUE 14): the default spec is evaluated
+    on every replica throughout, verdict transitions ride the report,
+    and a critical verdict outside the injected-fault window (plus a
+    bounded recovery) fails the round."""
     for r in range(rounds):
         for name, schedule in (
             ("kill-rejoin", kill_rejoin_schedule()),
@@ -836,6 +915,7 @@ def socket_soak(*, rounds: int = 2, n: int = 4, transport: str = "uds",
                     report = run_socket_schedule(
                         cluster, schedule, requests=requests
                     )
+                    assert_no_critical_outside_faults(report)
                 finally:
                     cluster.stop()
                 if verbose:
@@ -843,5 +923,5 @@ def socket_soak(*, rounds: int = 2, n: int = 4, transport: str = "uds",
                         f"socket round {r} [{name}]: events="
                         f"{report.events_fired} committed="
                         f"{report.final_committed} heights={report.heights}"
-                        " — OK"
+                        f" verdicts={report.verdicts} — OK"
                     )

@@ -80,6 +80,12 @@ def proc_config(self_id: int) -> Configuration:
         incoming_message_buffer_size=400,
         request_pool_size=800,
         request_forward_timeout=1.0,
+        # round-16 fix: derive the EFFECTIVE forward timeout from the
+        # transport's measured RTT (localhost: µs → clamped to the 10 ms
+        # floor) instead of waiting out the full constant above — which
+        # the cluster timeline measured as 97.6% of follower-submitted
+        # request latency.  The constant stays the ceiling/fallback.
+        request_forward_rtt_multiplier=20.0,
         request_complain_timeout=4.0,
         request_auto_remove_timeout=60.0,
         view_change_resend_interval=1.0,
@@ -191,6 +197,17 @@ class ReplicaApp(Application, Assembler, Comm, Signer, Verifier,
         else:
             self.recorder = NOP_RECORDER
         self.transport.recorder = self.recorder
+        # cluster health plane (ISSUE 14): every replica judges itself
+        # against the declarative SLO spec on a periodic tick; cmd=health
+        # serves the verdict, SocketCluster.cluster_health aggregates n
+        # of them.  Breach/clear transitions land in the flight recorder
+        # (when armed) so SLO violations show on the merged timeline.
+        from ..obs.health import HealthMonitor
+
+        self.health = HealthMonitor(recorder=self.recorder,
+                                    node=f"n{self.id}")
+        self.health_interval = float(spec.get("health_interval", 0.25))
+        self._health_task = None
         # FT_TRACE sidecars carry the SAME "client:rid" correlator the
         # recorder stamps on req.submit/req.deliver (request_id memoizes,
         # so the per-forward cost is a dict hit once warm)
@@ -264,6 +281,12 @@ class ReplicaApp(Application, Assembler, Comm, Signer, Verifier,
 
     def nodes(self) -> list[int]:
         return self.transport.nodes()
+
+    def rtt_seconds(self):
+        """Expose the transport's measured RTT through the Comm seam —
+        the forward-timeout derivation reads it off whatever object
+        Consensus holds as ``comm`` (this embedder)."""
+        return self.transport.rtt_seconds()
 
     # ------------------------------------------------------------ crypto (trivial)
 
@@ -433,8 +456,36 @@ class ReplicaApp(Application, Assembler, Comm, Signer, Verifier,
         self.transport.attach(self.consensus)
         await self.transport.start()
         await self.consensus.start()
+        # health sources wire AFTER start: the pool and WAL exist now
+        self.health.watch_consensus(self.consensus)
+        from ..obs.health import wal_signal_source
+
+        self.health.add_source(wal_signal_source(self._wal))
+        from ..utils.tasks import create_logged_task
+
+        self._health_task = create_logged_task(
+            self._health_loop(), name=f"health-{self.id}",
+            logger=self.logger,
+        )
+
+    async def _health_loop(self) -> None:
+        """Periodic SLO tick — the burn windows need a steady sample
+        cadence, not just whenever an operator polls cmd=health."""
+        while True:
+            try:
+                self.health.tick()
+            except Exception as e:  # noqa: BLE001 — judged, never judging
+                self.logger.warnf("health tick failed: %r", e)
+            await asyncio.sleep(self.health_interval)
 
     async def stop(self) -> None:
+        if self._health_task is not None:
+            self._health_task.cancel()
+            import contextlib
+
+            with contextlib.suppress(asyncio.CancelledError):
+                await self._health_task
+            self._health_task = None
         if self.consensus is not None:
             await self.consensus.stop()
         await self.transport.close()
@@ -657,6 +708,17 @@ class ControlServer:
             return {"ok": True, "transport": r.transport.transport_snapshot(),
                     "height": r.height(),
                     "committed": r.committed_requests()}
+        if cmd == "health":
+            # live SLO verdict (ISSUE 14): tick once on demand so the
+            # answer reflects NOW even between periodic samples, then
+            # serve the verdict + recent transitions
+            r.health.tick()
+            return {
+                "ok": True,
+                "node": f"n{r.id}",
+                "health": r.health.verdict(),
+                "transitions": r.health.transition_log()[-16:],
+            }
         if cmd == "metrics":
             # Prometheus text exposition over the control channel: the
             # per-replica counters finally have a reader in multi-process

@@ -240,6 +240,14 @@ class SocketComm(Comm):
         self.muted = False
         self._dropped_links: set[int] = set()
         self._slow_links: dict[int, float] = {}
+        #: per-peer RTT estimate (seconds), EWMA over measured round
+        #: trips: the TCP dial (connect = one SYN/SYN-ACK round trip;
+        #: UDS connects in ~µs, which is the true loopback answer) and
+        #: every sync RPC.  Consumed by Pool via Consensus's
+        #: forward-timeout derivation (request_forward_rtt_multiplier):
+        #: round 16 measured follower-submitted requests spending 97.6%
+        #: of their latency waiting out the FIXED forward constant.
+        self._rtt: dict[int, float] = {}
 
     @classmethod
     def from_config(cls, config, peers: dict[int, str], *,
@@ -502,9 +510,11 @@ class SocketComm(Comm):
         first = True
         while not self._closing:
             try:
+                t_dial = perf_counter()
                 reader, writer = await asyncio.wait_for(
                     self._dial(peer.addr), timeout=CONNECT_TIMEOUT
                 )
+                self._note_rtt(peer.id, perf_counter() - t_dial)
             except (OSError, asyncio.TimeoutError):
                 self.metrics.connect_failures += 1
                 if self._closing:
@@ -841,13 +851,39 @@ class SocketComm(Comm):
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._sync_waiters[nonce] = fut
         req = SyncRequest(nonce=nonce, from_height=from_height)
+        t0 = perf_counter()
         self._enqueue(target, encode_frame(FT_SYNC_REQ, encode(req)))
         try:
-            return await asyncio.wait_for(fut, timeout)
+            resp = await asyncio.wait_for(fut, timeout)
+            # a completed sync RPC is a measured round trip (enqueue ->
+            # response dispatch): opportunistically refresh the RTT
+            self._note_rtt(target, perf_counter() - t0)
+            return resp
         except (asyncio.TimeoutError, asyncio.CancelledError):
             return None
         finally:
             self._sync_waiters.pop(nonce, None)
+
+    # ------------------------------------------------------------ RTT
+
+    def _note_rtt(self, peer_id: int, sample: float) -> None:
+        """Fold one measured round trip into the per-peer EWMA."""
+        if sample <= 0:
+            return
+        prev = self._rtt.get(peer_id)
+        self._rtt[peer_id] = sample if prev is None \
+            else 0.7 * prev + 0.3 * sample
+
+    def rtt_seconds(self) -> Optional[float]:
+        """The transport's measured RTT envelope: the WORST (largest)
+        per-peer estimate, because a forwarded request must reach
+        whichever peer currently leads — deriving the forward timer from
+        the slowest link is the conservative choice.  None before any
+        round trip was measured (the consumer falls back to the
+        configured constant)."""
+        if not self._rtt:
+            return None
+        return max(self._rtt.values())
 
     # ------------------------------------------------------------ faults
 
@@ -884,4 +920,7 @@ class SocketComm(Comm):
             1 for p in self._peers.values() if p.connected
         )
         snap["outbox_backlog"] = sum(len(p.outbox) for p in self._peers.values())
+        snap["rtt_ms"] = {
+            str(p): round(r * 1e3, 3) for p, r in sorted(self._rtt.items())
+        }
         return snap

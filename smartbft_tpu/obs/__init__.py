@@ -16,12 +16,22 @@ from .critpath import (  # noqa: F401
     SEGMENTS,
     assemble_critical_path_block,
 )
+from .health import (  # noqa: F401
+    HealthMonitor,
+    aggregate_cluster_verdict,
+)
 from .recorder import (  # noqa: F401
     NOP_RECORDER,
     NopRecorder,
     SpanEvent,
     TraceRecorder,
     assemble_trace_block,
+)
+from .slo import (  # noqa: F401
+    SLOEvaluator,
+    SLORule,
+    SLOSpec,
+    default_slo_spec,
 )
 from .vcphases import (  # noqa: F401
     ViewChangePhaseTracker,
@@ -38,4 +48,10 @@ __all__ = [
     "assemble_trace_block",
     "ViewChangePhaseTracker",
     "assemble_viewchange_block",
+    "HealthMonitor",
+    "aggregate_cluster_verdict",
+    "SLOEvaluator",
+    "SLORule",
+    "SLOSpec",
+    "default_slo_spec",
 ]

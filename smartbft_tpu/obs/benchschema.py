@@ -1,0 +1,213 @@
+"""Versioned bench-row schema: the cross-round comparability contract.
+
+Every bench row family this repo emits (the ``assemble_*_row`` pure
+functions in ``bench.py`` plus the kernel/throughput headline rows) is
+pinned here as a small JSON-schema-style description: required keys with
+types, optional keys typed when present, nested blocks described
+recursively.  Two consumers rely on it:
+
+* the tier-1 drift gate (tests) validates synthetic rows built through
+  the SAME pure assemble functions the real benches call, so a row-shape
+  change that would break downstream tooling fails in CI, not three
+  rounds later when someone diffs BENCH_*.json files;
+* the longitudinal baseline guard (:mod:`smartbft_tpu.obs.baseline`)
+  validates fresh rows before comparing them against a pinned baseline —
+  rows from different rounds are only comparable because this schema
+  says they still mean the same thing.
+
+Unknown top-level keys are ALLOWED (additive evolution is the norm);
+missing required keys and type changes are the drift this gate exists to
+catch.  ``SCHEMA_VERSION`` is stamped into every baseline file; a pinned
+baseline whose schema version disagrees with the checker's is reported
+instead of silently compared.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+__all__ = ["SCHEMA_VERSION", "ROW_SCHEMAS", "identify_row", "validate_row",
+           "validate_rows"]
+
+#: bump when a row family's required shape changes incompatibly
+SCHEMA_VERSION = 1
+
+_NUM = (int, float)
+_STR = (str,)
+_DICT = (dict,)
+_LIST = (list,)
+
+
+def _check(obj, schema: dict, path: str, errors: list[str]) -> None:
+    if not isinstance(obj, dict):
+        errors.append(f"{path or '<row>'}: expected object, got "
+                      f"{type(obj).__name__}")
+        return
+    for key, want in schema.get("required", {}).items():
+        if key not in obj or obj[key] is None:
+            errors.append(f"{path}{key}: required key missing")
+            continue
+        _check_value(obj[key], want, f"{path}{key}", errors)
+    for key, want in schema.get("optional", {}).items():
+        if key in obj and obj[key] is not None:
+            _check_value(obj[key], want, f"{path}{key}", errors)
+
+
+def _check_value(value, want, path: str, errors: list[str]) -> None:
+    if isinstance(want, dict):
+        _check(value, want, path + ".", errors)
+    elif isinstance(want, tuple):
+        # bool is an int subclass; a numeric field turning bool is drift
+        if isinstance(value, bool) and bool not in want:
+            errors.append(f"{path}: expected "
+                          f"{'/'.join(t.__name__ for t in want)}, got bool")
+        elif not isinstance(value, want):
+            errors.append(
+                f"{path}: expected {'/'.join(t.__name__ for t in want)}, "
+                f"got {type(value).__name__}"
+            )
+    elif callable(want):
+        err = want(value)
+        if err:
+            errors.append(f"{path}: {err}")
+
+
+def _list_of(item_schema) -> "callable":
+    def check(value):
+        if not isinstance(value, list):
+            return f"expected list, got {type(value).__name__}"
+        errs: list[str] = []
+        for i, item in enumerate(value):
+            _check_value(item, item_schema, f"[{i}]", errs)
+        return "; ".join(errs) if errs else None
+
+    return check
+
+
+#: the percentile sub-block LogScaleHistogram.snapshot() emits
+_PCTS = {"required": {"count": _NUM, "p50_ms": _NUM, "p95_ms": _NUM,
+                      "p99_ms": _NUM, "max_ms": _NUM},
+         "optional": {"mean_ms": _NUM}}
+
+_LATENCY_BLOCK = {
+    "required": {"count": _NUM, "p50_ms": _NUM, "p95_ms": _NUM,
+                 "p99_ms": _NUM, "shed": _DICT, "histogram": _DICT},
+    "optional": {"mean_ms": _NUM, "max_ms": _NUM, "pending_stamps": _NUM,
+                 "dropped_stamps": _NUM, "per_shard": _DICT,
+                 "phases": _DICT, "knee": _DICT},
+}
+
+_PROTOCOL_PLANE = {
+    "required": {"ingest_us": _NUM, "route_us": _NUM, "vote_reg_us": _NUM,
+                 "codec_us": _NUM},
+    "optional": {"broadcasts": _NUM, "sends": _NUM, "encodes": _NUM,
+                 "decodes": _NUM, "batch_ingests": _NUM,
+                 "msgs_ingested": _NUM},
+}
+
+ROW_SCHEMAS: dict = {
+    # bench.py e2e_bench / assemble_e2e_row — the north-star row
+    "committed_tx_per_sec_n*": {
+        "required": {"metric": _STR, "value": _NUM, "unit": _STR,
+                     "vs_baseline": _NUM, "baseline_tx_per_sec": _NUM,
+                     "pipeline": _NUM, "burst_decisions": _NUM},
+        "optional": {"launches": _NUM, "decisions": _NUM,
+                     "launches_per_decision": _NUM, "window_launches": _LIST,
+                     "batch_fill_pct": _NUM, "launch_probe_ms": _NUM,
+                     "baseline_launch_probe_ms": _NUM, "breaker": _DICT,
+                     "mesh": _DICT, "protocol_plane": _PROTOCOL_PLANE,
+                     "baseline_protocol_plane": _DICT,
+                     "tx_per_sec_probe_normalized": _NUM,
+                     "vs_baseline_probe_normalized": _NUM},
+    },
+    # bench.py kernel_bench — the kernel micro headline
+    "p256_sig_verify_p50_us": {
+        "required": {"metric": _STR, "value": _NUM, "unit": _STR,
+                     "vs_baseline": _NUM},
+        "optional": {"vs_all_cores": _NUM, "cores": _NUM,
+                     "protocol_plane": _PROTOCOL_PLANE},
+    },
+    # bench.py assemble_open_loop_row
+    "open_loop_p99_ms": {
+        "required": {"metric": _STR, "value": _NUM, "unit": _STR,
+                     "offered_per_sec": _NUM, "goodput_per_sec": _NUM,
+                     "latency": _LATENCY_BLOCK, "sweep": _list_of(_DICT)},
+        "optional": {"shards": _NUM, "zipf_skew": _NUM,
+                     "admission_high_water": _NUM, "viewchange": _DICT,
+                     "trace": _DICT, "critical_path": _DICT,
+                     "health": _DICT, "degraded_notes": _DICT},
+    },
+    # bench.py assemble_transport_row
+    "transport_committed_tx_per_sec": {
+        "required": {"metric": _STR, "value": _NUM, "unit": _STR,
+                     "vs_baseline": _NUM, "flavor": _STR, "nodes": _NUM,
+                     "requests": _NUM, "transport": _DICT},
+        "optional": {"inproc_tx_per_sec": _NUM,
+                     "protocol_plane": _PROTOCOL_PLANE,
+                     "inproc_protocol_plane": _DICT,
+                     "critical_path": _DICT, "cluster_trace": _DICT},
+    },
+    # bench.py assemble_sharded_row
+    "sharded_committed_tx_per_sec": {
+        "required": {"metric": _STR, "value": _NUM, "unit": _STR,
+                     "vs_baseline": _NUM,
+                     "shard": {"required": {"sweep": _list_of(_DICT)},
+                               "optional": {"scaling": _DICT,
+                                            "top": _DICT}}},
+        "optional": {"reshard": _DICT},
+    },
+    # bench.py assemble_mesh_row
+    "mesh_committed_tx_per_sec": {
+        "required": {"metric": _STR, "value": _NUM, "unit": _STR,
+                     "vs_baseline": _NUM, "devices": _NUM,
+                     "mesh": {"required": {"sweep": _list_of(_DICT)},
+                              "optional": {"gating": _DICT,
+                                           "verdict_parity": _DICT,
+                                           "verdict_parity_2d": _DICT,
+                                           "capacity_scaling": _NUM,
+                                           "topology": _STR,
+                                           "downgrades": _NUM,
+                                           "top": _DICT}}},
+        "optional": {},
+    },
+    # obs.baseline.tiny_logical_row — the tier-1 regression-gate row
+    # (value = mean logical commit latency; percentiles ride in "latency")
+    "tiny_logical_commit_ms": {
+        "required": {"metric": _STR, "value": _NUM, "unit": _STR,
+                     "requests": _NUM, "decisions": _NUM,
+                     "latency": _PCTS},
+        "optional": {"nodes": _NUM, "seed": _NUM, "p50_ms": _NUM},
+    },
+}
+
+
+def identify_row(row: dict) -> Optional[str]:
+    """The schema family a row belongs to, or None for unpinned rows."""
+    metric = row.get("metric")
+    if not isinstance(metric, str):
+        return None
+    if metric in ROW_SCHEMAS:
+        return metric
+    for family in ROW_SCHEMAS:
+        if family.endswith("*") and metric.startswith(family[:-1]):
+            return family
+    return None
+
+
+def validate_row(row: dict) -> list[str]:
+    """Schema errors for one row ([] when clean or the family is
+    unpinned — an unknown family is not drift, it is a new row)."""
+    family = identify_row(row)
+    if family is None:
+        return []
+    errors: list[str] = []
+    _check(row, ROW_SCHEMAS[family], "", errors)
+    return [f"{family}: {e}" for e in errors]
+
+
+def validate_rows(rows: list) -> list[str]:
+    errors: list[str] = []
+    for i, row in enumerate(rows):
+        for e in validate_row(row):
+            errors.append(f"row[{i}] {e}")
+    return errors

@@ -85,8 +85,15 @@ def link_summary(events: list[dict], offsets: dict) -> list[dict]:
     sender or receiver clock is unestimated are skipped rather than
     published with unbounded skew.  Returns one row per directed link
     with exact percentiles — the WAN-profile work (ROADMAP item 5)
-    reads per-link time straight off this table."""
+    reads per-link time straight off this table.
+
+    Offset-estimation error can exceed a loopback hop's real flight
+    time: an apparently NEGATIVE network time is an artifact of that
+    error bound, so it is CLAMPED to 0 and counted per link
+    (``clamped``) instead of published as a physically impossible
+    measurement."""
     links: dict[tuple, list] = {}
+    clamped: dict[tuple, int] = {}
     for ev in events:
         if ev.get("kind") != "net.recv":
             continue
@@ -102,7 +109,11 @@ def link_summary(events: list[dict], offsets: dict) -> list[dict]:
         if off is None:
             off = 0.0  # single-clock run: no shift needed anywhere
         net_ms = (ev.get("t", 0.0) - (sent_us / 1e6 - off)) * 1e3
-        links.setdefault((sender, ev.get("node", "?")), []).append(net_ms)
+        key = (sender, ev.get("node", "?"))
+        if net_ms < 0.0:
+            clamped[key] = clamped.get(key, 0) + 1
+            net_ms = 0.0
+        links.setdefault(key, []).append(net_ms)
     rows = []
     for (a, b), vals in sorted(links.items()):
         vals.sort()
@@ -113,6 +124,10 @@ def link_summary(events: list[dict], offsets: dict) -> list[dict]:
             "p95_ms": round(_pct(vals, 0.95), 3),
             "p99_ms": round(_pct(vals, 0.99), 3),
             "max_ms": round(vals[-1], 3),
+            # samples the skew error bound pushed below zero (published
+            # as 0): err_bound exceeding the hop time is EXPECTED on
+            # loopback, and hiding the clamp would overstate precision
+            "clamped": clamped.get((a, b), 0),
         })
     return rows
 
@@ -178,6 +193,16 @@ def render(dumps: list[dict], *, last: Optional[int] = None,
               + (f", dropped {sum(d.get('dropped', 0) for d in dumps)}"
                  if any(d.get("dropped") for d in dumps) else ""))
     out.append(header)
+    unaligned = sorted(d.get("node", "?") for d in dumps
+                       if not d.get("offset_known", True))
+    if aligned and unaligned:
+        # loud degradation: these nodes merge with an UNKNOWN clock —
+        # their timestamps are unshifted and their per-link rows are
+        # excluded, not silently published with assumed-zero skew
+        out.append(
+            f"WARNING: no clock offset for {', '.join(unaligned)} — "
+            "their events merge UNALIGNED and their links are excluded"
+        )
     if events and not summary_only:
         t0 = events[0].get("t", 0.0)
         out.append("")

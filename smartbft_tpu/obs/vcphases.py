@@ -199,6 +199,16 @@ class ViewChangePhaseTracker:
         if self.open and view >= self._view:
             self._abandon("sync")
 
+    def timeout_escalated(self) -> None:
+        """The view-change timeout fired: the ViewChanger is forcing a
+        sync and RESTARTING the round (viewchanger.go:254-270 backoff
+        escalation).  The open round is recycled — count it abandoned so
+        its stale marks cannot keep reading as a still-in-progress view
+        change (a restarted replica that restored a moot VC round would
+        otherwise report viewchange.active_seconds growing forever)."""
+        if self.open:
+            self._abandon("timeout")
+
     def _abandon(self, reason: str) -> None:
         self.abandoned += 1
         rec = self.recorder

@@ -457,6 +457,53 @@ class ShardSet:
             "fill": (total_size / total_cap) if total_cap else 0.0,
         }
 
+    def health_signals(self) -> dict:
+        """The front door's contribution to a
+        :class:`~smartbft_tpu.obs.health.HealthMonitor` — the set-level
+        roll-up of the same signals each replica reports for itself:
+        combined pool fill (parked moved-client submitters included, the
+        client-felt pressure), whether the gate shed (the monitor's
+        latch turns the counter into a recent-window signal), and the
+        live submit->commit p99 over the set's latency tracker."""
+        occ = self.occupancy()
+        cap = occ["total_capacity"]
+        # client-FELT fill: pooled requests plus waiters (parked moved
+        # submitters included) over capacity — the same definition the
+        # per-replica pool_signal_source uses, NOT the autoscaler's
+        # pooled-only 'fill' (a resharding front door with stalled
+        # clients must not read healthy)
+        out = {
+            "pool.fill": ((occ["total_size"] + occ["total_waiters"]) / cap)
+            if cap else 0.0,
+            "pool.shed_total": float(occ["shed_admission"]
+                                     + occ["shed_timeout"]),
+        }
+        if self.latency.aggregate.count:
+            out["latency.commit_p99_ms"] = \
+                self.latency.aggregate.quantile(0.99) * 1e3
+        return out
+
+    def health_source(self, *, clock=None):
+        """A zero-arg HealthMonitor source over :meth:`health_signals`
+        with the shed counter latched into ``pool.shed_recent`` (the
+        rule's signal) — counters are monotone, verdicts need recency."""
+        import time as _time
+
+        from ..obs.health import EventLatch
+
+        latch = EventLatch(5.0)
+        clock = clock or _time.monotonic
+
+        def signals() -> dict:
+            sig = self.health_signals()
+            shed_total = sig.pop("pool.shed_total", 0.0)
+            sig["pool.shed_recent"] = latch.update(
+                shed_total, 1.0, clock()
+            )
+            return sig
+
+        return signals
+
     # -- the combined committed stream -------------------------------------
 
     def poll_committed(self) -> list:

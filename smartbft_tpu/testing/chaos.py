@@ -227,6 +227,12 @@ class ChaosReport:
     heal_at: float = 0.0
     leaders_seen: set = field(default_factory=set)
     events_fired: list = field(default_factory=list)
+    #: (logical t, status, [breaching slo names]) — one entry per
+    #: CLUSTER-verdict change from the continuous SLO evaluation
+    verdicts: list = field(default_factory=list)
+    #: (first fired event t, last fired event t), logical offsets
+    fault_span: Optional[tuple] = None
+    final_health: Optional[dict] = None
     # open-loop spike accounting (load_spike / load_stop actions)
     spike_offered: int = 0
     spike_acked: int = 0
@@ -242,6 +248,37 @@ class ChaosReport:
     @property
     def spike_shed(self) -> int:
         return self.spike_shed_admission + self.spike_shed_timeout
+
+
+def assert_health_verdicts(verdicts: list, fault_span: Optional[tuple],
+                           final_health: Optional[dict], *,
+                           recovery_s: float = 30.0) -> None:
+    """The soak health gate (ISSUE 14), shared by the logical-clock and
+    socket runners: a ``critical`` verdict is only acceptable inside the
+    injected-fault window plus a bounded recovery, and the run must not
+    END critical.  With NO fault window (no event ever fired) there is
+    no excuse: EVERY critical sample fails — a default window would
+    blanket-pass exactly the unexplained criticals the gate exists to
+    catch."""
+    if fault_span is None:
+        stray = [(t, names) for t, status, names in verdicts
+                 if status == "critical"]
+        lo = hi = 0.0
+    else:
+        lo, hi = fault_span
+        hi += recovery_s
+        stray = [
+            (t, names) for t, status, names in verdicts
+            if status == "critical" and not (lo <= t <= hi)
+        ]
+    assert not stray, (
+        f"critical verdict outside the injected-fault window "
+        f"[{lo:.1f}s, {hi:.1f}s]: {stray}"
+    )
+    if final_health is not None:
+        assert final_health.get("status") != "critical", (
+            f"cluster still critical after the run drained: {final_health}"
+        )
 
 
 # ---------------------------------------------------------------------- cluster
@@ -262,6 +299,8 @@ class ChaosCluster:
         engine_faults: bool = False,
         trace: bool = False,
         trace_capacity: int = 4096,
+        health: bool = True,
+        slo_spec=None,
     ):
         self.wal_root = str(wal_root)
         self.n = n
@@ -368,6 +407,100 @@ class ChaosCluster:
         #: overload scenarios (phase p99s via begin_phase)
         self.latency = CommitLatencyTracker(clock=self.scheduler.now)
         self._latency_scan_pos = 0
+        #: continuous SLO evaluation (ISSUE 14): one HealthMonitor per
+        #: node on the LOGICAL clock, ticked by the run loop; sources
+        #: rebind across crash-restarts (each restart builds a fresh
+        #: Consensus + VC tracker).  slo_spec defaults to the production
+        #: default spec — the point is judging chaos runs against the
+        #: same objectives an operator would.
+        self.health_monitors: dict[int, object] = {}
+        if health:
+            from ..obs.health import HealthMonitor
+
+            for i in range(1, n + 1):
+                mon = HealthMonitor(
+                    slo_spec, clock=self.scheduler.now, node=f"n{i}",
+                    recorder=self.recorders.get(i),
+                )
+                mon.add_source(self._node_signal_source(i))
+                if self.coalescer is not None:
+                    from ..obs.health import coalescer_signal_source
+
+                    mon.add_source(coalescer_signal_source(self.coalescer))
+                self.health_monitors[i] = mon
+        self._last_cluster_status: Optional[str] = None
+
+    def _node_signal_source(self, node_id: int) -> Callable:
+        """A source that follows the node's CURRENT Consensus: restarts
+        rebuild consensus (and its VC tracker), so the bound vc/pool
+        sources are rebuilt whenever the underlying object changes."""
+        from ..obs.health import pool_signal_source, vc_signal_source
+
+        state = {"consensus": None, "sources": []}
+
+        def signals() -> dict:
+            app = self.app(node_id)
+            c = app.consensus if node_id not in self.down else None
+            if c is None:
+                state["consensus"], state["sources"] = None, []
+                return {}
+            if c is not state["consensus"]:
+                state["consensus"] = c
+                state["sources"] = [
+                    vc_signal_source(c.vc_phases, clock=self.scheduler.now),
+                    pool_signal_source(c.pool_occupancy,
+                                       clock=self.scheduler.now),
+                ]
+            out: dict = {}
+            for fn in state["sources"]:
+                out.update(fn())
+            return out
+
+        return signals
+
+    def tick_health(self, report: Optional[ChaosReport] = None) -> dict:
+        """Tick every live node's monitor, aggregate the cluster verdict,
+        and (when ``report`` is given) record verdict CHANGES.  Down
+        nodes count as unreachable — exactly the control-channel sweep
+        semantics of SocketCluster.cluster_health."""
+        from ..obs.health import aggregate_cluster_verdict
+
+        verdicts = {}
+        unreachable = []
+        for i, mon in self.health_monitors.items():
+            if i in self.down:
+                unreachable.append(f"n{i}")
+                continue
+            verdicts[f"n{i}"] = mon.tick()
+        agg = aggregate_cluster_verdict(verdicts, unreachable=unreachable)
+        if report is not None:
+            report.final_health = agg
+            if agg["status"] != self._last_cluster_status:
+                self._last_cluster_status = agg["status"]
+                report.verdicts.append((
+                    round(self.scheduler.now(), 2), agg["status"],
+                    sorted({r.get("slo", "?") for r in agg["reasons"]}),
+                ))
+        return agg
+
+    async def wait_healthy(self, timeout: float = 30.0,
+                           step: float = 0.05) -> float:
+        """Advance logical time until the cluster verdict returns to
+        ``healthy``; returns the logical seconds it took.  The
+        recovery-bound invariant (ISSUE 14) asserts through this."""
+        start = self.scheduler.now()
+        elapsed = 0.0
+        while elapsed < timeout:
+            if self.tick_health()["status"] == "healthy":
+                return self.scheduler.now() - start
+            await asyncio.sleep(0)
+            self.scheduler.advance_by(step)
+            await asyncio.sleep(0.001)
+            elapsed += step
+        raise TimeoutError(
+            f"cluster verdict did not return to healthy within {timeout}s: "
+            f"{self.tick_health()}"
+        )
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -607,6 +740,7 @@ class ChaosCluster:
         now = 0.0
         submitted = 0
         next_submit = 0.0
+        next_health = 0.0
         heal_seen = False
         self._spike_pending = 0
 
@@ -681,6 +815,8 @@ class ChaosCluster:
             while pending and pending[0].at <= now:
                 evt = pending.pop(0)
                 report.events_fired.append(await self._fire(evt))
+                lo, hi = report.fault_span or (now, now)
+                report.fault_span = (min(lo, now), max(hi, now))
             # 2. pump load
             if submitted < requests and now >= next_submit:
                 app = target_app()
@@ -702,6 +838,11 @@ class ChaosCluster:
             if self.spike is not None or self.latency.pending():
                 self.scan_latency_commits()
                 sample_occupancy()
+            # 3b. continuous SLO evaluation (every 0.25 logical s — the
+            # burn windows need cadence, not per-step granularity)
+            if self.health_monitors and now >= next_health:
+                self.tick_health(report)
+                next_health = now + 0.25
             lead = self.leader_of()
             if lead:
                 report.leaders_seen.add(lead)
@@ -972,6 +1113,13 @@ async def soak(
                                        out_dir=wal_root + "-flight")
                 if engine_faults:
                     await Invariants.breaker_recovered(cluster)
+                # ISSUE 14 invariants: no critical verdict the injected
+                # faults don't explain, and the verdict RETURNS to
+                # healthy within a bounded window of the heal (the
+                # breaker-trip and forced-VC shapes both ride this)
+                assert_health_verdicts(report.verdicts, report.fault_span,
+                                       None)
+                recovery_s = await cluster.wait_healthy(timeout=30.0)
             finally:
                 await cluster.stop()
             if verbose:
@@ -986,7 +1134,8 @@ async def soak(
                 print(
                     f"round {r}: events={kinds} decisions={report.final_decisions} "
                     f"committed={report.final_committed} leaders={sorted(report.leaders_seen)} "
-                    f"post-heal decisions={report.decisions_after_heal}{extra} — OK"
+                    f"post-heal decisions={report.decisions_after_heal}{extra} "
+                    f"verdicts={report.verdicts} healthy_in={recovery_s:.1f}s — OK"
                 )
 
 

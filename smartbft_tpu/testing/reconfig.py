@@ -52,6 +52,13 @@ _BP_FIELDS = (
     "admission_high_water",
 )
 
+# unit-free float knobs travel as integer thousandths (x/1000): the RTT
+# multiplier is a small ratio, and 0.001 resolution is far below any
+# meaningful timer difference
+_X1000_FIELDS = (
+    "request_forward_rtt_multiplier",
+)
+
 _INT_FIELDS = (
     "request_batch_max_count",
     "request_batch_max_bytes",
@@ -112,6 +119,7 @@ class ConfigMirror:
     autoscale_high_occupancy_bp: int = 8500
     autoscale_low_occupancy_bp: int = 1500
     admission_high_water_bp: int = 10000
+    request_forward_rtt_multiplier_x1000: int = 0
     rotation_granularity: str = "decision"
     verify_mesh_topology: str = "1d"
     request_batch_max_interval_ms: int = 0
@@ -152,6 +160,8 @@ def mirror_config(config: Configuration) -> ConfigMirror:
     kwargs.update({f: getattr(config, f) for f in _BOOL_FIELDS})
     kwargs.update({f + "_ms": round(getattr(config, f) * 1000) for f in _MS_FIELDS})
     kwargs.update({f + "_bp": round(getattr(config, f) * 10000) for f in _BP_FIELDS})
+    kwargs.update({f + "_x1000": round(getattr(config, f) * 1000)
+                   for f in _X1000_FIELDS})
     return ConfigMirror(**kwargs)
 
 
@@ -161,6 +171,8 @@ def unmirror_config(m: ConfigMirror) -> Configuration:
     kwargs.update({f: getattr(m, f) for f in _BOOL_FIELDS})
     kwargs.update({f: getattr(m, f + "_ms") / 1000.0 for f in _MS_FIELDS})
     kwargs.update({f: getattr(m, f + "_bp") / 10000.0 for f in _BP_FIELDS})
+    kwargs.update({f: getattr(m, f + "_x1000") / 1000.0
+                   for f in _X1000_FIELDS})
     return Configuration(**kwargs)
 
 

@@ -462,6 +462,64 @@ class ShardedCluster:
         self._client_ids: dict[int, list[str]] = {}
         self._client_scan_pos: dict[int, int] = {}
         self._client_cache_epoch = self.set.epoch
+        #: cluster health plane (ISSUE 14): ONE monitor over the front
+        #: door's roll-up (ShardSet.health_source), the shared verify
+        #: plane, and every live replica's VC tracker (rebound across
+        #: reshards/restarts) — the in-process twin of
+        #: SocketCluster.cluster_health
+        from ..obs.health import HealthMonitor, coalescer_signal_source
+
+        self.health = HealthMonitor(
+            clock=self.scheduler.now, node="cluster",
+            recorder=recorder_for("set"),
+        )
+        self.health.add_source(
+            self.set.health_source(clock=self.scheduler.now)
+        )
+        self.health.add_source(coalescer_signal_source(self.coalescer))
+        self.health.add_source(self._vc_signal_source())
+
+    def _vc_signal_source(self):
+        """A source folding every LIVE replica's VC tracker signals into
+        cluster-level maxima, rebinding per-tracker latches as reshards/
+        restarts rebuild Consensus instances."""
+        from ..obs.health import vc_signal_source
+
+        bound: dict[int, tuple] = {}
+
+        def signals() -> dict:
+            out: dict = {}
+            live_keys: set[int] = set()
+            for sh in self.shard_list:
+                for a in sh.live_apps():
+                    c = a.consensus
+                    if c is None:
+                        continue
+                    key = id(c)
+                    live_keys.add(key)
+                    hit = bound.get(key)
+                    if hit is None:
+                        hit = bound[key] = (
+                            c, vc_signal_source(c.vc_phases,
+                                                clock=self.scheduler.now)
+                        )
+                    for k, v in hit[1]().items():
+                        out[k] = max(out.get(k, 0.0), v)
+            # prune dead Consensus bindings (restarts/reshards rebuild
+            # them): the strong ref in `bound` would otherwise keep every
+            # retired instance — pool, trackers and all — alive for the
+            # cluster's lifetime under a long autoscaled soak
+            for key in list(bound):
+                if key not in live_keys:
+                    del bound[key]
+            return out
+
+        return signals
+
+    def cluster_health(self) -> dict:
+        """Tick the cluster monitor and return the verdict (the sharded
+        front door's one-call health surface)."""
+        return self.health.tick()
 
     # -- lifecycle ---------------------------------------------------------
 

@@ -1,0 +1,189 @@
+"""Bench-row schema pin (ISSUE 14 satellite): every assemble_*_row output
+validates against the versioned schema, and drift (missing required keys,
+type changes) is caught — the prerequisite for the baseline guard's
+cross-round comparability."""
+
+import bench
+from smartbft_tpu.obs.benchschema import (
+    SCHEMA_VERSION,
+    identify_row,
+    validate_row,
+    validate_rows,
+)
+
+# ---------------------------------------------------------------------------
+# synthetic child rows shaped like each bench subprocess's real output
+# ---------------------------------------------------------------------------
+
+
+def _latency(p99=80.0):
+    return {"count": 10, "p50_ms": 10.0, "p95_ms": 40.0, "p99_ms": p99,
+            "mean_ms": 15.0, "max_ms": p99, "shed": {}, "histogram": {},
+            "pending_stamps": 0, "dropped_stamps": 0, "per_shard": {}}
+
+
+def _plane():
+    return {"ingest_us": 10.0, "route_us": 5.0, "vote_reg_us": 2.0,
+            "codec_us": 3.0, "broadcasts": 4, "sends": 2, "encodes": 4,
+            "decodes": 8, "batch_ingests": 2, "msgs_ingested": 8}
+
+
+def openloop_child_rows():
+    sweep = {
+        "bench": "openloop", "offered_per_sec": 200.0,
+        "goodput_per_sec": 195.0, "shards": 2, "zipf_skew": 1.1,
+        "admission_high_water": 0.8,
+        "open_loop": {"shed_rate": 0.0, "shed_admission": 0,
+                      "shed_timeout": 0, "peak_occupancy": 12},
+        "latency": _latency(),
+    }
+    knee = {"metric": "open_loop_knee", "slo": "x",
+            "last_ok": {"offered_per_sec": 200.0}, "first_overloaded": None,
+            "beyond_sweep": True}
+    degraded = {
+        "metric": "open_loop_degraded", "phases": {}, "notes": {},
+        "viewchange": {}, "trace": {}, "critical_path": {},
+        "health": {"final": {"status": "healthy", "reasons": []},
+                   "transitions": []},
+    }
+    return [sweep, knee, degraded]
+
+
+def transport_child_rows():
+    def row(flavor, tx):
+        return {"bench": "transport", "flavor": flavor, "nodes": 4,
+                "requests": 120, "payload_bytes": 256, "decisions": 14,
+                "elapsed_s": 1.0, "tx_per_sec": tx,
+                "transport": {"bytes_sent": 1000, "frames_per_flush": 1.1},
+                "protocol_plane": _plane(), "critical_path": {}}
+
+    return [
+        row("inproc", 700.0), row("uds", 650.0),
+        {"metric": "transport_paired",
+         "pairs": [{"flavor": "uds", "vs_inproc": 0.93}]},
+        {"metric": "cluster_timeline", "nodes": 4, "transport": "uds",
+         "requests": 24, "merged_events": 900, "offsets": {}, "hops": [],
+         "critical_path": {}},
+    ]
+
+
+def sharded_child_rows():
+    def point(s, tx):
+        return {"shards": s, "tx_per_sec": tx, "launches": 4,
+                "batch_fill_pct": 10.0, "items_per_launch": 8.0,
+                "mixed_waves": 1, "elapsed_s": 2.0, "launch_probe_ms": 220.0,
+                "shard": {"per_shard": {}, "aggregate": {}}}
+
+    return [
+        point(1, 400.0), point(4, 1200.0),
+        {"metric": "sharded_scaling", "value": 3.0},
+        {"metric": "live_resize", "path": [2, 4, 3], "phases": [],
+         "tracking_vs_first": 1.5, "reshard": {"transitions": 2}},
+    ]
+
+
+def mesh_child_rows():
+    def point(d, tx):
+        return {"bench": "mesh", "devices": d, "shards": 2, "crypto": "toy",
+                "tx_per_sec": tx, "launches": 3, "items_per_launch": 30.0,
+                "capacity_items_per_launch": 64, "batch_fill_pct": 50.0,
+                "pad_waste_pct": 5.0, "mixed_waves": 1, "elapsed_s": 2.0,
+                "launch_probe_ms": 200.0, "hold_s": 0.0,
+                "launches_ungated": 6, "batch_fill_ungated_pct": 25.0,
+                "tx_per_sec_ungated": tx * 0.9,
+                "mesh": {"devices": d, "topology": "1d",
+                         "shard_map_available": True, "downgrades": 0,
+                         "hold": {}}}
+
+    return [
+        point(1, 300.0), point(8, 900.0),
+        {"metric": "mesh_parity", "match": True, "devices_checked": [1, 8],
+         "items": 96},
+        {"metric": "mesh_parity_2d", "match": True, "counts_match": True,
+         "devices_checked": [2, 8], "items": 96},
+        {"metric": "mesh_scaling", "value": 8.0,
+         "items_per_launch_ratio": 6.0, "tx_ratio": 3.0},
+    ]
+
+
+def throughput_row(tx=800.0):
+    return {"bench": "throughput", "engine": "jax", "nodes": 16,
+            "requests": 1200, "pipeline": 16, "burst_decisions": 32,
+            "tx_per_sec": tx, "decisions": 32, "batch_fill_pct": 80.0,
+            "verify_us_per_sig": 6.0, "launches": 2,
+            "launches_per_decision": 0.06, "window_launches": [],
+            "launch_probe_ms": 220.0, "sigs_verified": 4000,
+            "elapsed_s": 5.0, "breaker": {"open": False}, "mesh": {},
+            "protocol_plane": _plane()}
+
+
+# ---------------------------------------------------------------------------
+# every assemble fn's output validates
+# ---------------------------------------------------------------------------
+
+
+def test_assembled_rows_pass_schema():
+    rows = [
+        bench.assemble_open_loop_row(openloop_child_rows()),
+        bench.assemble_transport_row(transport_child_rows(), "uds"),
+        bench.assemble_sharded_row(sharded_child_rows()),
+        bench.assemble_mesh_row(mesh_child_rows()),
+        bench.assemble_e2e_row(throughput_row(800.0), throughput_row(120.0),
+                               nodes=16, pipeline=16, decisions=32),
+    ]
+    families = [identify_row(r) for r in rows]
+    assert families == [
+        "open_loop_p99_ms", "transport_committed_tx_per_sec",
+        "sharded_committed_tx_per_sec", "mesh_committed_tx_per_sec",
+        "committed_tx_per_sec_n*",
+    ]
+    errors = validate_rows(rows)
+    assert errors == [], errors
+    assert SCHEMA_VERSION == 1
+
+
+def test_health_block_rides_open_loop_row():
+    row = bench.assemble_open_loop_row(openloop_child_rows())
+    assert row["health"]["final"]["status"] == "healthy"
+    assert validate_row(row) == []
+
+
+def test_drift_missing_required_key_is_caught():
+    row = bench.assemble_transport_row(transport_child_rows(), "uds")
+    del row["transport"]
+    errors = validate_row(row)
+    assert errors and "transport: required key missing" in errors[0]
+
+
+def test_drift_type_change_is_caught():
+    row = bench.assemble_open_loop_row(openloop_child_rows())
+    row["value"] = "80ms"  # a stringified value would break every differ
+    errors = validate_row(row)
+    assert any("value" in e and "expected int/float" in e for e in errors)
+    # a numeric field silently turning bool is drift too
+    row2 = bench.assemble_open_loop_row(openloop_child_rows())
+    row2["offered_per_sec"] = True
+    assert any("got bool" in e for e in validate_row(row2))
+
+
+def test_nested_block_drift_is_caught():
+    row = bench.assemble_open_loop_row(openloop_child_rows())
+    del row["latency"]["shed"]
+    errors = validate_row(row)
+    assert any("latency.shed" in e for e in errors)
+
+
+def test_unpinned_families_are_not_drift():
+    assert identify_row({"metric": "some_new_family", "value": 1}) is None
+    assert validate_row({"metric": "some_new_family", "value": 1}) == []
+    assert validate_row({"bench": "openloop"}) == []  # child rows unpinned
+
+
+def test_kernel_and_tiny_rows_validate():
+    kernel = {"metric": "p256_sig_verify_p50_us", "value": 5.8,
+              "unit": "us/sig", "vs_baseline": 10.0, "vs_all_cores": 2.0,
+              "cores": 8, "protocol_plane": _plane()}
+    assert validate_row(kernel) == []
+    from smartbft_tpu.obs.baseline import tiny_logical_row
+
+    assert validate_row(tiny_logical_row(requests=4)) == []

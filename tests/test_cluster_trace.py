@@ -127,6 +127,116 @@ def test_link_summary_recovers_hop_time_through_skew():
     assert len(rows) == 1
     assert rows[0]["link"] == "n1->n2"
     assert abs(rows[0]["p50_ms"] - 3.0) < 0.01
+    assert rows[0]["clamped"] == 0
+
+
+# ---------------------------------------------------------------------------
+# clock-offset merge edge cases (ISSUE 14 satellite): negative offsets,
+# err_bound exceeding the hop time, a node missing offset data
+# ---------------------------------------------------------------------------
+
+
+def test_merged_events_with_negative_offsets_keep_causal_order():
+    """A replica whose clock runs BEHIND the parent's has a negative
+    offset; the merge must shift its events FORWARD (t - offset adds)
+    and keep the cross-node order causal."""
+    dumps = [
+        {"node": "n1", "clock_offset_s": -0.4,
+         "events": [{"t": 9.7, "kind": "send"}]},     # true t = 10.1
+        {"node": "n2", "clock_offset_s": -0.1,
+         "events": [{"t": 9.95, "kind": "recv"}]},    # true t = 10.05
+    ]
+    events = merged_events(dumps)
+    assert [e["kind"] for e in events] == ["recv", "send"]
+    assert abs(events[1]["t"] - 10.1) < 1e-9
+
+
+def test_link_summary_clamps_negative_network_time():
+    """On loopback the offset error bound (RTT/2) exceeds the real hop
+    time, so the recovered per-link value can come out NEGATIVE — it
+    must be clamped to 0 and COUNTED, never published as a physically
+    impossible measurement."""
+    offsets = {"n1": 0.0, "n2": 0.0}
+    sent_parent = 50.0
+    # the skew error makes the receive stamp land 2ms BEFORE the send
+    events = [
+        {"t": sent_parent - 0.002, "kind": "net.recv", "node": "n2",
+         "extra": {"from": 1, "sent_us": int(sent_parent * 1e6),
+                   "hop": 1, "origin": 1}},
+        {"t": sent_parent + 0.001, "kind": "net.recv", "node": "n2",
+         "extra": {"from": 1, "sent_us": int(sent_parent * 1e6),
+                   "hop": 1, "origin": 1}},
+    ]
+    (row,) = link_summary(events, offsets)
+    assert row["count"] == 2
+    assert row["clamped"] == 1
+    # every published value is non-negative after the clamp
+    assert min(row["p50_ms"], row["p95_ms"], row["p99_ms"],
+               row["max_ms"]) >= 0.0
+
+
+def test_missing_offset_node_degrades_loudly():
+    """A node absent from the offsets file merges UNALIGNED (no silent
+    assumed-zero skew): its events still appear on the timeline, its
+    per-link rows are excluded in BOTH directions, and the render says
+    so out loud."""
+    from smartbft_tpu.obs.report import render
+
+    sent_us = int(20.0 * 1e6)
+    dumps = [
+        {"node": "n1", "clock_offset_s": 0.1, "offset_known": True,
+         "events": [
+             {"t": 20.002, "kind": "net.recv", "node": "n1",
+              "extra": {"from": 3, "sent_us": sent_us, "hop": 1,
+                        "origin": 3}},
+         ]},
+        # n3 has NO offset estimate (its ping failed mid-sweep)
+        {"node": "n3", "clock_offset_s": 0.0, "offset_known": False,
+         "events": [
+             {"t": 20.001, "kind": "net.recv", "node": "n3",
+              "extra": {"from": 1, "sent_us": sent_us, "hop": 1,
+                        "origin": 1}},
+             {"t": 20.5, "kind": "req.deliver", "key": "c:1"},
+         ]},
+    ]
+    events = merged_events(dumps)
+    assert len(events) == 3              # n3's events still merge
+    offsets = {"n1": 0.1}                # n3 deliberately absent
+    rows = link_summary(events, offsets)
+    # both directions touch n3's unestimated clock: no rows published
+    assert rows == []
+    out = render(dumps)
+    assert "WARNING" in out and "n3" in out
+    assert "UNALIGNED" in out
+
+
+def test_report_offsets_file_marks_missing_nodes(tmp_path):
+    """The --offsets CLI path: a node absent from the offsets file gets
+    offset_known=False and the render warns."""
+    import json
+
+    from smartbft_tpu.obs import report as report_mod
+
+    d1 = tmp_path / "flight-n1.json"
+    d2 = tmp_path / "flight-n9.json"
+    d1.write_text(json.dumps({
+        "node": "n1", "events": [{"t": 1.0, "kind": "a"}]
+    }))
+    d2.write_text(json.dumps({
+        "node": "n9", "events": [{"t": 1.5, "kind": "b"}]
+    }))
+    offs = tmp_path / "offsets.json"
+    offs.write_text(json.dumps({"n1": {"offset_s": 0.25}}))
+    import io
+    from contextlib import redirect_stdout
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = report_mod.main([str(d1), str(d2), "--offsets", str(offs)])
+    assert rc == 0
+    out = buf.getvalue()
+    assert "clock-aligned" in out
+    assert "WARNING" in out and "n9" in out
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,423 @@
+"""Cluster health plane (ISSUE 14): declarative SLOs, burn-rate verdicts,
+breach events on the timeline, and the chaos acceptance scenario."""
+
+import asyncio
+
+import pytest
+
+from smartbft_tpu.obs.health import (
+    EventLatch,
+    HealthMonitor,
+    aggregate_cluster_verdict,
+    pool_signal_source,
+    vc_signal_source,
+)
+from smartbft_tpu.obs.recorder import TraceRecorder
+from smartbft_tpu.obs.slo import (
+    SLOEvaluator,
+    SLORule,
+    SLOSpec,
+    default_slo_spec,
+)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# ---------------------------------------------------------------------------
+# SLO evaluator units
+# ---------------------------------------------------------------------------
+
+
+def test_spec_validation_rejects_bad_rules():
+    with pytest.raises(ValueError, match="kind"):
+        SLOSpec(rules=(SLORule("a", "a", 1.0, kind="sideways"),)).validate()
+    with pytest.raises(ValueError, match="budget"):
+        SLOSpec(rules=(SLORule("a", "a", 1.0, budget=0.0),)).validate()
+    with pytest.raises(ValueError, match="fast window"):
+        SLOSpec(rules=(SLORule("a", "a", 1.0, fast_window_s=10.0,
+                               slow_window_s=5.0),)).validate()
+    with pytest.raises(ValueError, match="duplicate"):
+        SLOSpec(rules=(SLORule("a", "a", 1.0),
+                       SLORule("a", "b", 2.0))).validate()
+    with pytest.raises(ValueError, match="critical ceiling"):
+        SLOSpec(rules=(SLORule("a", "a", 5.0, critical_bound=1.0),)).validate()
+    default_slo_spec().validate()  # the shipped spec must be valid
+
+
+def test_multi_window_burn_requires_both_windows():
+    """One bad sample in a long history breaches the fast window but not
+    the slow one — the verdict must NOT flap (the Google-SRE rationale
+    for multi-window burn rates)."""
+    clock = FakeClock()
+    rule = SLORule("lat", "lat", 100.0, budget=0.2,
+                   fast_window_s=2.0, slow_window_s=60.0)
+    ev = SLOEvaluator(SLOSpec(rules=(rule,)), clock=clock)
+    # 60 s of healthy history
+    for _ in range(240):
+        clock.advance(0.25)
+        ev.observe({"lat": 10.0})
+    # one transient blip: fast burn high, slow burn low -> no breach
+    clock.advance(0.25)
+    ev.observe({"lat": 500.0})
+    assert ev.evaluate().status == "healthy"
+    # a SUSTAINED violation breaches both windows
+    for _ in range(80):
+        clock.advance(0.25)
+        ev.observe({"lat": 500.0})
+    v = ev.evaluate()
+    assert v.status == "degraded"
+    assert v.reasons == ["lat"]
+    b = v.breaches[0].as_dict()
+    assert b["burn_fast"] >= 1.0 and b["burn_slow"] >= 1.0
+    assert b["value"] == 500.0 and b["bound"] == 100.0
+
+
+def test_recovery_clears_via_fast_window():
+    clock = FakeClock()
+    rule = SLORule("lat", "lat", 100.0, budget=0.05,
+                   fast_window_s=2.0, slow_window_s=30.0)
+    ev = SLOEvaluator(SLOSpec(rules=(rule,)), clock=clock)
+    for _ in range(40):
+        clock.advance(0.25)
+        ev.observe({"lat": 500.0})
+    assert ev.evaluate().status == "degraded"
+    # recovery: within one fast window of clean samples the verdict
+    # returns to healthy even though the slow window still burns
+    for _ in range(10):
+        clock.advance(0.25)
+        ev.observe({"lat": 10.0})
+    assert ev.evaluate().status == "healthy"
+
+
+def test_floor_rule_and_critical_escalation():
+    clock = FakeClock()
+    spec = SLOSpec(rules=(
+        SLORule("fill", "fill", 50.0, kind="floor", budget=0.1,
+                fast_window_s=2.0, slow_window_s=10.0),
+        SLORule("det", "det", 1.0, critical_bound=10.0, budget=0.1,
+                fast_window_s=2.0, slow_window_s=10.0),
+    ))
+    ev = SLOEvaluator(spec, clock=clock)
+    for _ in range(60):
+        clock.advance(0.25)
+        ev.observe({"fill": 5.0, "det": 20.0})
+    v = ev.evaluate()
+    assert v.status == "critical"
+    by_name = {b.slo: b for b in v.breaches}
+    assert by_name["fill"].severity == "degraded"   # floor violated
+    assert by_name["det"].severity == "critical"    # past critical bound
+    # critical breaches rank first
+    assert v.breaches[0].slo == "det"
+
+
+def test_missing_signals_never_breach():
+    clock = FakeClock()
+    ev = SLOEvaluator(default_slo_spec(), clock=clock)
+    for _ in range(100):
+        clock.advance(0.25)
+        ev.observe({})  # nothing wired
+    assert ev.evaluate().status == "healthy"
+
+
+def test_samples_bounded_by_slow_window():
+    clock = FakeClock()
+    rule = SLORule("x", "x", 1.0, fast_window_s=1.0, slow_window_s=5.0)
+    ev = SLOEvaluator(SLOSpec(rules=(rule,)), clock=clock)
+    for _ in range(10_000):
+        clock.advance(0.25)
+        ev.observe({"x": 0.0})
+    (state,) = ev._states.values()
+    assert len(state.samples) <= 5.0 / 0.25 + 2
+
+
+# ---------------------------------------------------------------------------
+# signal sources + latching
+# ---------------------------------------------------------------------------
+
+
+def test_event_latch_holds_then_releases():
+    latch = EventLatch(5.0)
+    assert latch.update(3, 42.0, t0 := 0.0) == 0.0  # history, not an event
+    assert latch.update(4, 42.0, 1.0) == 42.0       # counter moved: latch
+    assert latch.update(4, 42.0, 5.9) == 42.0       # still inside hold
+    assert latch.update(4, 42.0, 6.1) == 0.0        # aged out
+    assert latch.update(5, 7.0, 7.0) == 7.0         # new event re-latches
+    # a counter DROP (restart reset / aggregate losing a member to a
+    # scale-in) is NOT a fresh event and must not latch a phantom value
+    latch2 = EventLatch(5.0)
+    latch2.update(10, 0.0, 0.0)
+    assert latch2.update(3, 1.0, 1.0) == 0.0
+    # and the next genuine increase still latches from the new anchor
+    assert latch2.update(4, 1.0, 2.0) == 1.0
+    del t0
+
+
+def test_pool_signal_source_fill_and_shed_latch():
+    clock = FakeClock()
+    occ = {"size": 40, "waiters": 10, "capacity": 100,
+           "shed_admission": 0, "shed_timeout": 0}
+    src = pool_signal_source(lambda: occ, clock=clock, latch_s=5.0)
+    sig = src()
+    assert sig["pool.fill"] == pytest.approx(0.5)
+    assert sig["pool.shed_recent"] == 0.0
+    occ["shed_admission"] = 3
+    clock.advance(1.0)
+    assert src()["pool.shed_recent"] == 1.0
+    clock.advance(10.0)
+    assert src()["pool.shed_recent"] == 0.0
+
+
+def test_vc_signal_source_latches_detection():
+    from smartbft_tpu.obs.vcphases import ViewChangePhaseTracker
+
+    clock = FakeClock()
+    tr = ViewChangePhaseTracker(clock=clock, node="n1")
+    src = vc_signal_source(tr, clock=clock, latch_s=5.0)
+    assert src()["viewchange.detection_seconds"] == 0.0
+    tr.detection(3.5)
+    clock.advance(1.0)
+    sig = src()
+    assert sig["viewchange.detection_seconds"] == pytest.approx(3.5)
+    clock.advance(10.0)
+    assert src()["viewchange.detection_seconds"] == 0.0
+    # an ARMED-only round (lone complainer) reads 0 active; the round
+    # counts as active once the complaint QUORUM commits the node to it
+    tr.armed(1)
+    clock.advance(2.0)
+    assert src()["viewchange.active_seconds"] == 0.0
+    tr.joined(1)
+    clock.advance(1.5)
+    assert src()["viewchange.active_seconds"] == pytest.approx(1.5)
+
+
+# ---------------------------------------------------------------------------
+# HealthMonitor + aggregation
+# ---------------------------------------------------------------------------
+
+
+def test_monitor_transitions_and_breach_events():
+    clock = FakeClock()
+    rec = TraceRecorder(clock=clock, node="n1", capacity=64)
+    spec = SLOSpec(rules=(
+        SLORule("viewchange.detection_seconds",
+                "viewchange.detection_seconds", 1.0, budget=0.1,
+                fast_window_s=2.0, slow_window_s=20.0),
+    ))
+    mon = HealthMonitor(spec, clock=clock, recorder=rec, node="n1")
+    value = {"v": 0.0}
+    mon.add_source(lambda: {"viewchange.detection_seconds": value["v"]})
+    for _ in range(20):
+        clock.advance(0.25)
+        mon.tick()
+    assert mon.status == "healthy"
+    value["v"] = 4.0
+    for _ in range(20):
+        clock.advance(0.25)
+        mon.tick()
+    assert mon.status == "degraded"
+    assert mon.reasons[0]["slo"] == "viewchange.detection_seconds"
+    value["v"] = 0.0
+    for _ in range(20):
+        clock.advance(0.25)
+        mon.tick()
+    assert mon.status == "healthy"
+    kinds = [(e.kind, (e.extra or {}).get("status")) for e in rec.events()]
+    assert ("slo.breach", "degraded") in kinds
+    assert ("slo.clear", "healthy") in kinds
+    log = mon.transition_log()
+    assert [t["status"] for t in log] == ["degraded", "healthy"]
+    assert log[0]["slos"] == ["viewchange.detection_seconds"]
+
+
+def test_monitor_source_failure_is_counted_not_fatal():
+    mon = HealthMonitor(clock=FakeClock())
+    mon.add_source(lambda: 1 / 0)
+    v = mon.tick()
+    assert v["status"] == "healthy"
+    assert mon.source_errors == 1
+
+
+def test_aggregate_cluster_verdict():
+    healthy = {"status": "healthy", "reasons": []}
+    degraded = {"status": "degraded",
+                "reasons": [{"slo": "pool.fill", "severity": "degraded"}]}
+    critical = {"status": "critical",
+                "reasons": [{"slo": "x", "severity": "critical"}]}
+    agg = aggregate_cluster_verdict({"n1": healthy, "n2": healthy})
+    assert agg["status"] == "healthy" and agg["unreachable"] == []
+    agg = aggregate_cluster_verdict({"n1": healthy, "n2": degraded})
+    assert agg["status"] == "degraded"
+    assert agg["reasons"][0]["node"] == "n2"
+    agg = aggregate_cluster_verdict({"n1": healthy, "n2": critical})
+    assert agg["status"] == "critical"
+    # one unreachable of four degrades; a majority gone is critical
+    agg = aggregate_cluster_verdict(
+        {"n1": healthy, "n2": healthy, "n3": healthy}, unreachable=["n4"]
+    )
+    assert agg["status"] == "degraded"
+    assert agg["replicas"] == {"n1": "healthy", "n2": "healthy",
+                               "n3": "healthy"}
+    agg = aggregate_cluster_verdict({"n1": healthy},
+                                    unreachable=["n2", "n3", "n4"])
+    assert agg["status"] == "critical"
+
+
+def test_shard_set_health_source_shapes():
+    """ShardSet.health_signals/health_source: the front-door roll-up
+    feeds the monitor the same signal names the per-replica sources use
+    (stub shards — no cluster needed)."""
+    from smartbft_tpu.shard.set import ShardSet
+    from smartbft_tpu.shard.router import ShardRouter
+
+    class StubShard:
+        def __init__(self, sid):
+            self.shard_id = sid
+
+        async def start(self):
+            pass
+
+        async def stop(self):
+            pass
+
+        async def submit(self, raw):
+            pass
+
+        def poll_committed(self, since):
+            return []
+
+        def pool_occupancy(self):
+            return {"size": 30, "capacity": 100, "free": 70, "waiters": 5,
+                    "shed_admission": 2, "shed_timeout": 0}
+
+    s = ShardSet([StubShard(0), StubShard(1)], router=ShardRouter(2))
+    sig = s.health_signals()
+    # client-FELT fill: pooled + waiters over capacity (waiters included,
+    # matching the per-replica pool_signal_source definition)
+    assert sig["pool.fill"] == pytest.approx((60 + 10) / 200)
+    assert sig["pool.shed_total"] == 4.0
+    clock = FakeClock()
+    src = s.health_source(clock=clock)
+    first = src()
+    assert first["pool.shed_recent"] == 0.0  # pre-existing history
+    assert "pool.fill" in first
+
+
+# ---------------------------------------------------------------------------
+# soak gate semantics
+# ---------------------------------------------------------------------------
+
+
+def test_assert_health_verdicts_gate():
+    from smartbft_tpu.testing.chaos import assert_health_verdicts
+
+    inside = [(0.0, "healthy", []), (3.0, "critical", ["x"]),
+              (9.0, "healthy", [])]
+    assert_health_verdicts(inside, (2.0, 8.0), {"status": "healthy"})
+    with pytest.raises(AssertionError, match="outside"):
+        assert_health_verdicts(
+            [(50.0, "critical", ["x"])], (2.0, 8.0), None, recovery_s=10.0
+        )
+    with pytest.raises(AssertionError, match="still critical"):
+        assert_health_verdicts([], (0.0, 0.0), {"status": "critical"})
+    # NO fault window at all: every critical sample is unexplained and
+    # fails — there is no default free-pass window
+    with pytest.raises(AssertionError, match="outside"):
+        assert_health_verdicts([(5.0, "critical", ["x"])], None, None)
+    assert_health_verdicts([(5.0, "degraded", ["x"])], None, None)
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance scenario (tier-1): mute the leader -> the cluster verdict
+# transitions healthy -> degraded (the breaching SLO named:
+# viewchange.detection_seconds) -> healthy within the recovery bound, with
+# the breach event visible on the merged timeline.
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_mute_leader_health_verdict_cycle(tmp_path):
+    from smartbft_tpu.obs.report import merged_events
+    from smartbft_tpu.testing.chaos import (
+        ChaosCluster,
+        Invariants,
+        assert_health_verdicts,
+        mute_leader_schedule,
+    )
+
+    async def run():
+        cluster = ChaosCluster(str(tmp_path), n=4, depth=1, rotation=False,
+                               trace=True)
+        await cluster.start()
+        try:
+            report = await cluster.run_schedule(
+                mute_leader_schedule(), requests=12
+            )
+            Invariants.fork_free(cluster)
+            Invariants.exactly_once(cluster, expected=12)
+            # the verdict cycle: healthy -> degraded with the breaching
+            # SLO NAMED -> healthy again
+            statuses = [(s, names) for _t, s, names in report.verdicts]
+            assert statuses[0][0] == "healthy", report.verdicts
+            degraded = [n for s, n in statuses if s == "degraded"]
+            assert degraded, f"never degraded: {report.verdicts}"
+            assert any("viewchange.detection_seconds" in names
+                       for names in degraded), report.verdicts
+            # no critical outside the injected-fault window; and the
+            # verdict RETURNS to healthy within the recovery bound
+            assert_health_verdicts(report.verdicts, report.fault_span,
+                                   None)
+            recovery = await cluster.wait_healthy(timeout=30.0)
+            assert recovery <= 30.0
+            # the breach event landed on the merged timeline, next to
+            # its cause (the vc.detected mark)
+            dumps = [r.dump() for r in cluster.recorders.values()]
+            events = merged_events(dumps)
+            kinds = [e["kind"] for e in events]
+            assert "slo.breach" in kinds and "vc.detected" in kinds
+            breach = next(e for e in events if e["kind"] == "slo.breach")
+            assert "viewchange.detection_seconds" in \
+                breach["extra"]["slos"]
+            # causality on ONE timeline: the breach follows the detection
+            detect_t = next(e["t"] for e in events
+                            if e["kind"] == "vc.detected")
+            assert breach["t"] >= detect_t
+        finally:
+            await cluster.stop()
+
+    asyncio.run(run())
+
+
+def test_sharded_cluster_health_surface(tmp_path):
+    """The in-process sharded front door exposes ONE cluster verdict
+    (ShardSet roll-up + per-replica VC trackers + shared verify plane)."""
+    from smartbft_tpu.testing.app import wait_for
+    from smartbft_tpu.testing.sharded import ShardedCluster
+
+    async def run():
+        cluster = ShardedCluster(str(tmp_path), shards=2, n=4, depth=1,
+                                 window=0.002, seed=11)
+        await cluster.start()
+        try:
+            for k in range(4):
+                await cluster.submit(cluster.client_for_shard(k % 2),
+                                     f"h-{k}")
+            await wait_for(
+                lambda: cluster.committed_requests() >= 4,
+                cluster.scheduler, 60.0,
+            )
+            v = cluster.cluster_health()
+            assert v["status"] == "healthy", v
+            assert v["spec"] == "default"
+            assert v["ticks"] >= 1
+        finally:
+            await cluster.stop()
+
+    asyncio.run(run())

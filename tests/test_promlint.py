@@ -1,0 +1,103 @@
+"""Prometheus exposition lint (ISSUE 14 satellite): the text-format
+grammar validator plus the guarantee that this repo's own exposition
+stays scrapeable as counters keep accreting."""
+
+from smartbft_tpu.metrics import (
+    MetricOpts,
+    MetricsBundle,
+    PrometheusProvider,
+    escape_label_value,
+    lint_prometheus_text,
+)
+
+
+def _full_bundle_provider() -> PrometheusProvider:
+    p = PrometheusProvider()
+    b = MetricsBundle(p)
+    b.pool.count_of_requests.set(3)
+    b.pool.count_of_failed_add_requests.with_labels("semaphore").add(2)
+    b.view.view_number.set(2)
+    b.view_change.heartbeat_detection_seconds.set(3.5)
+    b.tpu.batch_fill_percent.observe(42.0)
+    b.pool.latency_of_requests.observe(0.01)
+    b.pool.latency_of_requests.observe(0.02)
+    return p
+
+
+def test_full_bundle_exposition_is_lint_clean():
+    text = _full_bundle_provider().expose()
+    assert lint_prometheus_text(text) == []
+    # the exposition actually carries the new health-relevant gauges
+    assert "consensus_viewchange_heartbeat_detection_seconds 3.5" in text
+
+
+def test_label_values_are_escaped_and_lintable():
+    p = PrometheusProvider()
+    c = p.new_counter(MetricOpts(
+        namespace="consensus", subsystem="t", name="labeled", help="h",
+        label_names=("who",),
+    ))
+    c.with_labels('evil"quote\\back\nnewline').add(1)
+    text = p.expose()
+    assert lint_prometheus_text(text) == []
+    assert '\\"' in text and "\\n" in text
+    assert escape_label_value('a"b') == 'a\\"b'
+    assert escape_label_value("a\nb") == "a\\nb"
+    assert escape_label_value("a\\b") == "a\\\\b"
+
+
+def test_legacy_label_with_equals_is_rewritten():
+    """A value-only legacy label CONTAINING '=' is still not exposition
+    grammar — it must be rewritten to a quoted pair, not passed raw."""
+    p = PrometheusProvider()
+    c = p.new_counter(MetricOpts(namespace="consensus", subsystem="t",
+                                 name="legacy", help="h"))
+    c.with_labels("query=slow").add(1)
+    text = p.expose()
+    assert lint_prometheus_text(text) == []
+    assert 'label="query=slow"' in text
+
+
+def test_lint_catches_each_grammar_violation():
+    bad = "\n".join([
+        "# TYPE foo counter",
+        "foo 1",
+        "foo 2",                      # duplicate sample
+        "# TYPE foo counter",         # duplicate TYPE, after samples
+        "# HELP foo help",
+        "# HELP foo help",            # duplicate HELP
+        "bar{x=unquoted} 1",          # unquoted label value
+        'baz{9bad="v"} 1',            # bad label name
+        'qux{y="ok"} notafloat',      # non-float value
+        "# TYPE hist histogram",
+        "hist 3",                     # bare histogram sample
+        "# TYPE weird banana",        # unknown type keyword
+        "# TYPE gaugey gauge",
+        "gaugey_bucket 1",            # gauge with a histogram suffix
+    ])
+    problems = lint_prometheus_text(bad)
+    joined = "\n".join(problems)
+    for needle in (
+        "duplicate sample", "duplicate TYPE", "TYPE for foo after",
+        "duplicate HELP", "bad label syntax", "bad label name",
+        "not a float", "bare sample", "unknown TYPE",
+        "gauge gaugey exposes suffixed sample",
+    ):
+        assert needle in joined, f"lint missed: {needle}\n{joined}"
+
+
+def test_lint_accepts_legal_corner_cases():
+    good = "\n".join([
+        "# TYPE h histogram",
+        '# HELP h a histogram',
+        'h_bucket{le="+Inf"} 2',
+        "h_count 2",
+        "h_sum 0.03",
+        "# TYPE g gauge",
+        "g -3.5e-2",
+        "plain_untyped_sample 1 1700000000",   # timestamped, untyped: legal
+        "# a free-form comment",
+        'same_name{a="1"} 1',
+        'same_name{a="2"} 1',                  # same name, distinct labels
+    ])
+    assert lint_prometheus_text(good) == []

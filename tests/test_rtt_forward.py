@@ -1,0 +1,179 @@
+"""RTT-derived follower forwarding (ISSUE 14 satellite): the pool's
+forward timer derives from the transport's measured RTT, with the
+configured constant as ceiling + fallback.  The end-to-end socket pin
+(follower submit no longer waits out the constant) lives in
+tests/test_net_cluster.py's smoke gate."""
+
+import asyncio
+import types
+
+import pytest
+
+from smartbft_tpu.config import ConfigError, Configuration
+from smartbft_tpu.consensus import Consensus
+from smartbft_tpu.core.pool import (
+    FORWARD_TIMEOUT_FLOOR,
+    Pool,
+    PoolOptions,
+)
+from smartbft_tpu.types import RequestInfo
+from smartbft_tpu.utils.clock import Scheduler
+from smartbft_tpu.utils.logging import RecordingLogger
+
+
+class _Handler:
+    def __init__(self):
+        self.forwarded = []
+
+    def on_request_timeout(self, request, info):
+        self.forwarded.append(info)
+
+    def on_leader_fwd_request_timeout(self, request, info):
+        pass
+
+    def on_auto_remove_timeout(self, info):
+        pass
+
+
+class _Inspector:
+    def request_id(self, raw):
+        return RequestInfo(client_id="c", request_id=raw.decode())
+
+
+def _pool(scheduler, handler, forward_timeout_fn=None):
+    opts = PoolOptions(
+        queue_size=8,
+        forward_timeout=1.0,
+        complain_timeout=120.0,
+        auto_remove_timeout=240.0,
+        request_max_bytes=100,
+        submit_timeout=1.0,
+        forward_timeout_fn=forward_timeout_fn,
+    )
+    return Pool(RecordingLogger("pool"), _Inspector(), handler, opts,
+                scheduler)
+
+
+# ---------------------------------------------------------------------------
+# pool clamp semantics
+# ---------------------------------------------------------------------------
+
+
+def test_forward_timeout_clamps_into_floor_and_ceiling():
+    sched = Scheduler()
+    pool = _pool(sched, _Handler())
+    assert pool._forward_timeout() == 1.0          # no fn: the constant
+    for derived, expect in (
+        (0.000_05, FORWARD_TIMEOUT_FLOOR),         # µs RTT: the floor
+        (0.2, 0.2),                                # in range: as derived
+        (5.0, 1.0),                                # above ceiling: clamped
+        (None, 1.0),                               # no measurement yet
+        (0.0, 1.0),                                # degenerate: fallback
+    ):
+        pool._opts.forward_timeout_fn = lambda d=derived: d
+        assert pool._forward_timeout() == pytest.approx(expect), derived
+    # a raising provider falls back to the constant, never wedges timers
+    def boom():
+        raise RuntimeError("telemetry died")
+
+    pool._opts.forward_timeout_fn = boom
+    assert pool._forward_timeout() == 1.0
+
+
+def test_derived_forward_timer_fires_early_on_logical_clock():
+    """With a 0.2 s derived timeout the forward fires at 0.2 logical
+    seconds — not at the 1.0 s configured constant."""
+    sched = Scheduler()
+    handler = _Handler()
+    pool = _pool(sched, handler, forward_timeout_fn=lambda: 0.2)
+
+    async def run():
+        await pool.submit(b"r1")
+        sched.advance_by(0.1)
+        await asyncio.sleep(0)
+        assert handler.forwarded == []
+        sched.advance_by(0.15)
+        await asyncio.sleep(0)
+        assert [str(i) for i in handler.forwarded] == ["c:r1"]
+
+    asyncio.run(run())
+
+
+def test_restart_timers_rederives_forward_timeout():
+    sched = Scheduler()
+    handler = _Handler()
+    derived = {"v": 0.5}
+    pool = _pool(sched, handler, forward_timeout_fn=lambda: derived["v"])
+
+    async def run():
+        await pool.submit(b"r1")
+        pool.stop_timers()
+        derived["v"] = 0.05   # the RTT estimate improved meanwhile
+        pool.restart_timers()
+        sched.advance_by(0.06)
+        await asyncio.sleep(0)
+        assert [str(i) for i in handler.forwarded] == ["c:r1"]
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# transport RTT estimation
+# ---------------------------------------------------------------------------
+
+
+def test_transport_rtt_ewma_and_envelope():
+    from smartbft_tpu.net.transport import SocketComm
+
+    comm = SocketComm(1, "uds:///tmp/x.sock", {2: "a", 3: "b"})
+    assert comm.rtt_seconds() is None        # nothing measured yet
+    comm._note_rtt(2, 0.001)
+    comm._note_rtt(3, 0.004)
+    assert comm.rtt_seconds() == pytest.approx(0.004)  # worst peer wins
+    # EWMA: a new sample moves the estimate 30% of the way
+    comm._note_rtt(3, 0.008)
+    assert comm.rtt_seconds() == pytest.approx(0.7 * 0.004 + 0.3 * 0.008)
+    comm._note_rtt(2, -1.0)                  # garbage sample ignored
+    assert comm._rtt[2] == pytest.approx(0.001)
+    snap = comm.transport_snapshot()
+    assert set(snap["rtt_ms"]) == {"2", "3"}
+
+
+# ---------------------------------------------------------------------------
+# consensus wiring + config plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_consensus_forward_fn_wiring():
+    def fn_for(mult, comm):
+        stub = types.SimpleNamespace(
+            config=Configuration(self_id=1,
+                                 request_forward_rtt_multiplier=mult),
+            comm=comm,
+        )
+        return Consensus._forward_timeout_fn(stub)
+
+    # knob off, or a Comm without RTT (the in-process Network): no fn
+    rttless = types.SimpleNamespace()
+    assert fn_for(0.0, rttless) is None
+    assert fn_for(20.0, rttless) is None
+    measured = types.SimpleNamespace(rtt_seconds=lambda: 0.002)
+    assert fn_for(0.0, measured) is None
+    fn = fn_for(20.0, measured)
+    assert fn() == pytest.approx(0.04)
+    cold = types.SimpleNamespace(rtt_seconds=lambda: None)
+    assert fn_for(20.0, cold)() is None
+
+
+def test_config_validation_and_mirror_round_trip():
+    with pytest.raises(ConfigError, match="rtt_multiplier"):
+        Configuration(self_id=1,
+                      request_forward_rtt_multiplier=-1.0).validate()
+    Configuration(self_id=1, request_forward_rtt_multiplier=0.0).validate()
+    Configuration(self_id=1, request_forward_rtt_multiplier=20.0).validate()
+    from smartbft_tpu.testing.reconfig import mirror_config, unmirror_config
+
+    c = Configuration(self_id=1, request_forward_rtt_multiplier=12.5)
+    assert unmirror_config(
+        mirror_config(c)
+    ).request_forward_rtt_multiplier == 12.5
