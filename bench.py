@@ -578,6 +578,51 @@ def assemble_open_loop_row(rows: list) -> dict:
     }
 
 
+def viewchange_guard_rows(rows: list) -> list:
+    """The ISSUE 15 longitudinal failover pins: scalar rows derived from
+    the degraded run so ``--check-baseline`` catches a failover
+    regression — the forced-VC phase's request p99 (the round-12
+    degraded-table cell that crowned view change the worst failure mode)
+    and the detection arm-to-fire p99 under the muted leader.  Pure
+    function, importable; returns [] when the degraded run is absent."""
+    degraded = next(
+        (r for r in rows if r.get("metric") == "open_loop_degraded"), None
+    )
+    if not degraded:
+        return []
+    out = []
+    phases = degraded.get("phases") or {}
+    vc_phase = phases.get("view_change") or {}
+    p99 = vc_phase.get("p99_ms")
+    if isinstance(p99, (int, float)):
+        healthy = (phases.get("healthy") or {}).get("p99_ms")
+        row = {
+            "metric": "viewchange_phase_p99_ms",
+            "value": p99,
+            "unit": "ms",
+            "offered_per_sec": degraded.get("offered_per_sec"),
+            "shards": degraded.get("shards"),
+        }
+        if isinstance(healthy, (int, float)):
+            row["healthy_p99_ms"] = healthy
+            if healthy:
+                row["vs_healthy"] = round(p99 / healthy, 2)
+        out.append(row)
+    det = (degraded.get("viewchange") or {}).get("detection") or {}
+    if det.get("count") and isinstance(det.get("p99_ms"), (int, float)):
+        out.append({
+            "metric": "viewchange_detection_p99_ms",
+            "value": det["p99_ms"],
+            "unit": "ms",
+            "count": det.get("count"),
+            "offered_per_sec": degraded.get("offered_per_sec"),
+            "shards": degraded.get("shards"),
+            # the effective-timer derivation that produced it, verbatim
+            "timer": (degraded.get("viewchange") or {}).get("timer"),
+        })
+    return out
+
+
 def open_loop_bench(cpu_mode: bool) -> None:
     """Run benchmarks/openloop.py in a subprocess and print ONE JSON line
     whose ``latency`` block carries percentiles + histogram + shed counts
@@ -616,6 +661,8 @@ def open_loop_bench(cpu_mode: bool) -> None:
     rows = [json.loads(l) for l in proc.stdout.decode().splitlines()
             if l.strip()]
     _emit(assemble_open_loop_row(rows))
+    for guard_row in viewchange_guard_rows(rows):
+        _emit(guard_row)
 
 
 def transport_bench(flavor: str) -> None:

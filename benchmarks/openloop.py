@@ -84,6 +84,12 @@ def openloop_config(pool_size: int, batch: int, admission: float):
             request_auto_remove_timeout=240.0,
             leader_heartbeat_timeout=3.0,
             leader_heartbeat_count=10,
+            # adaptive failover (ISSUE 15): the complain timer derives
+            # from the commit inter-arrival EWMA (~10x the measured
+            # cadence, the 3 s constant as ceiling), so the forced-VC
+            # phase's detection lands sub-second; the flip drain is on
+            # by default (flip_drain_windows)
+            heartbeat_rtt_multiplier=10.0,
             view_change_timeout=12.0,
             view_change_resend_interval=3.0,
             verify_launch_timeout=0.15,

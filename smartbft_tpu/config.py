@@ -81,6 +81,60 @@ class Configuration:
     leader_heartbeat_count: int = 10
     num_of_ticks_behind_before_syncing: int = 10
 
+    # Adaptive failover detection (no reference counterpart — the
+    # reference's complain timer is the constant above; round 16 measured
+    # detection arm-to-fire up to 21.8 s under a muted leader while the
+    # VC protocol itself runs in 35-52 ms, making DETECTION ~99% of the
+    # failover cliff).  When heartbeat_rtt_multiplier > 0 the EFFECTIVE
+    # complain timer becomes
+    #   clamp(multiplier * max(rtt_ewma, commit_interval_ewma,
+    #         observed_heartbeat_gap_ewma) * backoff,
+    #         DETECTION_FLOOR, leader_heartbeat_timeout)
+    # where rtt_ewma is the transport's measured per-peer RTT envelope
+    # (SocketComm, PR 14) and commit_interval_ewma is the Controller's
+    # commit inter-arrival EWMA (the Pool._drain_rate idiom) — both
+    # CLUSTER-VISIBLE signals, so the leader's heartbeat emission cadence
+    # (effective timeout / leader_heartbeat_count) shrinks in step with
+    # the followers' complain timers; the observed-gap term (sampled
+    # with the receipt-time clock — tick-quantized samples would feed
+    # the tick cadence back into the derivation and run it up to the
+    # ceiling) additionally guarantees a follower never complains faster
+    # than a multiple of the emission cadence its leader actually
+    # demonstrates.  The derived timer only applies to a leader this
+    # follower has OBSERVED in the current view (first-observation
+    # grace): until the new leader's first sign of life the constant
+    # governs, so warm followers carrying hair-trigger signals from the
+    # previous view cannot spuriously depose a cold-signal leader whose
+    # own derivation paces its first emission at ceiling/count.
+    # The configured constant stays the ceiling AND the
+    # fallback (no measurement yet, in-process Comm with no RTT, cold
+    # cluster).  The monitor's tick cadence is derived from the effective
+    # timeout too, so arm-to-fire can never overshoot the timer by
+    # multiples (the round-16 granularity gap).  ``backoff`` widens the
+    # timer by detection_backoff_base per consecutive complain against
+    # the SAME view (capped at detection_backoff_max, and always at the
+    # ceiling), so a flaky network that keeps killing view changes backs
+    # detection off instead of thrashing leadership; installing a higher
+    # view resets it.  0 (default) keeps the constant — reference-
+    # faithful.
+    heartbeat_rtt_multiplier: float = 0.0
+    detection_backoff_base: float = 2.0
+    detection_backoff_max: float = 8.0
+
+    # Flip-time backlog drain (ISSUE 15 — round 16's critical path put
+    # 98% of forced-VC request time in `propose_wait`: followers' pooled
+    # requests wait out a full request_forward_timeout before reaching
+    # the NEW leader after the flip).  When > 0, a view-flip timer
+    # restart fast-forwards the oldest
+    #   flip_drain_windows * pipeline_depth * request_batch_max_count
+    # pooled requests (their forward timers arm at the floor instead of
+    # the full timeout), so the new view's first proposals batch the
+    # stalled backlog into deep windows immediately; the rest of the
+    # pool keeps the ordinary timeout chain.  Leader-side pool dedup
+    # absorbs the duplicates this may forward.  0 disables (every timer
+    # restarts at the full forward timeout — reference-faithful).
+    flip_drain_windows: int = 4
+
     # State collection (config.go:64-66)
     collect_timeout: float = 1.0
 
@@ -310,6 +364,27 @@ class Configuration:
             raise ConfigError(
                 "request_forward_rtt_multiplier should not be negative "
                 "(0 keeps the constant request_forward_timeout)"
+            )
+        if self.heartbeat_rtt_multiplier < 0:
+            raise ConfigError(
+                "heartbeat_rtt_multiplier should not be negative "
+                "(0 keeps the constant leader_heartbeat_timeout)"
+            )
+        if self.detection_backoff_base < 1.0:
+            raise ConfigError(
+                "detection_backoff_base must be at least 1 (the per-round "
+                "complain-timer widening factor; 1 disables backoff)"
+            )
+        if self.detection_backoff_max < self.detection_backoff_base:
+            raise ConfigError(
+                "detection_backoff_max must be at least "
+                "detection_backoff_base (it caps the cumulative backoff "
+                "multiplier)"
+            )
+        if self.flip_drain_windows < 0:
+            raise ConfigError(
+                "flip_drain_windows should not be negative "
+                "(0 disables the flip-time backlog fast-forward)"
             )
         if self.verify_mesh_devices < 0:
             raise ConfigError(

@@ -132,6 +132,7 @@ class Controller(RequestTimeoutHandler):
         metrics_consensus: Optional[ConsensusMetrics] = None,
         recorder=None,
         vc_phases=None,
+        clock=None,
     ):
         self.id = self_id
         self.n = n
@@ -192,6 +193,15 @@ class Controller(RequestTimeoutHandler):
         self._sync_pending = False  # 1-slot sync token (controller.go:718-730)
         self._sync_lock = asyncio.Lock()  # deliver-vs-sync (controller.go:143,940)
         self._reconfig: Optional[Reconfig] = None
+        # commit inter-arrival EWMA (ISSUE 15, the Pool._drain_rate idiom):
+        # one subtraction + two multiplies per delivery, read by the
+        # heartbeat monitor's adaptive complain-timer derivation.  The
+        # clock is the consensus scheduler's (logical in tests, wall under
+        # WallClockDriver) so the signal lives in the same time domain as
+        # the timers it feeds.
+        self._clock = clock if clock is not None else time.monotonic
+        self._last_commit_t: Optional[float] = None
+        self._commit_gap_ewma = 0.0
 
     # ------------------------------------------------------------------ info
 
@@ -231,6 +241,12 @@ class Controller(RequestTimeoutHandler):
     def i_am_the_leader(self) -> tuple[bool, int]:
         leader = self.leader_id()
         return leader == self.id, leader
+
+    def commit_interval_seconds(self) -> Optional[float]:
+        """The measured commit inter-arrival EWMA (seconds), or None
+        before two deliveries have landed — the cluster-visible liveness
+        cadence the adaptive complain timer derives from."""
+        return self._commit_gap_ewma if self._commit_gap_ewma > 0 else None
 
     # ------------------------------------------------------------------ requests
 
@@ -514,6 +530,19 @@ class Controller(RequestTimeoutHandler):
         self.curr_view_number = new_view_number
         self.curr_decisions_in_view = new_decisions_in_view
         self._start_view(new_proposal_sequence)
+        if new_view_number > latest_view:
+            # a real view FLIP (not a rotation restart): ask the verify
+            # plane to launch its next waves immediately — the mesh idled
+            # through the depose, and the new view's first deep windows
+            # must not also pay the coalescing window/hold before their
+            # quorum waves go out (ISSUE 15; verifiers without the seam
+            # no-op)
+            warm = getattr(self.verifier, "note_view_flip", None)
+            if warm is not None:
+                try:
+                    warm()
+                except Exception as e:  # noqa: BLE001 — warmth is advisory
+                    self.logger.warnf("view-flip verify warm failed: %r", e)
         if self.i_am_the_leader()[0]:
             self.batcher.reset()
 
@@ -692,6 +721,13 @@ class Controller(RequestTimeoutHandler):
         if self._stopped:
             return
         self.curr_decisions_in_view += 1
+        now = self._clock()
+        if self._last_commit_t is not None:
+            gap = now - self._last_commit_t
+            if gap > 0:
+                self._commit_gap_ewma = gap if self._commit_gap_ewma <= 0 \
+                    else 0.7 * self._commit_gap_ewma + 0.3 * gap
+        self._last_commit_t = now
         md = decode(ViewMetadata, d.proposal.metadata)
         vp = self.vc_phases
         if vp is not None and vp.open:

@@ -14,7 +14,7 @@ HeartBeatResponses — f+1 higher-view responses force the leader to sync.
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Callable, Optional
 
 from ..api import Logger
 from ..messages import HeartBeat, HeartBeatResponse, Message
@@ -23,6 +23,17 @@ from .view import ViewSequencesHolder
 
 LEADER = "leader"
 FOLLOWER = "follower"
+
+#: hard lower bound of a DERIVED complain timer (seconds): below this the
+#: leader's emission interval (timeout / count) would race the event loop
+#: itself and loopback jitter would read as leader death
+DETECTION_FLOOR = 0.05
+
+#: the monitor ticks at effective_timeout / THIS so arm-to-fire can
+#: overshoot the timer by at most one tick (a quarter of it) — the fix
+#: for the round-16 granularity gap where a fixed 1 s tick cadence let
+#: detection overshoot a shrunk timer by multiples
+DETECTION_RESOLUTION = 4
 
 
 class HeartbeatMonitor:
@@ -38,6 +49,13 @@ class HeartbeatMonitor:
         num_of_ticks_behind_before_syncing: int,
         pipeline_depth: int = 1,
         vc_phases=None,
+        rtt_multiplier: float = 0.0,
+        backoff_base: float = 2.0,
+        backoff_max: float = 8.0,
+        rtt_fn: Optional[Callable[[], Optional[float]]] = None,
+        commit_interval_fn: Optional[Callable[[], Optional[float]]] = None,
+        metrics=None,
+        now_fn: Optional[Callable[[], float]] = None,
     ):
         self._log = logger
         self._hb_timeout = heartbeat_timeout
@@ -51,6 +69,53 @@ class HeartbeatMonitor:
         #: report their ARM-TO-FIRE interval (last heartbeat seen -> the
         #: complain) — the detection latency that dominates failover
         self._vc_phases = vc_phases
+        # adaptive detection (ISSUE 15): the effective complain timer is
+        # derived from live signals — the transport's per-peer RTT EWMA,
+        # the controller's commit inter-arrival EWMA, and this monitor's
+        # own observed-heartbeat-gap EWMA — clamped to the configured
+        # constant as ceiling/fallback.  Both LEADER emission cadence and
+        # FOLLOWER complain timing use the same derivation, so the
+        # count-x emission margin survives the shrink.
+        # rtt_multiplier <= 0 keeps the constant.
+        self._rtt_multiplier = rtt_multiplier
+        self._rtt_fn = rtt_fn
+        self._commit_interval_fn = commit_interval_fn
+        #: exponential backoff across consecutive complaints against the
+        #: SAME view (a flaky network keeps killing the resulting view
+        #: changes; widening the timer stops the leadership thrash) —
+        #: reset when a HIGHER view installs
+        self._backoff_base = max(backoff_base, 1.0)
+        self._backoff_max = max(backoff_max, 1.0)
+        self._backoff_round = 0
+        self._complained_view = -1
+        #: optional metrics.ViewChangeMetrics — the effective timer and
+        #: its inputs ride cmd=metrics as gauges
+        self._metrics = metrics
+        #: EWMA of the OBSERVED heartbeat inter-arrival (real or
+        #: artificial) — the most direct measurement of how stale a LIVE
+        #: leader can look.  Folding it into the derivation guarantees a
+        #: follower never complains faster than mult x the cadence the
+        #: leader actually demonstrates, which protects a cold-signal
+        #: leader (fresh restart, idle cluster: its emission falls back
+        #: to ceiling/count) from warm followers whose RTT/commit terms
+        #: alone would derive a hair-trigger timer below its emission
+        #: interval.  Samples are taken with ``now_fn`` (the consensus
+        #: scheduler clock) at RECEIPT time — measuring them against the
+        #: tick-quantized ``_last_tick`` would floor every sample at one
+        #: tick interval (eff/4) and feed the derivation its own tick
+        #: cadence, a runaway loop (eff -> mult*eff/4 -> ceiling) that
+        #: re-opened the round-12 detection cliff when first tried.
+        self._hb_gap_ewma = 0.0
+        self._now = now_fn
+        self._last_hb_seen_at: Optional[float] = None
+        #: first-observation grace (the cold-leader guard): the DERIVED
+        #: complain timer only applies once this view's leader has been
+        #: observed at least once (any heartbeat, real or artificial).
+        #: Until then the configured constant governs — warm followers
+        #: carrying hair-trigger signals from the previous view must not
+        #: depose a new leader they have never heard from (whose own
+        #: cold derivation may pace its first emission at ceiling/count).
+        self._leader_observed = False
         # pipelined mode: a healthy follower may trail the leader by up to
         # TWO window depths (base window + launch shadow) while quorums it
         # is not part of complete — lagging inside that span is the
@@ -82,6 +147,11 @@ class HeartbeatMonitor:
             "Changing to %s role, current view: %d, current leader: %d", role, view, leader_id
         )
         self._stop_send_heartbeat_from_leader = False
+        if view > self._complained_view:
+            # a HIGHER view installed: the complaints worked, stop backing
+            # off.  Re-entering the SAME view (a failed VC recycled it)
+            # keeps the widened timer — that is the whole point.
+            self._backoff_round = 0
         self._view = view
         self._leader_id = leader_id
         self._follower = role == FOLLOWER
@@ -89,6 +159,11 @@ class HeartbeatMonitor:
         self._last_heartbeat = self._last_tick
         self._hb_resp_collector = {}
         self._sync_req = False
+        # new view, new leader to observe: re-arm the first-observation
+        # grace, and never fold the dead span of the view change into the
+        # gap EWMA (the next receipt starts a fresh measurement)
+        self._leader_observed = False
+        self._last_hb_seen_at = None
 
     def stop_leader_send_msg(self) -> None:
         """Demote to non-sending without changing view (monitor keeps
@@ -120,6 +195,109 @@ class HeartbeatMonitor:
     def close(self) -> None:
         self._closed = True
 
+    # ------------------------------------------------------------------ timers
+
+    def _signal(self, fn) -> Optional[float]:
+        """One advisory signal read: None on no provider / no measurement
+        / failure — telemetry must never wedge the liveness monitor."""
+        if fn is None:
+            return None
+        try:
+            v = fn()
+        except Exception:  # noqa: BLE001 — derivation is advisory
+            return None
+        return v if v is not None and v > 0 else None
+
+    def _derive(self) -> tuple[float, float, float]:
+        """Derivation only — NO metric side effects.  Returns
+        ``(derived, rtt, commit_gap)`` with unmeasured signals as 0.0.
+        The cadence query calls this on every ticker re-arm; gauge/trace
+        publication rides :meth:`effective_timeout` on the tick path, so
+        at the adaptive floor cadence the per-re-arm cost stays at two
+        EWMA reads."""
+        ceiling = self._hb_timeout
+        mult = self._rtt_multiplier
+        if mult <= 0:
+            return ceiling, 0.0, 0.0
+        rtt = self._signal(self._rtt_fn)
+        commit_gap = self._signal(self._commit_interval_fn)
+        if rtt is None and commit_gap is None:
+            return ceiling, 0.0, 0.0
+        derived = mult * max(rtt or 0.0, commit_gap or 0.0,
+                             self._hb_gap_ewma)
+        backoff = min(
+            self._backoff_base ** self._backoff_round, self._backoff_max
+        )
+        return (
+            min(max(derived * backoff, DETECTION_FLOOR), ceiling),
+            rtt or 0.0,
+            commit_gap or 0.0,
+        )
+
+    def effective_timeout(self) -> float:
+        """The EFFECTIVE complain timer (seconds): the adaptive derivation
+        of ISSUE 15, or the configured constant when the multiplier is off
+        or no signal is measured yet.
+
+        ``max(rtt, commit_interval, observed_heartbeat_gap)`` is the
+        conservative envelope of how stale a LIVE leader can look: real
+        leader traffic arrives at commit cadence (and injects artificial
+        heartbeats), any heartbeat needs one link traversal, and the
+        observed-gap term guarantees we never complain faster than
+        ``mult`` x the emission cadence this leader actually
+        demonstrates — so a cold-signal leader (fresh restart, idle
+        cluster) whose emission fell back toward ceiling/count cannot be
+        spuriously deposed by warm followers.  Backoff multiplies in,
+        then the ceiling clamps: a derived timer can only ever be MORE
+        aggressive than the configured constant.  With the multiplier
+        off (the default) this is one comparison and a return — no
+        signal reads, no gauge writes."""
+        derived, rtt, commit_gap = self._derive()
+        if self._rtt_multiplier <= 0:
+            return derived
+        if self._metrics is not None:
+            m = self._metrics
+            m.detection_timeout_seconds.set(derived)
+            m.detection_rtt_seconds.set(rtt)
+            m.detection_commit_interval_seconds.set(commit_gap)
+            m.detection_backoff_round.set(self._backoff_round)
+        if self._vc_phases is not None:
+            self._vc_phases.note_effective_timer(
+                derived, rtt, commit_gap, self._backoff_round
+            )
+        return derived
+
+    def suggested_tick_interval(self, base_interval: float) -> float:
+        """The monitor's next tick interval: a quarter of the effective
+        timeout, never above the configured base cadence (so an
+        unadapted monitor ticks exactly as before) and never below 10 ms
+        (the wall-clock driver's own resolution).  Consumed by the
+        consensus facade's adaptive ticker — deriving the CHECK cadence
+        from the timer is what makes arm-to-fire <= 1.25x the timer
+        instead of 'timer plus however stale the fixed tick was'.
+        Publication-free: only the tick path writes the timer gauges.
+
+        A LEADER divides by ``heartbeat_count`` too when that is finer:
+        emission only happens on ticks, so a coarser cadence would floor
+        the emitted inter-arrival at the tick interval — and since
+        followers fold the OBSERVED gap into their derivation, an
+        emission floor of eff/4 feeds back as mult*eff/4 and runs the
+        cluster's timers up to the ceiling (measured: re-opened the
+        detection cliff).  Ticking at eff/count keeps the demonstrated
+        cadence equal to the derived one.
+
+        With the multiplier off the STATIC cadence is returned untouched:
+        the ceiling/4 (or ceiling/count) could still undercut a coarse
+        configured tick, and '0 keeps the constant' promises reference-
+        faithful emission traffic, not just a reference-faithful timer."""
+        if self._rtt_multiplier <= 0:
+            return base_interval
+        eff, _, _ = self._derive()
+        div = DETECTION_RESOLUTION
+        if not (self._follower or self._stop_send_heartbeat_from_leader):
+            div = max(div, self._hb_count)
+        return min(base_interval, max(eff / div, 0.01))
+
     # ------------------------------------------------------------------ ticks
 
     def tick(self, now: float) -> None:
@@ -135,8 +313,10 @@ class HeartbeatMonitor:
             self._leader_tick(now)
 
     def _leader_tick(self, now: float) -> None:
-        """Emit a heartbeat every hb_timeout/hb_count (go:352-376)."""
-        if (now - self._last_heartbeat) * self._hb_count < self._hb_timeout:
+        """Emit a heartbeat every effective_timeout/hb_count (go:352-376;
+        the adaptive derivation shrinks emission in step with the
+        followers' complain timers — see effective_timeout)."""
+        if (now - self._last_heartbeat) * self._hb_count < self.effective_timeout():
             return
         vs = self._view_sequences.load()
         if vs is None or not vs.view_active:
@@ -151,15 +331,30 @@ class HeartbeatMonitor:
             self._last_heartbeat = now
             return
         delta = now - self._last_heartbeat
-        if delta >= self._hb_timeout:
+        # first-observation grace: until THIS view's leader has shown one
+        # sign of life, the constant governs — the derived timer carries
+        # signals from the previous view and must not judge a leader it
+        # has never measured (a dead new leader costs one constant round,
+        # exactly the pre-adaptive behavior)
+        effective = (self.effective_timeout() if self._leader_observed
+                     else self._hb_timeout)
+        if delta >= effective:
             self._log.warnf(
                 "Heartbeat timeout (%s) from %d expired; last heartbeat was observed %s ago",
-                self._hb_timeout, self._leader_id, delta,
+                effective, self._leader_id, delta,
             )
             if self._vc_phases is not None:
                 # delta IS the complain-timer arm-to-fire time: the timer
                 # armed at the last observed heartbeat and fired now
                 self._vc_phases.detection(delta)
+            # consecutive complaints against the same view widen the next
+            # derived timer (anti-thrash backoff); a fresh view's first
+            # complaint starts the ladder at round 0
+            if self._view <= self._complained_view:
+                self._backoff_round += 1
+            else:
+                self._backoff_round = 0
+            self._complained_view = self._view
             self._handler.on_heartbeat_timeout(self._view, self._leader_id)
             self._timed_out = True
             return
@@ -214,6 +409,20 @@ class HeartbeatMonitor:
         else:
             self._follower_behind = False
 
+        # fold the observed inter-arrival into the gap EWMA (a sign-of-
+        # life cadence sample — artificial heartbeats count, they ARE
+        # leader liveness).  Receipt-time clock, NOT _last_tick: tick
+        # quantization would floor every sample at the tick interval and
+        # feed the derivation back into itself (see __init__).  Capped at
+        # the ceiling so one stale span cannot poison the derivation.
+        self._leader_observed = True
+        t = self._now() if self._now is not None else self._last_tick
+        if self._last_hb_seen_at is not None:
+            gap = min(t - self._last_hb_seen_at, self._hb_timeout)
+            if gap > 0:
+                self._hb_gap_ewma = gap if self._hb_gap_ewma <= 0 \
+                    else 0.7 * self._hb_gap_ewma + 0.3 * gap
+        self._last_hb_seen_at = t
         self._last_heartbeat = self._last_tick
 
     def _handle_heartbeat_response(self, sender: int, hbr: HeartBeatResponse) -> None:

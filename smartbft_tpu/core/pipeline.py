@@ -360,6 +360,19 @@ class WindowedView:
         """view.go:1000-1010 semantics; see View.abort for the cancellation
         contract."""
         self._stop()
+        # depose-time plane warmth (ISSUE 15): waves this window already
+        # handed to the coalescer flush + launch NOW instead of idling in
+        # the coalescing window/hold while the view change runs — the
+        # mesh keeps verifying through the depose and the flip lands on a
+        # warm plane.  Cancelling our awaiting tasks below does not
+        # cancel the launches themselves; verifiers without the seam
+        # no-op.
+        depose = getattr(self.verifier, "note_view_depose", None)
+        if depose is not None:
+            try:
+                depose()
+            except Exception as e:  # noqa: BLE001 — warmth is advisory
+                self.logger.warnf("depose verify warm failed: %r", e)
         for t in list(self._verify_tasks):
             t.cancel()
         if self._task is not None:

@@ -114,6 +114,15 @@ class PoolOptions:
     #: constant; on a measured-µs-RTT link the timer collapses to the
     #: floor instead.
     forward_timeout_fn: Optional[Callable[[], Optional[float]]] = None
+    #: flip-time backlog drain (ISSUE 15): how many of the OLDEST pooled
+    #: requests a view-flip timer restart fast-forwards (their forward
+    #: timers arm at FORWARD_TIMEOUT_FLOOR so followers push the stalled
+    #: backlog to the new leader within a tick instead of waiting out a
+    #: full forward timeout each).  Derived by the consensus facade as
+    #: flip_drain_windows * pipeline_depth * request_batch_max_count —
+    #: enough to fill the new view's deep windows immediately.  0
+    #: disables (every restart uses the ordinary timeout).
+    flip_drain_limit: int = 0
 
 
 #: hard lower bound of a derived forward timeout: forwarding is benign
@@ -209,6 +218,8 @@ class Pool:
         # the AdmissionRejected retry-after hint (see _note_drained)
         self.shed_admission = 0
         self.shed_timeout = 0
+        #: requests fast-forwarded by flip-time timer restarts (ISSUE 15)
+        self.flip_drains = 0
         self._drain_anchor = scheduler.now()
         self._drain_accum = 0
         self._drain_rate = 0.0  # requests/sec, EWMA over DRAIN_WINDOW spans
@@ -414,6 +425,7 @@ class Pool:
             "high_water": hw if hw is not None else self._opts.queue_size,
             "shed_admission": self.shed_admission,
             "shed_timeout": self.shed_timeout,
+            "flip_drains": self.flip_drains,
             "drain_rate": round(self._drain_rate, 3),
         }
 
@@ -687,20 +699,63 @@ class Pool:
                 item.timer = None
         self._log.debugf("Stopped all timers: size=%d", len(self._items))
 
-    def restart_timers(self) -> None:
+    def restart_timers(self, *, flip: bool = False) -> None:
         """Restart all request timers as forward timeouts
-        (requestpool.go:472-490)."""
+        (requestpool.go:472-490).
+
+        ``flip=True`` (a completed view change restarting the timers):
+        the oldest ``flip_drain_limit`` requests arm at
+        FORWARD_TIMEOUT_FLOOR instead — the stalled backlog reaches the
+        NEW leader within a tick and its first proposals batch it into
+        deep windows, instead of every pooled request waiting out a full
+        forward timeout while the new view idles (round 16: propose_wait
+        was 98% of forced-VC request time).  Leader-side dedup absorbs
+        any duplicate this forwards; requests past the limit keep the
+        ordinary chain."""
         self._stopped = False
         fwd = self._forward_timeout()
-        for info, item in self._items.items():
+        fast = self._opts.flip_drain_limit if flip else 0
+        for k, (info, item) in enumerate(self._items.items()):
             if item.timer is not None:
                 item.timer.cancel()
             req = item.request
-            item.timer = self._scheduler.schedule(
-                fwd,
-                (lambda r, i: lambda: self._on_request_to(r, i))(req, info),
-            )
+            if k < fast:
+                item.timer = self._scheduler.schedule(
+                    FORWARD_TIMEOUT_FLOOR,
+                    (lambda r, i: lambda: self._on_flip_forward(r, i))(req, info),
+                )
+            else:
+                item.timer = self._scheduler.schedule(
+                    fwd,
+                    (lambda r, i: lambda: self._on_request_to(r, i))(req, info),
+                )
+        if fast and self._items:
+            self.flip_drains += min(fast, len(self._items))
         self._log.debugf("Restarted all timers: size=%d", len(self._items))
+
+    def _on_flip_forward(self, request: bytes, info: RequestInfo) -> None:
+        """The flip-time BONUS forward: push a stalled request to the new
+        leader immediately, then re-arm the ORDINARY forward→complain
+        chain behind it on its original schedule.  The early forward is
+        purely additive — if it lands, leader-side dedup absorbs the
+        ordinary forward that follows; if it is lost on the wire or
+        refused by a peer that has not flipped to the new view yet (a
+        real race: this restart runs the moment THIS node completes the
+        view change, which can be ahead of its peers), the unchanged
+        chain retries it instead of stranding it until the complain
+        stage.  An accelerated chain was the first design and livelocked
+        the lossy-network gate both ways: early complains re-triggered
+        view changes, and a dropped one-shot forward stalled the drain."""
+        item = self._items.get(info)
+        if item is None or self._closed or self._stopped:
+            return
+        remaining = max(
+            self._forward_timeout() - FORWARD_TIMEOUT_FLOOR, 0.0
+        )
+        item.timer = self._scheduler.schedule(
+            remaining, lambda: self._on_request_to(request, info)
+        )
+        self._th.on_request_timeout(request, info)
 
     def _forward_timeout(self) -> float:
         """The effective forward timeout for the next timer arm: the

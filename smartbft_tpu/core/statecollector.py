@@ -8,13 +8,17 @@ Controller broadcasts a StateTransferRequest and awaits >f identical
 from __future__ import annotations
 
 import asyncio
-from typing import Optional
+from typing import Callable, Optional
 
 from ..api import Logger
 from ..messages import Message, StateTransferResponse
 from ..types import ViewAndSeq
 from ..utils.clock import Scheduler
 from .util import VoteSet, compute_quorum
+
+#: hard lower bound of a DERIVED collect timeout (seconds): a state
+#: sweep needs at least one full round trip plus peer dispatch
+COLLECT_TIMEOUT_FLOOR = 0.05
 
 
 class StateCollector:
@@ -25,11 +29,20 @@ class StateCollector:
         logger: Logger,
         collect_timeout: float,
         scheduler: Scheduler,
+        collect_timeout_fn: Optional[Callable[[], Optional[float]]] = None,
     ):
+        """``collect_timeout_fn`` (ISSUE 15): optional live provider of a
+        DERIVED collect timeout (the consensus facade wires an RTT-based
+        one when adaptive detection is armed), clamped into
+        [COLLECT_TIMEOUT_FLOOR, configured constant].  The state-fetch
+        leg of a failover then gives up on missing peers at network
+        scale instead of always burning the constant — the same
+        ceiling/fallback contract as every other derived timer."""
         self.self_id = self_id
         self.n = n
         self._log = logger
         self._collect_timeout = collect_timeout
+        self._collect_timeout_fn = collect_timeout_fn
         self._scheduler = scheduler
         self._quorum, self._f = compute_quorum(n)
         self._responses = VoteSet(
@@ -60,11 +73,26 @@ class StateCollector:
     def clear_collected(self) -> None:
         self._pending.clear()
 
+    def effective_timeout(self) -> float:
+        """The next collect arm's timeout: derived when a provider is
+        wired and measuring, the configured constant otherwise."""
+        fn = self._collect_timeout_fn
+        ceiling = self._collect_timeout
+        if fn is None:
+            return ceiling
+        try:
+            derived = fn()
+        except Exception:  # noqa: BLE001 — derivation is advisory
+            return ceiling
+        if derived is None or derived <= 0:
+            return ceiling
+        return min(max(derived, COLLECT_TIMEOUT_FLOOR), ceiling)
+
     async def collect_state_responses(self) -> Optional[ViewAndSeq]:
         """Await >f identical {view,seq} votes or timeout
         (statecollector.go:77-129)."""
         self._responses.clear()
-        timer = self._scheduler.schedule(self._collect_timeout, self._on_timeout)
+        timer = self._scheduler.schedule(self.effective_timeout(), self._on_timeout)
         self._log.debugf("Node %d started collecting state responses", self.self_id)
         try:
             while True:

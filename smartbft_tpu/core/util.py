@@ -257,6 +257,10 @@ class InFlightData:
         self._proposal = None
         self._prepared = False
         self._window: dict[int, list] = {}  # seq -> [proposal, prepared]
+        #: bumped on every mutation — cheap change detection for derived
+        #: caches (the ViewChanger's hot-standby ViewData keys on it
+        #: together with Checkpoint.version, ISSUE 15)
+        self.version = 0
 
     def in_flight_proposal(self):
         if self._window:
@@ -271,6 +275,7 @@ class InFlightData:
     def store_proposal(self, proposal) -> None:
         self._proposal = proposal
         self._prepared = False
+        self.version += 1
 
     def store_prepares(self, view: int, seq: int) -> None:
         if self._proposal is None:
@@ -281,16 +286,19 @@ class InFlightData:
                 return
             raise RuntimeError("stored prepares but proposal is not initialized")
         self._prepared = True
+        self.version += 1
 
     def clear(self) -> None:
         self._proposal = None
         self._prepared = False
         self._window.clear()
+        self.version += 1
 
     # -- windowed API (pipeline_depth > 1) ---------------------------------
 
     def store_proposal_at(self, seq: int, proposal) -> None:
         self._window[seq] = [proposal, False]
+        self.version += 1
 
     def store_prepares_at(self, seq: int) -> None:
         slot = self._window.get(seq)
@@ -299,6 +307,7 @@ class InFlightData:
                 f"stored prepares at seq {seq} but its proposal is not initialized"
             )
         slot[1] = True
+        self.version += 1
 
     def clear_below(self, seq: int) -> None:
         """Drop window rungs for delivered sequences (< ``seq``).
@@ -307,8 +316,11 @@ class InFlightData:
         (PersistedState writes it on every windowed save) is cleared too —
         otherwise in_flight_proposal() would fall back to a long-delivered
         proposal and poison this node's next ViewData."""
-        for s in [s for s in self._window if s < seq]:
+        stale = [s for s in self._window if s < seq]
+        for s in stale:
             del self._window[s]
+        if stale:
+            self.version += 1
         if not self._window and self._proposal is not None \
                 and getattr(self._proposal, "metadata", b""):
             from ..codec import decode
@@ -318,6 +330,7 @@ class InFlightData:
             if md.latest_sequence < seq:
                 self._proposal = None
                 self._prepared = False
+                self.version += 1
 
     def prune_synced(self, synced_seq: int) -> None:
         """A sync advanced the checkpoint to ``synced_seq``: drop what it

@@ -391,6 +391,20 @@ class ViewChanger:
         self._committed_during_view_change: Optional[ViewMetadata] = None
         self._pending_changes = 0
 
+        # hot-standby ViewData (ISSUE 15): when THIS node is the
+        # deterministic next leader, the tick loop pre-builds (and signs)
+        # its ViewData from the live checkpoint/ladder state, keyed on
+        # (next_view, checkpoint.version, in_flight.version) so any
+        # protocol progress invalidates the cache.  On complaint quorum
+        # _prepare_view_data_msg then returns the cached message instead
+        # of reconstructing + re-signing state under the depose — the new
+        # leader registers its own vote immediately and starts collecting
+        # the quorum one round trip sooner.
+        self._standby_msg: Optional[SignedViewData] = None
+        self._standby_key: Optional[tuple] = None
+        self.standby_prebuilds = 0
+        self.standby_hits = 0
+
         self._in_flight_view: Optional[View] = None
         self._in_flight_decide: Optional[asyncio.Future] = None
         self._in_flight_sync: Optional[asyncio.Future] = None
@@ -573,6 +587,7 @@ class ViewChanger:
                         self.vc_phases.note_tick()  # live in-VC gauge
                     self._check_if_resend_view_change(evt[1])
                     self._check_if_timeout(evt[1])
+                    self._maybe_prebuild_standby()
                 elif kind == "inform":
                     self._inform_new_view(evt[1])
                 elif kind == "restore":
@@ -592,6 +607,47 @@ class ViewChanger:
     def _blacklist(self) -> list[int]:
         prop, _ = self.checkpoint.get()
         return blacklist_of(prop)
+
+    # -- hot-standby ViewData (ISSUE 15) -----------------------------------
+
+    def _standby_state_key(self, next_view: int) -> tuple:
+        """Everything a ViewData is built from, as cheap version counters:
+        the checkpoint (last decision + signatures) and the in-flight
+        ladder.  Any commit, prepare, sync prune, or window move bumps
+        one of them and invalidates the cache."""
+        return (
+            next_view,
+            self.checkpoint.version,
+            getattr(self.in_flight, "version", -1),
+        )
+
+    def _maybe_prebuild_standby(self) -> None:
+        """Tick hook (off the commit hot path): when this node would lead
+        view curr_view+1, keep a signed ViewData for it pre-built from
+        the LIVE state.  Non-next-leaders drop the cache — it would never
+        be consulted with a matching key."""
+        if self._stopped or self.comm is None or self.signer is None:
+            return
+        try:
+            next_leader = get_leader_id(
+                self.curr_view + 1, self.n, self.nodes_list,
+                self.leader_rotation, 0, self.decisions_per_leader,
+                self._blacklist(),
+            )
+        except Exception:  # noqa: BLE001 — e.g. everyone blacklisted
+            return
+        if next_leader != self.self_id:
+            self._standby_msg = None
+            self._standby_key = None
+            return
+        key = self._standby_state_key(self.curr_view + 1)
+        if self._standby_msg is not None and key == self._standby_key:
+            return
+        self._standby_msg = self._build_view_data_msg(self.curr_view + 1)
+        self._standby_key = key
+        self.standby_prebuilds += 1
+        if self.vc_phases is not None:
+            self.vc_phases.note_standby(prebuilt=True)
 
     def _check_if_resend_view_change(self, now: float) -> None:
         """viewchanger.go:232-252."""
@@ -699,7 +755,9 @@ class ViewChanger:
         self.view_data_msgs.clear()
         self._check_timeout = False
         self._back_off_factor = 1
-        self.requests_timer.restart_timers()
+        # a sync installed a new view around the VC pipeline — still a
+        # flip: fast-forward the stalled backlog to the new leader
+        self.requests_timer.restart_timers(flip=True)
 
     def _start_view_change(self, view: int, stop_view: bool) -> None:
         """viewchanger.go:364-391."""
@@ -768,8 +826,21 @@ class ViewChanger:
         )
 
     def _prepare_view_data_msg(self) -> SignedViewData:
-        """viewchanger.go:433-456; the pipelined window adds the in-flight
-        LADDER (every undelivered consecutive rung above the checkpoint)."""
+        """viewchanger.go:433-456, fronted by the hot-standby cache: a
+        pre-built message whose state key still matches the live
+        checkpoint/ladder is returned as-is (the one-round-trip failover
+        path); anything else is built fresh."""
+        key = self._standby_state_key(self.curr_view)
+        if self._standby_msg is not None and key == self._standby_key:
+            self.standby_hits += 1
+            if self.vc_phases is not None:
+                self.vc_phases.note_standby(hit=True)
+            return self._standby_msg
+        return self._build_view_data_msg(self.curr_view)
+
+    def _build_view_data_msg(self, next_view: int) -> SignedViewData:
+        """The pipelined window adds the in-flight LADDER (every
+        undelivered consecutive rung above the checkpoint)."""
         last_decision, last_decision_signatures = self.checkpoint.get()
         in_flight = self._get_in_flight(last_decision)
         prepared = self.in_flight.is_in_flight_prepared()
@@ -799,7 +870,7 @@ class ViewChanger:
             else:
                 in_flight, prepared = None, False
         vd = ViewData(
-            next_view=self.curr_view,
+            next_view=next_view,
             last_decision=last_decision,
             last_decision_signatures=list(last_decision_signatures),
             in_flight_proposal=in_flight,
@@ -1164,7 +1235,11 @@ class ViewChanger:
             self.vc_phases.newview_done(self.curr_view)
         self.nvs.clear()
         self.controller.view_changed(self.curr_view, my_sequence + 1)
-        self.requests_timer.restart_timers()
+        # the FLIP: the new view is installed and the pool still holds the
+        # backlog that stalled through the depose — fast-forward its
+        # forward timers so it reaches the new leader's first deep windows
+        # instead of waiting out a full request timeout per window
+        self.requests_timer.restart_timers(flip=True)
         self._check_timeout = False
         self._back_off_factor = 1
 
@@ -1232,6 +1307,15 @@ class ViewChanger:
         )
         view.phase = PREPARED
         view.in_flight_proposal = proposal
+        # The normal path populates in_flight_requests at proposal verify
+        # time (view._process_pre_prepare); this special view skips that
+        # phase, so without this the decide() hand-off prunes NOTHING from
+        # the request pool on ANY node — the deposed leader keeps the
+        # committed batch pooled and forwards it to the new leader (within
+        # one flip-drain tick since ISSUE 15), which re-proposes it at a
+        # fresh sequence: measured duplicate delivery under spurious-depose
+        # churn at deep overload (mux ShardStreamViolation at 1600/s).
+        view.in_flight_requests = self.verifier.requests_from_proposal(proposal)
         view.my_proposal_sig = self.signer.sign_proposal(proposal, b"")
         view.last_broadcast_sent = Commit(
             view=view.number,

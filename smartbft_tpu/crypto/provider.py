@@ -782,6 +782,16 @@ class AsyncBatchCoalescer:
         #: a known-well-formed item from the last wave, re-verified by the
         #: breaker probe as the device-health canary
         self._canary: Optional[tuple] = None
+        #: flip-warm mode (ISSUE 15): until this wall-clock instant the
+        #: plane flushes EAGERLY — no coalescing window, no occupancy
+        #: hold.  Armed by note_view_flip when a view change installs a
+        #: new view: the mesh idled through the depose, and the flip's
+        #: first deep-window waves must launch at once so the stalled
+        #: backlog lands on a warm plane instead of re-paying the
+        #: batching latency it was tuned for in steady state.
+        self._warm_until = 0.0
+        self.flip_warms = 0
+        self.flip_warm_bypasses = 0
 
     # -- late wiring ---------------------------------------------------------
 
@@ -827,6 +837,52 @@ class AsyncBatchCoalescer:
             self.hold = max(0.0, float(hold))
             self._hold_explicit = self._hold_explicit or explicit
 
+    #: how long flip-warm mode lasts (wall seconds): long enough for the
+    #: new view's first deep windows to stage and launch their quorum
+    #: waves, short enough that steady-state coalescing resumes within
+    #: the same failover transient
+    FLIP_WARM_SPAN = 0.25
+
+    def note_view_flip(self, span: Optional[float] = None) -> None:
+        """A view change just installed a new view (ISSUE 15): flush any
+        pending wave immediately and run windowless/holdless for
+        ``span`` seconds.  Safe from any caller on the event loop; a
+        caller without a running loop (unit code) just arms the mode."""
+        self._warm_until = time.monotonic() + (
+            span if span is not None else self.FLIP_WARM_SPAN
+        )
+        self.flip_warms += 1
+        rec = self.recorder
+        if rec.enabled:
+            rec.record("verify.flip_warm", extra={"pending": len(self._pending)})
+        if self._pending and not self._launch_inflight:
+            # flush NOW even when a windowed flush is already parked in
+            # its sleep: the immediate task swaps the batch out and the
+            # stale sleeper later wakes to an empty (or fresher) batch —
+            # exactly the race _flush_after is already written to absorb.
+            # Probe for the loop BEFORE building the coroutine: a no-loop
+            # caller just arms the mode (the next submit flushes eagerly),
+            # and an abandoned coroutine would warn "never awaited".
+            try:
+                asyncio.get_running_loop()
+            except RuntimeError:
+                return
+            create_logged_task(
+                self._flush_after(0.0), name="coalescer-flush-flip"
+            )
+            self._flush_scheduled = True
+
+    def note_view_depose(self, span: Optional[float] = None) -> None:
+        """The current view is being torn down for a view change (ISSUE
+        15): same eager-flush transient as the flip — in-window waves
+        already handed to the plane launch NOW instead of idling in the
+        coalescing window/hold while the VC sub-protocol runs, so the
+        plane stays busy through the depose and the flip lands warm."""
+        self.note_view_flip(span)
+
+    def _flip_warm(self) -> bool:
+        return time.monotonic() < self._warm_until
+
     @property
     def breaker_open(self) -> bool:
         return self._breaker_is_open
@@ -848,6 +904,10 @@ class AsyncBatchCoalescer:
             "probe_attempts": s.probe_attempts,
             "probe_successes": s.probe_successes,
             "abandoned_late_arrivals": s.abandoned_late_arrivals,
+            # ISSUE 15: view-flip warm transients (eager windowless
+            # flushing) and the occupancy holds they bypassed
+            "flip_warms": self.flip_warms,
+            "flip_warm_bypasses": self.flip_warm_bypasses,
         }
 
     def shard_snapshot(self) -> dict:
@@ -921,8 +981,12 @@ class AsyncBatchCoalescer:
                 self._flush_scheduled = True
             elif not self._flush_scheduled:
                 self._flush_scheduled = True
+                # flip-warm mode: the failover transient flushes eagerly
+                # (no coalescing window) so the new view's first waves
+                # launch at once
+                delay = 0.0 if self._flip_warm() else self.window
                 create_logged_task(
-                    self._flush_after(self.window), name="coalescer-flush"
+                    self._flush_after(delay), name="coalescer-flush"
                 )
         return await fut
 
@@ -941,6 +1005,10 @@ class AsyncBatchCoalescer:
         rung-exact wave)."""
         budget = self.hold
         if budget <= 0.0:
+            return
+        if self._flip_warm():
+            # the failover transient must not trade latency for depth
+            self.flip_warm_bypasses += 1
             return
         start = time.monotonic()
         start_depth: Optional[int] = None
@@ -1469,6 +1537,18 @@ class CryptoProvider:
         shared) coalescer.  Same precedence as the fault policy — an
         explicitly constructed hold wins over config-wired values."""
         self._coalescer.configure_hold(hold, explicit=explicit)
+
+    def note_view_flip(self) -> None:
+        """Controller seam (ISSUE 15): a view change installed a new
+        view — run the (possibly shared) coalescer flip-warm so the new
+        view's first quorum waves launch without coalescing latency."""
+        self._coalescer.note_view_flip()
+
+    def note_view_depose(self) -> None:
+        """View seam (ISSUE 15): the view is aborting for a view change —
+        flush its in-flight waves eagerly (see the coalescer's
+        note_view_depose)."""
+        self._coalescer.note_view_depose()
 
     def _quorum_threshold(self) -> int:
         """ceil((n+f+1)/2) over this keyring's membership — the quorum

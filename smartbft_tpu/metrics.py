@@ -682,6 +682,21 @@ class ViewChangeMetrics:
         #: new view must drain before request p99 recovers)
         self.backlog_at_view_flip = _g(
             p, "viewchange", "backlog_at_view_flip")
+        #: the EFFECTIVE (derived) complain timer and its inputs
+        #: (ISSUE 15): detection_timeout_seconds is what the monitor will
+        #: actually wait before complaining — the RTT/commit-EWMA-derived
+        #: value after backoff and ceiling clamp; the *_input gauges are
+        #: its live signal terms (0 when the signal is unmeasured) and
+        #: detection_backoff_round the consecutive-complaint widening
+        #: round against the current view
+        self.detection_timeout_seconds = _g(
+            p, "viewchange", "detection_timeout_seconds")
+        self.detection_rtt_seconds = _g(
+            p, "viewchange", "detection_rtt_input_seconds")
+        self.detection_commit_interval_seconds = _g(
+            p, "viewchange", "detection_commit_interval_input_seconds")
+        self.detection_backoff_round = _g(
+            p, "viewchange", "detection_backoff_round")
 
 
 class TPUCryptoMetrics:

@@ -170,6 +170,21 @@ ROW_SCHEMAS: dict = {
                                            "top": _DICT}}},
         "optional": {},
     },
+    # bench.py viewchange_guard_rows (ISSUE 15) — the forced-VC phase's
+    # request p99 in the round-12 degraded harness, the longitudinal
+    # failover-regression pin
+    "viewchange_phase_p99_ms": {
+        "required": {"metric": _STR, "value": _NUM, "unit": _STR},
+        "optional": {"offered_per_sec": _NUM, "shards": _NUM,
+                     "healthy_p99_ms": _NUM, "vs_healthy": _NUM},
+    },
+    # bench.py viewchange_guard_rows (ISSUE 15) — complain-timer
+    # arm-to-fire p99 under the degraded run's muted leader
+    "viewchange_detection_p99_ms": {
+        "required": {"metric": _STR, "value": _NUM, "unit": _STR},
+        "optional": {"count": _NUM, "offered_per_sec": _NUM,
+                     "shards": _NUM, "timer": _DICT},
+    },
     # obs.baseline.tiny_logical_row — the tier-1 regression-gate row
     # (value = mean logical commit latency; percentiles ride in "latency")
     "tiny_logical_commit_ms": {

@@ -84,6 +84,16 @@ class ViewChangePhaseTracker:
         #: now a first-class column of the viewchange bench block
         self._detections: deque = deque(maxlen=max(int(keep), 1))
         self.detections_total = 0
+        #: the latest EFFECTIVE complain-timer derivation (ISSUE 15):
+        #: {timeout_s, rtt_s, commit_interval_s, backoff_round} — one
+        #: dict, overwritten in place by the heartbeat monitor so the
+        #: bench block publishes what the timer actually was
+        self.effective_timer: Optional[dict] = None
+        #: hot-standby ViewData accounting (ISSUE 15): prebuilds the
+        #: next-leader tick produced, and cache hits at ViewData-send
+        #: time (a hit = the one-round-trip failover path was taken)
+        self.standby_prebuilds = 0
+        self.standby_hits = 0
 
     # -- marks (ViewChanger) ----------------------------------------------
 
@@ -120,6 +130,25 @@ class ViewChangePhaseTracker:
         rec = self.recorder
         if rec.enabled:
             rec.record("vc.detected", node=self.node, dur=max(seconds, 0.0))
+
+    def note_effective_timer(self, timeout_s: float, rtt_s: float,
+                             commit_interval_s: float,
+                             backoff_round: int) -> None:
+        """The heartbeat monitor's current effective complain timer and
+        its inputs (ISSUE 15 satellite) — overwritten in place, O(1)."""
+        self.effective_timer = {
+            "timeout_s": round(timeout_s, 6),
+            "rtt_s": round(rtt_s, 6),
+            "commit_interval_s": round(commit_interval_s, 6),
+            "backoff_round": backoff_round,
+        }
+
+    def note_standby(self, prebuilt: bool = False, hit: bool = False) -> None:
+        """Hot-standby ViewData accounting (ISSUE 15)."""
+        if prebuilt:
+            self.standby_prebuilds += 1
+        if hit:
+            self.standby_hits += 1
 
     def _mark(self, name: str, kind: str, view: int) -> None:
         if not self.open or view < self._view or name in self._marks:
@@ -243,6 +272,25 @@ class ViewChangePhaseTracker:
         }
 
 
+def _timer_block(trackers: Sequence["ViewChangePhaseTracker"]) -> dict:
+    """Fold the per-node effective-timer derivations into one summary."""
+    samples = [t.effective_timer for t in trackers
+               if getattr(t, "effective_timer", None)]
+    if not samples:
+        return {"derived": False}
+    timeouts = [s["timeout_s"] for s in samples]
+    return {
+        "derived": True,
+        "nodes": len(samples),
+        "timeout_s_min": min(timeouts),
+        "timeout_s_max": max(timeouts),
+        "rtt_s_max": max(s["rtt_s"] for s in samples),
+        "commit_interval_s_max": max(s["commit_interval_s"]
+                                     for s in samples),
+        "backoff_round_max": max(s["backoff_round"] for s in samples),
+    }
+
+
 def assemble_viewchange_block(trackers: Sequence["ViewChangePhaseTracker"]
                               ) -> dict:
     """Fold N per-node trackers into the ONE ``viewchange`` block a bench
@@ -309,6 +357,17 @@ def assemble_viewchange_block(trackers: Sequence["ViewChangePhaseTracker"]
             "count": len(backlogs),
             "p50": _pct(backlogs, 0.50),
             "max": backlogs[-1] if backlogs else 0,
+        },
+        # ISSUE 15: the effective (derived) complain timer across the
+        # pooled trackers — min/max of the last per-node derivations plus
+        # the worst backoff round — and the hot-standby ViewData cache
+        # accounting (hits = view changes that took the one-round-trip
+        # prebuilt path)
+        "timer": _timer_block(trackers),
+        "standby": {
+            "prebuilds": sum(getattr(t, "standby_prebuilds", 0)
+                             for t in trackers),
+            "hits": sum(getattr(t, "standby_hits", 0) for t in trackers),
         },
         "end_to_end": {
             "count": len(totals),

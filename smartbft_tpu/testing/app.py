@@ -398,6 +398,7 @@ class App(Application, Assembler, Comm, Signer, Verifier, RequestInspector,
         mine = self.shared.get(self.id)
         for decision in best[len(mine):]:
             self.deliver(decision.proposal, list(decision.signatures))
+            self._drop_synced_from_pool(decision.proposal)
         mine = self.shared.get(self.id)
         latest = mine[-1] if mine else Decision(proposal=Proposal())
         # a reconfig in the latest synced decision must surface so the facade
@@ -406,6 +407,27 @@ class App(Application, Assembler, Comm, Signer, Verifier, RequestInspector,
             self._reconfig_in(latest.proposal) if mine else Reconfig(in_latest_decision=False)
         )
         return SyncResponse(latest=latest, reconfig=reconfig)
+
+    def _drop_synced_from_pool(self, proposal: Proposal) -> None:
+        """The socket replicas' wire-sync rule (PR 6), applied to the
+        in-process path: a decision this node learned by SYNC (not by its
+        own consensus deliver) must still leave the request pool.  A
+        pooled copy that survives the sync is re-proposed the moment this
+        node becomes leader — measured as duplicate delivery (mux
+        ShardStreamViolation) under adaptive-timer view-change churn at
+        deep overload, where a deposed-and-synced node retakes leadership
+        within milliseconds."""
+        consensus = getattr(self, "consensus", None)
+        pool = getattr(consensus, "pool", None)
+        if pool is None:
+            return
+        from ..core.pool import remove_delivered_requests
+
+        try:
+            infos = self.requests_from_proposal(proposal)
+        except Exception:  # noqa: BLE001 — foreign payload: nothing pooled
+            return
+        remove_delivered_requests(pool, infos, self.logger)
 
     # ------------------------------------------------------------------ lifecycle
 

@@ -1119,7 +1119,62 @@ async def soak(
                 # breaker-trip and forced-VC shapes both ride this)
                 assert_health_verdicts(report.verdicts, report.fault_span,
                                        None)
-                recovery_s = await cluster.wait_healthy(timeout=30.0)
+                # the engine-faults soak deliberately configures heartbeat
+                # escalation OUT of the picture (its config comment above)
+                # — the detection judgment applies to protocol-fault rounds
+                muted_leader = not engine_faults and any(
+                    e.action == "mute" for e in schedule
+                )
+                if muted_leader:
+                    # ISSUE 15 satellite: a mute-leader round must be
+                    # JUDGED as a detection failure — some verdict
+                    # transition (cluster log or per-node monitor) names
+                    # the viewchange.detection_seconds SLO while
+                    # non-healthy.  A soak where the leader dies and the
+                    # detection objective never trips means the
+                    # instrument, not the cluster, is broken.
+                    named = [
+                        names
+                        for _, status, names in report.verdicts
+                        if status != "healthy"
+                    ] + [
+                        names
+                        for mon in cluster.health_monitors.values()
+                        for _, status, names in mon.transitions
+                        if status != "healthy"
+                    ]
+                    assert any(
+                        "viewchange.detection_seconds" in names
+                        for names in named
+                    ), (
+                        f"mute round never breached "
+                        f"viewchange.detection_seconds: {named}"
+                    )
+                    # ...and recovery is BOUNDED by the detection SLO
+                    # machinery, not just "eventually": the detection
+                    # sample is latched after it fired, ages out of the
+                    # fast burn window, and the bound itself passes —
+                    # past latch + fast-window + bound (+2 s of tick
+                    # slack) a still-degraded verdict means detection
+                    # keeps RE-firing, i.e. leadership is thrashing.
+                    # Derived from the live defaults so tuning them
+                    # can't silently misalign this judgment.
+                    import inspect
+
+                    from ..obs.health import vc_signal_source
+                    from ..obs.slo import default_slo_spec
+                    det_rule = next(
+                        r for r in default_slo_spec().rules
+                        if r.name == "viewchange.detection_seconds"
+                    )
+                    latch_s = inspect.signature(
+                        vc_signal_source).parameters["latch_s"].default
+                    recovery_bound = (latch_s + det_rule.fast_window_s
+                                      + det_rule.bound + 2.0)
+                else:
+                    recovery_bound = 30.0
+                recovery_s = await cluster.wait_healthy(
+                    timeout=recovery_bound)
             finally:
                 await cluster.stop()
             if verbose:

@@ -242,3 +242,11 @@ class CoalescedTrivialCrypto:
         chaos/trivial clusters exercise occupancy gating through the
         Configuration path too."""
         self._coalescer.configure_hold(hold, explicit=explicit)
+
+    def note_view_flip(self) -> None:
+        """Forward the Controller's view-flip warmth hint (ISSUE 15) to
+        the shared coalescer, like the real CryptoProvider."""
+        self._coalescer.note_view_flip()
+
+    def note_view_depose(self) -> None:
+        self._coalescer.note_view_depose()

@@ -53,10 +53,13 @@ _BP_FIELDS = (
 )
 
 # unit-free float knobs travel as integer thousandths (x/1000): the RTT
-# multiplier is a small ratio, and 0.001 resolution is far below any
-# meaningful timer difference
+# multipliers and backoff factors are small ratios, and 0.001 resolution
+# is far below any meaningful timer difference
 _X1000_FIELDS = (
     "request_forward_rtt_multiplier",
+    "heartbeat_rtt_multiplier",
+    "detection_backoff_base",
+    "detection_backoff_max",
 )
 
 _INT_FIELDS = (
@@ -76,6 +79,7 @@ _INT_FIELDS = (
     "transport_max_frame_bytes",
     "autoscale_min_shards",
     "autoscale_max_shards",
+    "flip_drain_windows",
 )
 
 # transport_listen is deliberately NOT mirrored: like self_id it is a
@@ -116,10 +120,14 @@ class ConfigMirror:
     transport_max_frame_bytes: int = 16 * 1024 * 1024
     autoscale_min_shards: int = 1
     autoscale_max_shards: int = 8
+    flip_drain_windows: int = 4
     autoscale_high_occupancy_bp: int = 8500
     autoscale_low_occupancy_bp: int = 1500
     admission_high_water_bp: int = 10000
     request_forward_rtt_multiplier_x1000: int = 0
+    heartbeat_rtt_multiplier_x1000: int = 0
+    detection_backoff_base_x1000: int = 2000
+    detection_backoff_max_x1000: int = 8000
     rotation_granularity: str = "decision"
     verify_mesh_topology: str = "1d"
     request_batch_max_interval_ms: int = 0

@@ -97,20 +97,38 @@ class Scheduler:
 
 
 class Ticker:
-    """Periodic callback built on :class:`Scheduler` (reference tick channels)."""
+    """Periodic callback built on :class:`Scheduler` (reference tick channels).
 
-    def __init__(self, scheduler: Scheduler, interval: float, callback: Callable[[], None]):
+    ``interval_fn`` (optional) makes the cadence ADAPTIVE: each re-arm asks
+    it for the next interval, falling back to the static ``interval`` when
+    it is absent, fails, or returns a non-positive value.  The heartbeat
+    monitor uses this to derive its check cadence from the effective
+    (possibly RTT-shrunk) complain timer — a fixed cadence lets detection
+    overshoot a shrunk timer by multiples (ISSUE 15)."""
+
+    def __init__(self, scheduler: Scheduler, interval: float,
+                 callback: Callable[[], None],
+                 interval_fn: Optional[Callable[[], float]] = None):
         if interval <= 0:
             raise ValueError(f"ticker interval must be positive, got {interval}")
         self._scheduler = scheduler
         self._interval = interval
+        self._interval_fn = interval_fn
         self._callback = callback
         self._stopped = False
         self._handle: Optional[TaskHandle] = None
         self._arm()
 
     def _arm(self) -> None:
-        self._handle = self._scheduler.schedule(self._interval, self._fire)
+        interval = self._interval
+        if self._interval_fn is not None:
+            try:
+                derived = self._interval_fn()
+            except Exception:  # noqa: BLE001 — cadence derivation is advisory
+                derived = None
+            if derived is not None and derived > 0:
+                interval = derived
+        self._handle = self._scheduler.schedule(interval, self._fire)
 
     def _fire(self) -> None:
         if self._stopped:

@@ -187,3 +187,43 @@ def test_kernel_and_tiny_rows_validate():
     from smartbft_tpu.obs.baseline import tiny_logical_row
 
     assert validate_row(tiny_logical_row(requests=4)) == []
+
+
+def test_viewchange_guard_rows_validate_and_degrade_gracefully():
+    """The ISSUE 15 failover pins: synthetic degraded rows through the
+    SAME pure assemble fn bench.py calls must validate against the
+    pinned schema, and an absent/empty degraded run yields no rows
+    instead of drifting ones."""
+    rows = openloop_child_rows()
+    degraded = rows[-1]
+    degraded["offered_per_sec"] = 300.0
+    degraded["shards"] = 2
+    degraded["phases"] = {
+        "healthy": {"count": 100, "p50_ms": 20.0, "p95_ms": 60.0,
+                    "p99_ms": 80.0},
+        "view_change": {"count": 90, "p50_ms": 40.0, "p95_ms": 150.0,
+                        "p99_ms": 220.0},
+    }
+    degraded["viewchange"] = {
+        "detection": {"count": 3, "p50_ms": 300.0, "p95_ms": 600.0,
+                      "p99_ms": 700.0, "max_ms": 710.0},
+        "timer": {"derived": True, "timeout_s_max": 0.5},
+    }
+    guard = bench.viewchange_guard_rows(rows)
+    assert [r["metric"] for r in guard] == [
+        "viewchange_phase_p99_ms", "viewchange_detection_p99_ms"
+    ]
+    assert validate_rows(guard) == []
+    phase = guard[0]
+    assert phase["value"] == 220.0
+    assert phase["vs_healthy"] == 2.75
+    det = guard[1]
+    assert det["value"] == 700.0
+    assert det["timer"]["derived"] is True
+    # no degraded run -> no guard rows (a missing producer is reported by
+    # the baseline checker as 'missing', never as drift)
+    assert bench.viewchange_guard_rows(rows[:-1]) == []
+    # a degraded run that never completed its phases -> no rows either
+    degraded["phases"] = {}
+    degraded["viewchange"] = {}
+    assert bench.viewchange_guard_rows(rows) == []

@@ -18,6 +18,10 @@ def _full_bundle_provider() -> PrometheusProvider:
     b.pool.count_of_failed_add_requests.with_labels("semaphore").add(2)
     b.view.view_number.set(2)
     b.view_change.heartbeat_detection_seconds.set(3.5)
+    b.view_change.detection_timeout_seconds.set(0.42)
+    b.view_change.detection_rtt_seconds.set(0.003)
+    b.view_change.detection_commit_interval_seconds.set(0.02)
+    b.view_change.detection_backoff_round.set(2)
     b.tpu.batch_fill_percent.observe(42.0)
     b.pool.latency_of_requests.observe(0.01)
     b.pool.latency_of_requests.observe(0.02)
@@ -29,6 +33,13 @@ def test_full_bundle_exposition_is_lint_clean():
     assert lint_prometheus_text(text) == []
     # the exposition actually carries the new health-relevant gauges
     assert "consensus_viewchange_heartbeat_detection_seconds 3.5" in text
+    # ISSUE 15: the effective (derived) complain timer and its inputs
+    # ride cmd=metrics
+    assert "consensus_viewchange_detection_timeout_seconds 0.42" in text
+    assert "consensus_viewchange_detection_rtt_input_seconds 0.003" in text
+    assert ("consensus_viewchange_detection_commit_interval_input_seconds"
+            " 0.02") in text
+    assert "consensus_viewchange_detection_backoff_round 2" in text
 
 
 def test_label_values_are_escaped_and_lintable():

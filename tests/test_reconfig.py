@@ -466,3 +466,102 @@ def test_config_mirror_round_trips_admission_control():
     assert unmirror_config(
         mirror_config(fast_config(1))
     ).admission_high_water == 1.0
+
+
+def test_config_mirror_round_trips_failover_detection_fields():
+    """A config-bearing reconfig must carry the adaptive-failover knobs
+    (ISSUE 15): dropping heartbeat_rtt_multiplier / the detection
+    backoff bounds / flip_drain_windows on the wire would silently
+    disarm sub-second failover (the mirror default for the multiplier
+    is 0 = constant timer) or reset the flip-drain budget mid-run.  The
+    unit-free ratios travel as integer thousandths like the forward-RTT
+    multiplier."""
+    import dataclasses
+
+    from smartbft_tpu.testing.app import fast_config
+    from smartbft_tpu.testing.reconfig import mirror_config, unmirror_config
+
+    cfg = dataclasses.replace(
+        fast_config(1),
+        heartbeat_rtt_multiplier=12.5,
+        detection_backoff_base=1.5,
+        detection_backoff_max=6.25,
+        flip_drain_windows=7,
+    )
+    rt = unmirror_config(mirror_config(cfg))
+    assert rt.heartbeat_rtt_multiplier == 12.5
+    assert rt.detection_backoff_base == 1.5
+    assert rt.detection_backoff_max == 6.25
+    assert rt.flip_drain_windows == 7
+    rt.with_node_locals(fast_config(3)).validate()
+    # the defaults round-trip to "adaptive off" exactly
+    assert unmirror_config(
+        mirror_config(fast_config(1))
+    ).heartbeat_rtt_multiplier == 0.0
+
+
+def test_config_validate_rejects_bad_detection_knobs():
+    import dataclasses
+
+    import pytest
+
+    from smartbft_tpu.config import ConfigError
+    from smartbft_tpu.testing.app import fast_config
+
+    with pytest.raises(ConfigError, match="heartbeat_rtt_multiplier"):
+        dataclasses.replace(
+            fast_config(1), heartbeat_rtt_multiplier=-1.0
+        ).validate()
+    with pytest.raises(ConfigError, match="detection_backoff_base"):
+        dataclasses.replace(
+            fast_config(1), detection_backoff_base=0.5
+        ).validate()
+    with pytest.raises(ConfigError, match="detection_backoff_max"):
+        dataclasses.replace(
+            fast_config(1), detection_backoff_base=3.0,
+            detection_backoff_max=2.0,
+        ).validate()
+    with pytest.raises(ConfigError, match="flip_drain_windows"):
+        dataclasses.replace(
+            fast_config(1), flip_drain_windows=-1
+        ).validate()
+
+
+def test_reconfig_swaps_failover_detection_knobs(tmp_path):
+    """Reconfig regression for the ISSUE 15 knobs: a live reconfig
+    carrying new adaptive-detection values must land on every node (the
+    rebuilt heartbeat monitor and pool consume them), and the cluster
+    must keep committing afterwards."""
+
+    async def run():
+        apps, scheduler, network, shared = make_nodes(4, tmp_path)
+        await start_all(apps)
+        new_cfg = dataclasses.replace(
+            fast_config(1),
+            heartbeat_rtt_multiplier=9.0,
+            detection_backoff_base=1.5,
+            detection_backoff_max=12.0,
+            flip_drain_windows=2,
+        )
+        await apps[0].submit_reconfig("rc-failover", [1, 2, 3, 4], new_cfg)
+        await wait_for(
+            lambda: all(
+                a.consensus.config.heartbeat_rtt_multiplier == 9.0
+                and a.consensus.config.flip_drain_windows == 2
+                and a.consensus.config.detection_backoff_max == 12.0
+                for a in apps
+            ),
+            scheduler, timeout=240.0,
+        )
+        # the rebuilt monitor runs the new derivation and the rebuilt
+        # pool carries the new flip budget
+        mon = apps[1].consensus.controller.leader_monitor
+        assert mon._rtt_multiplier == 9.0
+        assert apps[1].consensus.pool._opts.flip_drain_limit == \
+            2 * new_cfg.pipeline_depth * new_cfg.request_batch_max_count
+        await apps[0].submit("c", "r-post")
+        await wait_for(lambda: all(a.height() >= 2 for a in apps),
+                       scheduler, timeout=240.0)
+        await stop_all(apps)
+
+    asyncio.run(run())
