@@ -623,22 +623,89 @@ def viewchange_guard_rows(rows: list) -> list:
     return out
 
 
+def commitpath_guard_rows(rows: list) -> list:
+    """The ISSUE 16 commit-path pins: scalar rows derived from the
+    open-loop child's output so ``--check-baseline`` catches a raw-speed
+    regression — the saturation knee (tx/s, higher is better) and the
+    healthy-phase ``propose_wait`` / ``deliver`` critpath shares (unit
+    ``share``, lower is better: the two segments the arrival-driven
+    proposer and the batched deliver fan-out cut).  Pure function,
+    importable; rows degrade to [] when their source block is absent."""
+    out = []
+    knee = next((r for r in rows if r.get("metric") == "open_loop_knee"), {})
+    last_ok = knee.get("last_ok") or {}
+    if isinstance(last_ok.get("offered_per_sec"), (int, float)):
+        out.append({
+            "metric": "open_loop_knee_tx_per_sec",
+            "value": last_ok["offered_per_sec"],
+            "unit": "tx/s",
+            "goodput_per_sec": last_ok.get("goodput_per_sec"),
+            "p99_ms": last_ok.get("p99_ms"),
+            "beyond_sweep": knee.get("beyond_sweep"),
+        })
+    degraded = next(
+        (r for r in rows if r.get("metric") == "open_loop_degraded"), None
+    )
+    healthy = (((degraded or {}).get("critical_path") or {})
+               .get("phases") or {}).get("healthy") or {}
+    segments = healthy.get("segments") or {}
+    for seg in ("propose_wait", "deliver"):
+        share = (segments.get(seg) or {}).get("share")
+        if isinstance(share, (int, float)):
+            out.append({
+                "metric": f"critpath_{seg}_share",
+                "value": share,
+                "unit": "share",
+                "phase": "healthy",
+                "requests": healthy.get("requests"),
+                "dominant_segment": healthy.get("dominant_segment"),
+                "sums_consistent": healthy.get("sums_consistent"),
+                "offered_per_sec": (degraded or {}).get("offered_per_sec"),
+            })
+    for kr in rows:
+        if kr.get("metric") != "open_loop_affinity_knee":
+            continue
+        s, ok = kr.get("shards"), kr.get("last_ok") or {}
+        if isinstance(ok.get("offered_per_sec"), (int, float)):
+            out.append({
+                "metric": f"open_loop_affinity_s{s}_knee_tx_per_sec",
+                "value": ok["offered_per_sec"],
+                "unit": "tx/s",
+                "shards": s,
+                "loop_affinity": kr.get("loop_affinity"),
+                "goodput_per_sec": ok.get("goodput_per_sec"),
+                "beyond_sweep": kr.get("beyond_sweep"),
+            })
+    return out
+
+
 def open_loop_bench(cpu_mode: bool) -> None:
     """Run benchmarks/openloop.py in a subprocess and print ONE JSON line
     whose ``latency`` block carries percentiles + histogram + shed counts
     + knee + degraded-phase percentiles (the round-12 contract)."""
     here = os.path.dirname(os.path.abspath(__file__))
+    # default grid raised in round 18 (commit-path raw speed): the knee
+    # moved from 800/s to the 8-9k/s band, so the old 200-1600 sweep
+    # would read "beyond sweep" and pin nothing
     rates = os.environ.get("SMARTBFT_BENCH_OPENLOOP_RATES",
-                           "200,400,800,1600")
+                           "1000,2000,4000,8000,9000")
     duration = float(os.environ.get("SMARTBFT_BENCH_OPENLOOP_DURATION", "8"))
     phase = float(os.environ.get("SMARTBFT_BENCH_OPENLOOP_PHASE", "6"))
     drain = 3.0
+    sweep_shards = os.environ.get("SMARTBFT_BENCH_OPENLOOP_SWEEP_SHARDS", "")
     cmd = [sys.executable, os.path.join(here, "benchmarks", "openloop.py"),
            "--rates", rates, "--duration", str(duration),
            "--phase-duration", str(phase)]
+    if sweep_shards:
+        cmd += ["--sweep-shards", sweep_shards]
     if cpu_mode:
         cmd.append("--cpu")
     points = len([r for r in rates.split(",") if r.strip()])
+    # each affinity-sweep point runs its S workers CONCURRENTLY, so a
+    # point costs one duration+drain+salvage budget regardless of S
+    affinity_points = (points * len([s for s in sweep_shards.split(",")
+                                     if s.strip()])
+                       if sweep_shards else 0)
     phase_timeout = float(os.environ.get(
         "SMARTBFT_BENCH_OPENLOOP_PHASE_TIMEOUT", "60"))
     # derived, not guessed (the PR-5/7 salvage lesson): every sweep point
@@ -648,7 +715,7 @@ def open_loop_bench(cpu_mode: bool) -> None:
     # deadline — the child's own salvage fires before this parent kills it
     timeout = float(os.environ.get(
         "SMARTBFT_BENCH_OPENLOOP_TIMEOUT",
-        str(points * (duration + drain + phase_timeout)
+        str((points + affinity_points) * (duration + drain + phase_timeout)
             + 5 * (phase + drain) + 5 * phase_timeout + 120)))
     proc = subprocess.run(
         cmd, timeout=timeout, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
@@ -662,6 +729,8 @@ def open_loop_bench(cpu_mode: bool) -> None:
             if l.strip()]
     _emit(assemble_open_loop_row(rows))
     for guard_row in viewchange_guard_rows(rows):
+        _emit(guard_row)
+    for guard_row in commitpath_guard_rows(rows):
         _emit(guard_row)
 
 

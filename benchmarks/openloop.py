@@ -61,7 +61,8 @@ PHASE_TIMEOUT = float(os.environ.get("SMARTBFT_BENCH_OPENLOOP_PHASE_TIMEOUT",
                                      "60"))
 
 
-def openloop_config(pool_size: int, batch: int, admission: float):
+def openloop_config(pool_size: int, batch: int, admission: float,
+                    adaptive: bool = False):
     """Per-node configuration for open-loop runs: production-shaped pool
     + admission knobs, view-change machinery tight enough that a forced
     view change completes inside a measured phase."""
@@ -76,6 +77,13 @@ def openloop_config(pool_size: int, batch: int, admission: float):
             request_pool_submit_timeout=1.0,
             request_batch_max_count=batch,
             request_batch_max_interval=0.02,
+            # arrival-driven proposing (ISSUE 16): the leader proposes as
+            # soon as the arrival EWMA says the wave cannot fill inside
+            # the cadence, so `request_batch_max_interval` is the
+            # ACCUMULATION CAP under load, not a per-wave latency tax at
+            # low load — deep `batch` caps and low-load latency stop
+            # being a tradeoff
+            request_batch_adaptive=adaptive,
             # a request pooled on a non-leader (mid-view-change intake)
             # must reach the leader well inside the reshard drain
             # deadline, or a moved key-range cannot finish draining
@@ -85,11 +93,15 @@ def openloop_config(pool_size: int, batch: int, admission: float):
             leader_heartbeat_timeout=3.0,
             leader_heartbeat_count=10,
             # adaptive failover (ISSUE 15): the complain timer derives
-            # from the commit inter-arrival EWMA (~10x the measured
-            # cadence, the 3 s constant as ceiling), so the forced-VC
-            # phase's detection lands sub-second; the flip drain is on
-            # by default (flip_drain_windows)
-            heartbeat_rtt_multiplier=10.0,
+            # from the commit inter-arrival EWMA (a multiple of the
+            # measured cadence, the 3 s constant as ceiling), so the
+            # forced-VC phase's detection lands sub-second; the flip
+            # drain is on by default (flip_drain_windows).  20x rather
+            # than the product-default 10x: every replica of every shard
+            # shares ONE core here, so scheduling jitter near saturation
+            # rivals a 10x-the-commit-gap timer and fires spurious view
+            # changes mid-measurement (round 18)
+            heartbeat_rtt_multiplier=20.0,
             view_change_timeout=12.0,
             view_change_resend_interval=3.0,
             verify_launch_timeout=0.15,
@@ -109,9 +121,32 @@ def build_cluster(tmp: str, args, *, engine_faults: bool = False,
         tmp, shards=args.shards, n=args.nodes, depth=2, crypto="trivial",
         engine_faults=engine_faults, window=0.005, seed=17,
         config_fn=openloop_config(args.pool_size, args.batch,
-                                  args.admission),
+                                  args.admission,
+                                  adaptive=not args.no_adaptive),
         trace=trace, trace_capacity=trace_capacity,
     )
+
+
+def cluster_rtt_s_max(cluster) -> float:
+    """The worst measured transport RTT across live replicas — 0.0 on the
+    in-process loopback Network (no wire, no sampler), the REAL envelope
+    once a socket transport rides this bench.  Recorded per row so the
+    ROADMAP's WAN-profile work inherits an honest field instead of a
+    number that silently meant 'never measured'."""
+    worst = 0.0
+    for sh in cluster.shard_list:
+        for a in sh.live_apps():
+            comm = getattr(getattr(a, "consensus", None), "comm", None)
+            rtt_fn = getattr(comm, "rtt_seconds", None)
+            if rtt_fn is None:
+                continue
+            try:
+                rtt = rtt_fn()
+            except Exception:  # noqa: BLE001 — observability, never fatal
+                continue
+            if rtt is not None and rtt > worst:
+                worst = rtt
+    return round(worst, 6)
 
 
 async def _wait_wall(cond, timeout: float, step: float = 0.02) -> bool:
@@ -123,8 +158,14 @@ async def _wait_wall(cond, timeout: float, step: float = 0.02) -> bool:
     return True
 
 
-async def run_sweep_point(rate: float, args) -> dict:
-    """One offered-load point: fresh cluster, open-loop pump, one row."""
+async def run_sweep_point(rate: float, args, *, prefix: str = "ol",
+                          export_hist: bool = False) -> dict:
+    """One offered-load point: fresh cluster, open-loop pump, one row.
+
+    ``prefix`` namespaces request ids (affinity-sweep workers each pump a
+    private 1-shard cluster, and the merged row must not alias their
+    ids); ``export_hist`` adds the raw latency-histogram state to the row
+    so the parent merges EXACT bucket sums, not percentiles."""
     from smartbft_tpu.testing.load import ZipfClients, run_open_loop
     from smartbft_tpu.utils.clock import WallClockDriver
 
@@ -147,7 +188,7 @@ async def run_sweep_point(rate: float, args) -> dict:
         stats = await run_open_loop(
             cluster, rate=rate, duration=args.duration, clients=zipf,
             seed=31, wall=True, step=0.005, drain=args.drain,
-            on_tick=on_tick,
+            on_tick=on_tick, request_prefix=prefix,
         )
         committed = cluster.set.committed_requests()
         in_window = window_committed["n"]
@@ -164,11 +205,19 @@ async def run_sweep_point(rate: float, args) -> dict:
             "hot_client_share": round(zipf.hot_fraction(1), 3),
             "pool_size": args.pool_size,
             "admission_high_water": args.admission,
+            "batch_max": args.batch,
+            "adaptive_batching": not args.no_adaptive,
+            # self-describing rows (ISSUE 16 bench hygiene): which loop
+            # topology served this point, and the honest RTT envelope
+            "loop_affinity": args.affinity,
+            "rtt_s_max": cluster_rtt_s_max(cluster),
             "goodput_per_sec": round(in_window / args.duration, 1),
             "committed_total": committed,
             "open_loop": stats.block(),
             "latency": lat,
         }
+        if export_hist:
+            row["lat_hist"] = cluster.set.latency.aggregate.export_state()
         _log(f"openloop[{rate:g}/s]: goodput {row['goodput_per_sec']}/s "
              f"shed {stats.shed}/{stats.offered} "
              f"p99 {lat['p99_ms']}ms peak_occ {stats.peak_occupancy}")
@@ -216,6 +265,147 @@ def find_knee(rows: list) -> dict:
     return knee
 
 
+def merge_worker_rows(rows: list, rate: float, shards: int, args) -> dict:
+    """Fold S per-process worker rows (one 1-shard cluster each) into the
+    ONE merged affinity-sweep row.  Counters sum, peaks take the max, and
+    the latency percentiles come from the exact bucket-wise histogram
+    merge of the workers' exported raw state — never a
+    percentile-of-percentiles."""
+    from smartbft_tpu.metrics import LogScaleHistogram
+
+    hist = LogScaleHistogram()
+    for r in rows:
+        if r.get("lat_hist"):
+            hist.merge_from(LogScaleHistogram.from_state(r["lat_hist"]))
+    ol = {
+        "offered": sum(r["open_loop"]["offered"] for r in rows),
+        "acked": sum(r["open_loop"]["acked"] for r in rows),
+        "shed_admission": sum(r["open_loop"]["shed_admission"]
+                              for r in rows),
+        "shed_timeout": sum(r["open_loop"]["shed_timeout"] for r in rows),
+        "failed": sum(r["open_loop"]["failed"] for r in rows),
+        "peak_occupancy": max(r["open_loop"]["peak_occupancy"]
+                              for r in rows),
+        "peak_fill": max(r["open_loop"]["peak_fill"] for r in rows),
+        "retry_after_p50": None,
+    }
+    shed = ol["shed_admission"] + ol["shed_timeout"]
+    ol["shed_rate"] = round(shed / ol["offered"], 4) if ol["offered"] else 0.0
+    lat = hist.snapshot()
+    # the latency snapshot a single-cluster row carries also has shed
+    # counters riding it; keep the merged row shape-compatible
+    lat["shed"] = {"admission": ol["shed_admission"],
+                   "timeout": ol["shed_timeout"], "other": ol["failed"]}
+    return {
+        "bench": "openloop_affinity",
+        "offered_per_sec": rate,
+        "duration_s": args.duration,
+        "shards": shards,
+        "nodes_per_shard": args.nodes,
+        "clients": sum(r["clients"] for r in rows),
+        "zipf_skew": args.zipf,
+        "pool_size": args.pool_size,
+        "admission_high_water": args.admission,
+        "batch_max": args.batch,
+        "adaptive_batching": not args.no_adaptive,
+        "loop_affinity": "process",
+        "rtt_s_max": max(r.get("rtt_s_max", 0.0) for r in rows),
+        "goodput_per_sec": round(sum(r["goodput_per_sec"] for r in rows), 1),
+        "committed_total": sum(r["committed_total"] for r in rows),
+        "open_loop": ol,
+        "latency": lat,
+        "workers": [
+            {"offered_per_sec": r["offered_per_sec"],
+             "goodput_per_sec": r["goodput_per_sec"],
+             "p99_ms": r["latency"]["p99_ms"],
+             "shed_rate": r["open_loop"]["shed_rate"]}
+            for r in rows
+        ],
+    }
+
+
+def run_affinity_point(rate: float, shards: int, args) -> dict:
+    """One affinity-sweep point: S concurrent WORKER PROCESSES, each a
+    private 1-shard cluster (own interpreter, own event loop — the
+    per-shard loop affinity the shared-scheduler ShardedCluster cannot
+    give) serving 1/S of the offered load over a disjoint client slice.
+    The parent merges the S rows into one."""
+    import subprocess
+
+    here = os.path.abspath(__file__)
+    per_rate = rate / shards
+    per_clients = max(1, args.clients // shards)
+    procs = []
+    for k in range(shards):
+        cmd = [sys.executable, here, "--worker",
+               "--worker-prefix", f"w{k}",
+               "--rates", f"{per_rate:g}",
+               "--shards", "1", "--nodes", str(args.nodes),
+               "--duration", str(args.duration), "--drain", str(args.drain),
+               "--batch", str(args.batch),
+               "--pool-size", str(args.pool_size),
+               "--admission", str(args.admission),
+               "--clients", str(per_clients), "--zipf", str(args.zipf),
+               "--affinity", "process", "--no-degraded", "--cpu"]
+        if args.no_adaptive:
+            cmd.append("--no-adaptive")
+        procs.append(subprocess.Popen(
+            cmd, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        ))
+    deadline = time.monotonic() + args.duration + args.drain + PHASE_TIMEOUT
+    rows = []
+    for p in procs:
+        budget = max(1.0, deadline - time.monotonic())
+        try:
+            out, _ = p.communicate(timeout=budget)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.communicate()
+            continue
+        if p.returncode != 0:
+            continue
+        for line in out.decode().splitlines():
+            line = line.strip()
+            if line:
+                rows.append(json.loads(line))
+    if not rows:
+        raise RuntimeError(
+            f"affinity point S={shards} rate={rate:g}: every worker failed")
+    if len(rows) < shards:
+        _log(f"affinity[S={shards} {rate:g}/s]: only {len(rows)}/{shards} "
+             f"workers survived — merged row covers the survivors' load")
+    return merge_worker_rows(rows, rate, shards, args)
+
+
+def run_affinity_sweep(args) -> None:
+    """The ISSUE 16 S∈{4,8,16} loop-affinity sweep: for each shard count,
+    sweep the offered loads with process-per-shard workers and locate
+    the per-S knee.  Emits one merged row per point plus one
+    ``open_loop_affinity_knee`` line per S."""
+    shard_counts = [int(x) for x in args.sweep_shards.split(",")
+                    if x.strip()]
+    rates = [float(x) for x in args.rates.split(",") if x.strip()]
+    for s in shard_counts:
+        rows = []
+        for rate in rates:
+            try:
+                row = run_affinity_point(rate, s, args)
+            except Exception as exc:  # noqa: BLE001 — a stuck point costs
+                _log(f"affinity[S={s} {rate:g}/s]: FAILED — {exc!r}")
+                continue  # its slot; the per-S knee degrades gracefully
+            print(json.dumps(row), flush=True)
+            rows.append(row)
+            _log(f"affinity[S={s} {rate:g}/s]: goodput "
+                 f"{row['goodput_per_sec']}/s shed "
+                 f"{row['open_loop']['shed_rate']} "
+                 f"p99 {row['latency']['p99_ms']}ms")
+        if rows:
+            print(json.dumps({
+                "metric": "open_loop_affinity_knee", "shards": s,
+                "loop_affinity": "process", **find_knee(rows),
+            }), flush=True)
+
+
 async def run_degraded(args) -> dict:
     """Fixed offered load through every degraded mode, ONE live cluster.
 
@@ -234,10 +424,12 @@ async def run_degraded(args) -> dict:
     # whole degraded run, and the per-phase VC decomposition comes out in
     # the row's `viewchange` block — the scheduler is wall-driven here,
     # so span durations are real seconds
-    # deep rings (16k/recorder): the critical-path decomposition joins a
-    # request's submit with its deliver — both must survive the run
+    # deep rings (64k/recorder): the critical-path decomposition joins a
+    # request's submit with its deliver — both must survive the WHOLE
+    # five-phase walk (16k retained only the last ~5k requests, silently
+    # dropping the healthy phase from the per-phase critpath block)
     cluster = build_cluster(tmp, args, engine_faults=True, trace=True,
-                            trace_capacity=16384)
+                            trace_capacity=65536)
     # the transition's bounded drain shares the per-phase salvage budget
     # (same convention as benchmarks/sharded.py's live resize)
     cluster.set.drain_deadline = PHASE_TIMEOUT
@@ -423,8 +615,16 @@ def main() -> None:
                     help="post-arrival drain window per point")
     ap.add_argument("--shards", type=int, default=2)
     ap.add_argument("--nodes", type=int, default=4, help="replicas per shard")
-    ap.add_argument("--batch", type=int, default=16)
-    ap.add_argument("--pool-size", type=int, default=200)
+    # round-18 defaults: deep waves (the adaptive proposer keeps low-load
+    # latency flat, so the cap can sit where throughput wants it) and a
+    # pool sized so admission, not slot scarcity, is the shed authority
+    # at the post-round-18 knee
+    ap.add_argument("--batch", type=int, default=128)
+    # 2400 (round 18): at the 8-9k/s knee a view-change or GC burst
+    # backlogs ~0.3s of arrivals; an 800-slot pool shed those bursts
+    # straight through the admission gate and poisoned otherwise-healthy
+    # rows, while 2400 rides them out (reported per row as pool_size)
+    ap.add_argument("--pool-size", type=int, default=2400)
     ap.add_argument("--admission", type=float, default=0.8,
                     help="admission_high_water fraction (1.0 disables)")
     ap.add_argument("--clients", type=int, default=512,
@@ -435,12 +635,46 @@ def main() -> None:
     ap.add_argument("--phase-duration", type=float, default=6.0)
     ap.add_argument("--no-degraded", action="store_true",
                     help="skip the degraded-mode phase run")
+    ap.add_argument("--no-adaptive", action="store_true",
+                    help="disable arrival-driven proposing (fixed-cadence "
+                         "waves, the pre-round-18 behavior)")
+    ap.add_argument("--affinity", choices=("shared", "process"),
+                    default="shared",
+                    help="loop topology label stamped on rows: 'shared' = "
+                         "all shards on one scheduler/loop (ShardedCluster)"
+                         ", 'process' = one interpreter per shard")
+    ap.add_argument("--sweep-shards", default="",
+                    help="comma-separated shard counts (e.g. 4,8,16): "
+                         "additionally sweep --rates with process-per-"
+                         "shard workers and emit merged affinity rows + a "
+                         "per-S knee line")
+    ap.add_argument("--worker", action="store_true",
+                    help=argparse.SUPPRESS)  # internal: one sweep point,
+    # 1-shard cluster, row + raw histogram on stdout (affinity workers)
+    ap.add_argument("--worker-prefix", default="ol",
+                    help=argparse.SUPPRESS)
     ap.add_argument("--cpu", action="store_true",
                     help="pin JAX to the CPU backend")
     args = ap.parse_args()
 
+    # measurement hygiene: INFO/DEBUG records cost ~20µs each THROUGH the
+    # disabled-handler path (makeRecord + callHandlers), and the replicas
+    # emit them per request — at bench rates that is whole CPU-seconds of
+    # logging inside the measured window.  WARNING+ (overload, failover)
+    # still reaches stderr.
+    import logging as _pylogging
+
+    _pylogging.disable(_pylogging.INFO)
+
     if args.cpu or os.environ.get("SMARTBFT_BENCH_CPU") == "1":
         force_cpu()
+
+    if args.worker:
+        rate = float(args.rates.split(",")[0])
+        row = asyncio.run(run_sweep_point(
+            rate, args, prefix=args.worker_prefix, export_hist=True))
+        print(json.dumps(row), flush=True)
+        return
 
     rows = []
     for rate in [float(x) for x in args.rates.split(",") if x.strip()]:
@@ -454,6 +688,9 @@ def main() -> None:
     if rows:
         print(json.dumps({"metric": "open_loop_knee", **find_knee(rows)}),
               flush=True)
+
+    if args.sweep_shards:
+        run_affinity_sweep(args)
 
     if not args.no_degraded:
         try:

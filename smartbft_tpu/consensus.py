@@ -493,6 +493,8 @@ class Consensus:
             metrics_view=self.metrics.view,
             vc_phases=self.vc_phases,
             recorder=self.recorder,
+            # debounce clock for the event-driven standby prebuild
+            scheduler=self.scheduler,
         )
         self.collector = StateCollector(
             self_id=self.config.self_id,
@@ -627,6 +629,8 @@ class Consensus:
             self.config.request_batch_max_count,
             self.config.request_batch_max_bytes,
             self.config.request_batch_max_interval,
+            adaptive=self.config.request_batch_adaptive,
+            fill_slack=self.config.request_batch_fill_slack,
         )
         self.pool._on_submitted = batcher.on_submitted
         leader_monitor = HeartbeatMonitor(

@@ -6,6 +6,16 @@ submitted-event and a scheduler timer — closing the reference's TODO
 ("use task-scheduler based on logical time", batcher.go:46): the timeout
 runs on the shared logical-time Scheduler, so tests drive it
 deterministically.
+
+Arrival-driven mode (``adaptive=True``): the fixed cadence above taxes every
+partial wave with the full ``batch_timeout`` even when the pool's arrival
+rate says the wave can never fill in time.  Adaptive mode applies the
+TagRateTracker/occupancy-gating idiom to the proposer: on every wakeup it
+compares the wave's remaining deficit against what the pool's arrival-rate
+EWMA predicts will land before the deadline, and proposes IMMEDIATELY once
+the fill is implausible (``deficit > rate * fill_slack * time_left``).  A
+wave the rate predicts WILL fill still forms to full depth, so saturation
+keeps its deep amortizing batches; the deadline stays the hard bound.
 """
 
 from __future__ import annotations
@@ -25,15 +35,21 @@ class BatchBuilder:
         max_msg_count: int,
         max_size_bytes: int,
         batch_timeout: float,
+        adaptive: bool = False,
+        fill_slack: float = 1.0,
     ):
         self._pool = pool
         self._scheduler = scheduler
         self._max_msg_count = max_msg_count
         self._max_size_bytes = max_size_bytes
         self._batch_timeout = batch_timeout
+        self._adaptive = adaptive
+        self._fill_slack = fill_slack
         self._closed = False
         self._wakeup: Optional[asyncio.Future] = None
         self._pending_signal = False
+        #: proposes cut short by the fill prediction (observability)
+        self.early_proposes = 0
 
     def on_submitted(self) -> None:
         """Wired as the pool's submitted signal (1-slot, like the reference's
@@ -42,6 +58,19 @@ class BatchBuilder:
             self._wakeup.set_result("submitted")
         else:
             self._pending_signal = True
+
+    def _fill_implausible(self, deadline: float) -> bool:
+        """Adaptive gate: can the wave still plausibly reach max_msg_count
+        before ``deadline`` at the measured arrival rate?  Rate 0 (idle or
+        cold pool) makes any deficit implausible — the no-load case where
+        waiting out the cadence buys nothing."""
+        deficit = self._max_msg_count - self._pool.available_count()
+        if deficit <= 0:
+            return False  # already full; the caller's full-check wins
+        remaining = deadline - self._scheduler.now()
+        if remaining <= 0:
+            return True
+        return deficit > self._pool.arrival_rate() * self._fill_slack * remaining
 
     async def next_batch(self) -> Optional[list[bytes]]:
         """Return the next proposal batch; None if closed (batcher.go:40-63)."""
@@ -54,6 +83,16 @@ class BatchBuilder:
             return None
 
         deadline = self._scheduler.now() + self._batch_timeout
+        if self._adaptive and self._fill_implausible(deadline):
+            # the wave cannot fill in time: propose whatever is pooled NOW
+            # instead of paying the cadence.  An empty pool falls through
+            # to the wait — there is nothing to propose early.
+            batch, _ = self._pool.next_requests(
+                self._max_msg_count, self._max_size_bytes, check=False
+            )
+            if batch:
+                self.early_proposes += 1
+                return batch
         timer = self._scheduler.schedule(self._batch_timeout, self._on_timeout)
         try:
             while True:
@@ -82,6 +121,13 @@ class BatchBuilder:
                 )
                 if full:
                     return batch
+                if self._adaptive and self._fill_implausible(deadline):
+                    batch, _ = self._pool.next_requests(
+                        self._max_msg_count, self._max_size_bytes, check=False
+                    )
+                    if batch:
+                        self.early_proposes += 1
+                        return batch
         finally:
             timer.cancel()
             self._wakeup = None

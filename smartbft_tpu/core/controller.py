@@ -187,7 +187,13 @@ class Controller(RequestTimeoutHandler):
         self._stopped = False
         self._task: Optional[asyncio.Task] = None
         self._propose_pending = False  # 1-slot leader token (controller.go:748-761)
+        # propose-side launch shadow: batch formation + proposal assembly
+        # run in this task, OFF the controller event loop, so decisions and
+        # view events keep flowing while the leader waits on the batcher
+        # (1-slot, like the token: at most one assembly in flight)
+        self._assembly_task: Optional[asyncio.Task] = None
         self._fwd_submit_failures = 0  # throttled warn counter (handle_request)
+        self._shed_submits = 0  # throttled info counter (submit_request)
         self._leader_memo_key = None  # (view, decisions, ckpt version) memo
         self._leader_memo = 0
         self._sync_pending = False  # 1-slot sync token (controller.go:718-730)
@@ -202,6 +208,10 @@ class Controller(RequestTimeoutHandler):
         self._clock = clock if clock is not None else time.monotonic
         self._last_commit_t: Optional[float] = None
         self._commit_gap_ewma = 0.0
+        # last PROOF the leader is alive (heartbeat receipt time, fed by the
+        # HeartbeatMonitor) — lets commit_interval_seconds tell "no load"
+        # (leader alive, nothing to commit) from "no leader" (silence)
+        self._leader_alive_at: Optional[float] = None
 
     # ------------------------------------------------------------------ info
 
@@ -245,8 +255,37 @@ class Controller(RequestTimeoutHandler):
     def commit_interval_seconds(self) -> Optional[float]:
         """The measured commit inter-arrival EWMA (seconds), or None
         before two deliveries have landed — the cluster-visible liveness
-        cadence the adaptive complain timer derives from."""
-        return self._commit_gap_ewma if self._commit_gap_ewma > 0 else None
+        cadence the adaptive complain timer derives from.
+
+        Idle decay (ISSUE 15 residual e): a busy-era EWMA of tens of ms
+        would otherwise cadence-lock the complain timer at hair-trigger
+        forever once traffic stops.  When the leader has PROVEN itself
+        alive after the last commit (a heartbeat arrived — see
+        on_leader_sign_of_life) and the commit silence has outgrown the
+        EWMA, the silence span itself is reported: the derived timer then
+        relaxes toward its configured ceiling as the lull extends.  Silence
+        WITHOUT a fresh sign of life keeps the tight busy-era value — a
+        possibly-dead leader must still be detected fast."""
+        ewma = self._commit_gap_ewma
+        if ewma <= 0:
+            return None
+        if (
+            self._last_commit_t is not None
+            and self._leader_alive_at is not None
+            and self._leader_alive_at > self._last_commit_t
+        ):
+            # commit silence WITNESSED by a live leader: grows while
+            # heartbeats keep arriving, freezes the moment they stop — a
+            # leader that dies mid-lull must not keep relaxing the timer
+            idle = self._leader_alive_at - self._last_commit_t
+            if idle > 2.0 * ewma:
+                return idle
+        return ewma
+
+    def on_leader_sign_of_life(self, t: float) -> None:
+        """HeartbeatMonitor receipt hook: the current leader demonstrated
+        liveness at ``t`` (same clock domain as ``clock``)."""
+        self._leader_alive_at = t
 
     # ------------------------------------------------------------------ requests
 
@@ -260,7 +299,15 @@ class Controller(RequestTimeoutHandler):
         try:
             await self.request_pool.submit(request, forwarded=forwarded)
         except Exception as e:
-            self.logger.infof("Request %s was not submitted, error: %s", info, e)
+            # a shed submit is ROUTINE past the admission knee — throttle
+            # like the forwarded-path warnings (per-request records on
+            # this hot path cost whole seconds per open-loop bench run)
+            self._shed_submits += 1
+            if self._shed_submits == 1 or self._shed_submits % 1000 == 0:
+                self.logger.infof(
+                    "Request %s was not submitted (%d sheds so far), error: %s",
+                    info, self._shed_submits, e,
+                )
             raise
         self.logger.debugf("Request %s was submitted", info)
 
@@ -609,22 +656,45 @@ class Controller(RequestTimeoutHandler):
         """controller.go:475-487.  In pipelined mode (pipeline_depth > 1)
         the view accepts proposals while previous decisions are still in
         flight; the token re-arms after each propose until the window fills,
-        and again on every delivery (_decide)."""
+        and again on every delivery (_decide).
+
+        Propose-side launch shadow: batch formation + assembly run in a
+        concurrent task (_assemble_and_propose), NOT inline on the event
+        loop — the old inline ``await next_batch()`` serialized every
+        queued decision behind up to a full batch interval of waiting, so
+        delivery fan-out stalled exactly when the leader was idling for
+        requests.  The 1-slot assembly task mirrors the leader token."""
         self._propose_pending = False
         if self._stopped or self.batcher.closed():
             return
+        if self._assembly_task is not None and not self._assembly_task.done():
+            return  # assembly in flight; it re-arms the token when done
         view = self.curr_view
         window_has_room = getattr(view, "can_accept_more_proposals", None)
         if window_has_room is not None and not window_has_room():
             # window full: the next delivery (_decide) or the view's
             # capacity seam (on_window_capacity) re-arms the token
             return
+        self._assembly_task = create_logged_task(
+            self._assemble_and_propose(view, window_has_room),
+            name=f"controller-assemble-{self.id}", logger=self.logger,
+        )
+
+    async def _assemble_and_propose(self, view, window_has_room) -> None:
+        """One batch-form + assemble + propose cycle, running in the shadow
+        of the in-flight wave's verify launch.  Every controller-state
+        mutation here is loop-synchronous (no awaits between the post-batch
+        guard and the propose), so the event loop never observes a half
+        -proposed state."""
         next_batch = await self.batcher.next_batch()
         if not next_batch:
-            self._acquire_leader_token()  # try again later
+            if not (self._stopped or self.batcher.closed()):
+                self._acquire_leader_token()  # try again later
             return
-        if view is not self.curr_view or self._stopped:
-            return  # view changed while batching
+        if view is not self.curr_view or self._stopped or self.batcher.closed():
+            # view changed/aborted while batching: the requests were never
+            # marked in flight, so the next view re-batches them
+            return
         metadata = view.get_metadata()
         proposal = self.assembler.assemble_proposal(metadata, next_batch)
         rec = self.recorder
@@ -975,6 +1045,13 @@ class Controller(RequestTimeoutHandler):
         if self._task is not None:
             await self._task
             self._task = None
+        if self._assembly_task is not None:
+            # the closed batcher resolves any parked next_batch wait, so
+            # this never blocks; awaiting keeps shutdown orphan-free
+            try:
+                await self._assembly_task
+            finally:
+                self._assembly_task = None
 
     def stopped(self) -> bool:
         return self._stopped

@@ -131,6 +131,23 @@ class HeartbeatMonitor:
         self._stop_send_heartbeat_from_leader = False
         self._last_heartbeat: Optional[float] = None
         self._last_tick: float = 0.0
+        #: learned tick inter-arrival (local-pause detector, ISSUE 16): a
+        #: tick landing far past this cadence means THIS process was
+        #: starved (GC pause, saturated event loop, host preemption) — a
+        #: span during which no heartbeat could have been observed from a
+        #: perfectly live leader.  The follower complain base is credited
+        #: with the stall so local starvation never reads as leader
+        #: silence (the spurious-failover storm that capped the round-18
+        #: open-loop sweep).  A leader that truly died inside the pause is
+        #: still caught: silence keeps accruing normally from the first
+        #: post-pause tick on.
+        self._tick_gap_ewma = 0.0
+        #: folded cadence samples — the expectation is only trusted once
+        #: it has warmed up (a couple of sparse hand-driven ticks must not
+        #: read every subsequent gap as a pause)
+        self._tick_gap_samples = 0
+        #: discounted local pauses (observability)
+        self.local_pauses = 0
         self._hb_resp_collector: dict[int, int] = {}
         self._timed_out = False
         self._sync_req = False
@@ -304,10 +321,31 @@ class HeartbeatMonitor:
         """heartbeatmonitor.go:345-350."""
         if self._closed:
             return
+        prev = self._last_tick
         self._last_tick = now
         if self._last_heartbeat is None:
             self._last_heartbeat = now
-        if self._follower or self._stop_send_heartbeat_from_leader:
+        follower = self._follower or self._stop_send_heartbeat_from_leader
+        gap = now - prev
+        if prev > 0 and gap > 0:
+            if self._tick_gap_samples >= 8 and gap > 4.0 * self._tick_gap_ewma:
+                # local pause: the tick driver was starved for far longer
+                # than its learned cadence, so nothing COULD have been
+                # observed in that span.  Credit the excess to the
+                # follower's complain base (never past `now`); the leader
+                # path wants the opposite — emit immediately after the
+                # stall — so it is left untouched.  The EWMA does not fold
+                # the outlier (one pause must not stretch the expectation).
+                self.local_pauses += 1
+                if follower and self._last_heartbeat is not None:
+                    self._last_heartbeat = min(
+                        now, self._last_heartbeat + (gap - self._tick_gap_ewma)
+                    )
+            else:
+                self._tick_gap_ewma = gap if self._tick_gap_ewma <= 0 \
+                    else 0.8 * self._tick_gap_ewma + 0.2 * gap
+                self._tick_gap_samples += 1
+        if follower:
             self._follower_tick(now)
         else:
             self._leader_tick(now)
@@ -424,6 +462,14 @@ class HeartbeatMonitor:
                     else 0.7 * self._hb_gap_ewma + 0.3 * gap
         self._last_hb_seen_at = t
         self._last_heartbeat = self._last_tick
+        # idle-decay seam (ISSUE 15 residual e): tell the commit-interval
+        # EWMA's owner the leader just proved itself alive — commit silence
+        # WITNESSED by live heartbeats reads as "no load" and relaxes the
+        # derived complain timer, while silence without them stays "maybe
+        # no leader" and keeps the tight busy-era cadence
+        sign_of_life = getattr(self._handler, "on_leader_sign_of_life", None)
+        if sign_of_life is not None:
+            sign_of_life(t)
 
     def _handle_heartbeat_response(self, sender: int, hbr: HeartBeatResponse) -> None:
         """f+1 higher-view responses force a sync (go:260-286)."""

@@ -322,6 +322,11 @@ class WindowedView:
         self._verify_results: list[tuple] = []
         self._aborted = False
         self._abort_event = asyncio.Event()
+        # persistent abort sentinel for the decide rendezvous: created
+        # lazily on first delivery, reused for every decision, cancelled
+        # once in _run's teardown — the per-decision create+cancel pair
+        # was a measurable fixed cost of the deliver segment
+        self._abort_wait_task: Optional[asyncio.Task] = None
         self._task: Optional[asyncio.Task] = None
         self._verify_tasks: set[asyncio.Task] = set()
         self._restored_broadcasts: list[Message] = []
@@ -573,6 +578,9 @@ class WindowedView:
         finally:
             for t in list(self._verify_tasks):
                 t.cancel()
+            if self._abort_wait_task is not None:
+                self._abort_wait_task.cancel()
+                self._abort_wait_task = None
             self.view_sequences.store(
                 ViewSequence(view_active=False, proposal_seq=self.proposal_sequence)
             )
@@ -718,10 +726,15 @@ class WindowedView:
                 raise ViewAborted()
             for _, finalize in staged:
                 finalize()
+        # wave-batched delivery: a commit burst (one network flush carrying
+        # the whole window's commits) turns several consecutive slots READY
+        # at once — deliver the entire in-order run in THIS pass instead of
+        # paying one full _advance rescan per decision
         low = self.slots.get(self.proposal_sequence)
-        if low is not None and low.phase == READY:
+        while low is not None and low.phase == READY:
             await self._deliver(low)
             progressed = True
+            low = self.slots.get(self.proposal_sequence)
         self.phase = self._lowest_phase()
         if self.metrics:
             self.metrics.phase.set(self.phase)
@@ -1111,16 +1124,14 @@ class WindowedView:
             self.decider.decide(slot.proposal, signatures, slot.requests),
             name=f"wview-decide-{self.self_id}-{slot.seq}", logger=self.logger,
         )
-        abort_wait = create_logged_task(
-            self._abort_event.wait(),
-            name=f"wview-abortwait-{self.self_id}-{slot.seq}", logger=self.logger,
-        )
-        try:
-            await asyncio.wait(
-                {decide, abort_wait}, return_when=asyncio.FIRST_COMPLETED
+        if self._abort_wait_task is None or self._abort_wait_task.done():
+            self._abort_wait_task = create_logged_task(
+                self._abort_event.wait(),
+                name=f"wview-abortwait-{self.self_id}", logger=self.logger,
             )
-        finally:
-            abort_wait.cancel()
+        await asyncio.wait(
+            {decide, self._abort_wait_task}, return_when=asyncio.FIRST_COMPLETED
+        )
         if not decide.done():
             # abandoned rendezvous: create_logged_task's observer retrieves
             # (and loudly logs) any eventual failure of the orphaned decide

@@ -4,8 +4,11 @@ Re-design of /root/reference/internal/bft/requestpool.go:52-567.  The
 reference uses a linked list + existence map + weighted semaphore + one
 ``time.AfterFunc`` goroutine per request; here the FIFO and existence map
 collapse into one ordered dict, the semaphore into a waiter queue of
-futures, and every timer goes through the shared tick-driven
-:class:`~smartbft_tpu.utils.clock.Scheduler` so tests are deterministic.
+futures, and the per-request timers into a lazy timer wheel (per-stage
+FIFO deques + ONE armed timer on the shared tick-driven
+:class:`~smartbft_tpu.utils.clock.Scheduler`) so tests are deterministic
+and the commit path pays no schedule/cancel pair for timers that never
+fire — which at open-loop rates is nearly all of them.
 
 Timeout chain per request (requestpool.go:493-567):
   forward timeout  -> on_request_timeout  (forward request to leader)
@@ -131,13 +134,24 @@ class PoolOptions:
 FORWARD_TIMEOUT_FLOOR = 0.01
 
 
-class _Item:
-    __slots__ = ("request", "timer", "addition_time")
+# timer-wheel stages: which leg of the timeout chain an item's armed
+# queue entry belongs to (see Pool._wheel_fire)
+_STAGE_IDLE = -1
+_STAGE_FWD = 0
+_STAGE_COMPLAIN = 1
+_STAGE_AUTOREMOVE = 2
+_STAGE_FLIP = 3
 
-    def __init__(self, request: bytes, timer: Optional[TaskHandle], addition_time: float):
+
+class _Item:
+    __slots__ = ("request", "addition_time", "deadline", "stage", "gen")
+
+    def __init__(self, request: bytes, addition_time: float):
         self.request = request
-        self.timer = timer
         self.addition_time = addition_time
+        self.deadline = 0.0
+        self.stage = _STAGE_IDLE
+        self.gen = 0
 
 
 def remove_delivered_requests(pool, infos, logger) -> None:
@@ -191,6 +205,13 @@ class Pool:
         self._recorder = recorder if recorder is not None else NOP_RECORDER
 
         self._items: "OrderedDict[RequestInfo, _Item]" = OrderedDict()
+        # lazy timer wheel state: one FIFO deque of (deadline, info, gen)
+        # per chain stage, and a single armed scheduler timer at the
+        # earliest deadline.  See the "timers" section below.
+        self._timer_qs: tuple = (deque(), deque(), deque(), deque())
+        self._wheel_handle: Optional[TaskHandle] = None
+        self._wheel_deadline = float("inf")
+        self._gen = 0  # pool-wide monotonic arm counter (stale detection)
         self._size_bytes = 0
         self._closed = False
         self._stopped = False
@@ -223,6 +244,13 @@ class Pool:
         self._drain_anchor = scheduler.now()
         self._drain_accum = 0
         self._drain_rate = 0.0  # requests/sec, EWMA over DRAIN_WINDOW spans
+        # admission-side twin of the drain estimate: how fast requests are
+        # ARRIVING (admitted submits/sec).  The arrival-driven BatchBuilder
+        # reads this to predict whether the in-formation wave can fill
+        # before its deadline (README "Arrival-driven proposing").
+        self._arrival_anchor = scheduler.now()
+        self._arrival_accum = 0
+        self._arrival_rate = 0.0  # requests/sec, EWMA over ARRIVAL_WINDOW spans
 
     # ------------------------------------------------------------------ submit
 
@@ -365,13 +393,10 @@ class Pool:
                 self._release_space()
                 raise
 
-        timer = self._scheduler.schedule(
-            self._forward_timeout(), lambda: self._on_request_to(request, info)
-        )
-        if self._stopped:
-            timer.cancel()
-            timer = None
-        self._items[info] = _Item(request, timer, self._scheduler.now())
+        item = _Item(request, self._scheduler.now())
+        self._items[info] = item
+        if not self._stopped:
+            self._arm(info, item, _STAGE_FWD, self._forward_timeout())
         self._size_bytes += len(request)
         if rec.enabled:
             # dur = time spent parked on space (0 for an immediate add)
@@ -384,6 +409,7 @@ class Pool:
         # the fairness rule parks fresh submitters behind existing waiters
         # even when a slot is free; hand any remaining capacity to them now
         self._release_space()
+        self._note_arrival()
         self._on_submitted()
 
     def _check_dup(self, info: RequestInfo) -> None:
@@ -427,6 +453,7 @@ class Pool:
             "shed_timeout": self.shed_timeout,
             "flip_drains": self.flip_drains,
             "drain_rate": round(self._drain_rate, 3),
+            "arrival_rate": round(self.arrival_rate(), 3),
         }
 
     # -- drain-rate estimate (the retry-after hint's input) ----------------
@@ -450,6 +477,42 @@ class Pool:
                 else 0.5 * self._drain_rate + 0.5 * inst
             self._drain_anchor = now
             self._drain_accum = 0
+
+    #: shorter span than DRAIN_WINDOW: the proposer's fill prediction must
+    #: track offered-rate swings within a couple of batch intervals, while
+    #: the drain estimate only feeds a coarse retry hint
+    ARRIVAL_WINDOW = 0.25
+
+    def _note_arrival(self) -> None:
+        """Fold one admitted submit into the arrival-rate EWMA (the
+        _note_drained idiom pointed at the front door)."""
+        self._arrival_accum += 1
+        now = self._scheduler.now()
+        dt = now - self._arrival_anchor
+        if dt >= self.ARRIVAL_WINDOW:
+            inst = self._arrival_accum / dt
+            self._arrival_rate = inst if self._arrival_rate <= 0.0 \
+                else 0.5 * self._arrival_rate + 0.5 * inst
+            self._arrival_anchor = now
+            self._arrival_accum = 0
+
+    def arrival_rate(self) -> float:
+        """Admitted submits/sec.  While submits keep folding window edges
+        this is the EWMA; once the live window overruns ARRIVAL_WINDOW
+        without a fold (arrivals too sparse to trigger one) the partial
+        window IS the freshest truth, so return it directly — otherwise a
+        stale busy-era EWMA would keep predicting "the wave will fill,
+        keep waiting" long after traffic stopped."""
+        now = self._scheduler.now()
+        dt = now - self._arrival_anchor
+        if dt >= self.ARRIVAL_WINDOW:
+            return self._arrival_accum / dt
+        return self._arrival_rate
+
+    def available_count(self) -> int:
+        """Pooled requests not reserved in-flight — exactly the population
+        next_requests' check-mode fast path counts."""
+        return len(self._items) - len(self._in_flight)
 
     def retry_after_hint(self) -> float:
         """Seconds until the pool plausibly drains back below the
@@ -566,8 +629,7 @@ class Pool:
                 missing += 1
                 continue
             removed += 1
-            if item.timer is not None:
-                item.timer.cancel()
+            # no timer to cancel: the wheel entry goes stale with the item
             self._size_bytes -= len(item.request)
             self._move_to_del(info)
             if self._metrics:
@@ -599,8 +661,6 @@ class Pool:
         if item is None:
             self._move_to_del(info)
             raise PoolError(f"request {info} is not in the pool at remove time")
-        if item.timer is not None:
-            item.timer.cancel()
         self._size_bytes -= len(item.request)
         self._move_to_del(info)
         if self._metrics:
@@ -641,33 +701,119 @@ class Pool:
                 capacity -= 1
 
     # ------------------------------------------------------------------ timers
+    #
+    # Lazy timer wheel (round 18).  The reference arms one timer per
+    # request per chain stage; at open-loop rates the schedule/cancel
+    # pairs for timers that never fire (requests commit long before
+    # their forward timeout) were a top profile line of the whole
+    # cluster.  Here an armed item carries (deadline, stage, gen) and is
+    # appended to a per-stage FIFO deque; ONE scheduler timer is armed
+    # at the earliest outstanding deadline.  Removal just drops the item
+    # — its queue entry goes stale (item gone, or gen mismatch after a
+    # re-arm) and is skipped when the wheel next fires, so the commit
+    # path pays a deque append on submit and nothing on removal.
+    # Per-stage queues are near-monotone (uniform timeouts mean FIFO
+    # order == deadline order); an adaptive forward_timeout_fn can
+    # invert entries, which only DELAYS an interior entry until the
+    # queue head's deadline — bounded by the derivation swing, harmless
+    # for what is a liveness nudge backed by leader-side dedup.
 
-    def _on_request_to(self, request: bytes, info: RequestInfo) -> None:
-        item = self._items.get(info)
-        if item is None:
-            return
+    def _arm(self, info: RequestInfo, item: _Item, stage: int,
+             delay: float) -> None:
+        self._gen += 1
+        item.gen = self._gen
+        item.stage = stage
+        item.deadline = self._scheduler.now() + delay
+        self._timer_qs[stage].append((item.deadline, info, item.gen))
+        if item.deadline < self._wheel_deadline:
+            self._arm_wheel(item.deadline)
+
+    def _arm_wheel(self, deadline: float) -> None:
+        if self._wheel_handle is not None:
+            self._wheel_handle.cancel()
+        self._wheel_deadline = deadline
+        self._wheel_handle = self._scheduler.schedule(
+            max(deadline - self._scheduler.now(), 0.0), self._wheel_fire
+        )
+
+    def _cancel_wheel(self) -> None:
+        if self._wheel_handle is not None:
+            self._wheel_handle.cancel()
+            self._wheel_handle = None
+        self._wheel_deadline = float("inf")
+
+    def _wheel_fire(self) -> None:
+        self._wheel_handle = None
+        self._wheel_deadline = float("inf")
+        now = self._scheduler.now()
+        for stage, q in enumerate(self._timer_qs):
+            while q:
+                deadline, info, gen = q[0]
+                item = self._items.get(info)
+                if item is None or item.gen != gen:
+                    q.popleft()  # stale: removed, or re-armed elsewhere
+                    continue
+                if deadline > now:
+                    break
+                q.popleft()
+                # a dispatch handler may stop/close the pool mid-fire
+                # (complain -> view change); due entries behind it are
+                # dropped exactly as stop_timers would have cancelled them
+                if self._closed or self._stopped:
+                    continue
+                self._dispatch(stage, info, item)
         if self._closed or self._stopped:
             return
-        item.timer = self._scheduler.schedule(
-            self._opts.complain_timeout,
-            lambda: self._on_leader_fwd_request_to(request, info),
-        )
-        if self._metrics:
-            self._metrics.count_of_leader_forward_requests.add(1)
-        self._th.on_request_timeout(request, info)
+        # re-arm at the earliest still-armed entry (stale prefixes were
+        # drained above; a dispatch may have appended fresh entries)
+        nxt = float("inf")
+        for q in self._timer_qs:
+            while q:
+                deadline, info, gen = q[0]
+                item = self._items.get(info)
+                if item is None or item.gen != gen:
+                    q.popleft()
+                    continue
+                if deadline < nxt:
+                    nxt = deadline
+                break
+        if nxt < float("inf"):
+            self._arm_wheel(nxt)
 
-    def _on_leader_fwd_request_to(self, request: bytes, info: RequestInfo) -> None:
-        item = self._items.get(info)
-        if item is None:
-            return
-        if self._closed or self._stopped:
-            return
-        item.timer = self._scheduler.schedule(
-            self._opts.auto_remove_timeout, lambda: self._on_auto_remove_to(info)
-        )
-        if self._metrics:
-            self._metrics.count_of_complain_timeout.add(1)
-        self._th.on_leader_fwd_request_timeout(request, info)
+    def _dispatch(self, stage: int, info: RequestInfo, item: _Item) -> None:
+        """Fire one chain leg for one item — the re-arm happens BEFORE the
+        handler runs, matching the reference's AfterFunc ordering."""
+        request = item.request
+        if stage == _STAGE_FWD:
+            self._arm(info, item, _STAGE_COMPLAIN, self._opts.complain_timeout)
+            if self._metrics:
+                self._metrics.count_of_leader_forward_requests.add(1)
+            self._th.on_request_timeout(request, info)
+        elif stage == _STAGE_COMPLAIN:
+            self._arm(info, item, _STAGE_AUTOREMOVE,
+                      self._opts.auto_remove_timeout)
+            if self._metrics:
+                self._metrics.count_of_complain_timeout.add(1)
+            self._th.on_leader_fwd_request_timeout(request, info)
+        elif stage == _STAGE_AUTOREMOVE:
+            self._on_auto_remove_to(info)
+        else:  # _STAGE_FLIP: the flip-time BONUS forward (round 15).
+            # Push the stalled request to the new leader immediately, then
+            # re-arm the ORDINARY forward->complain chain behind it on its
+            # original schedule.  The early forward is purely additive —
+            # if it lands, leader-side dedup absorbs the ordinary forward
+            # that follows; if it is lost on the wire or refused by a peer
+            # that has not flipped to the new view yet, the unchanged
+            # chain retries it instead of stranding it until the complain
+            # stage.  An accelerated chain was the first design and
+            # livelocked the lossy-network gate both ways: early complains
+            # re-triggered view changes, and a dropped one-shot forward
+            # stalled the drain.
+            remaining = max(
+                self._forward_timeout() - FORWARD_TIMEOUT_FLOOR, 0.0
+            )
+            self._arm(info, item, _STAGE_FWD, remaining)
+            self._th.on_request_timeout(request, info)
 
     def _on_auto_remove_to(self, info: RequestInfo) -> None:
         try:
@@ -693,10 +839,9 @@ class Pool:
         """Freeze all request timers during a view change
         (requestpool.go:456-470)."""
         self._stopped = True
-        for item in self._items.values():
-            if item.timer is not None:
-                item.timer.cancel()
-                item.timer = None
+        for q in self._timer_qs:
+            q.clear()
+        self._cancel_wheel()
         self._log.debugf("Stopped all timers: size=%d", len(self._items))
 
     def restart_timers(self, *, flip: bool = False) -> None:
@@ -713,49 +858,19 @@ class Pool:
         any duplicate this forwards; requests past the limit keep the
         ordinary chain."""
         self._stopped = False
+        for q in self._timer_qs:
+            q.clear()  # every item is re-armed fresh below
+        self._cancel_wheel()
         fwd = self._forward_timeout()
         fast = self._opts.flip_drain_limit if flip else 0
         for k, (info, item) in enumerate(self._items.items()):
-            if item.timer is not None:
-                item.timer.cancel()
-            req = item.request
             if k < fast:
-                item.timer = self._scheduler.schedule(
-                    FORWARD_TIMEOUT_FLOOR,
-                    (lambda r, i: lambda: self._on_flip_forward(r, i))(req, info),
-                )
+                self._arm(info, item, _STAGE_FLIP, FORWARD_TIMEOUT_FLOOR)
             else:
-                item.timer = self._scheduler.schedule(
-                    fwd,
-                    (lambda r, i: lambda: self._on_request_to(r, i))(req, info),
-                )
+                self._arm(info, item, _STAGE_FWD, fwd)
         if fast and self._items:
             self.flip_drains += min(fast, len(self._items))
         self._log.debugf("Restarted all timers: size=%d", len(self._items))
-
-    def _on_flip_forward(self, request: bytes, info: RequestInfo) -> None:
-        """The flip-time BONUS forward: push a stalled request to the new
-        leader immediately, then re-arm the ORDINARY forward→complain
-        chain behind it on its original schedule.  The early forward is
-        purely additive — if it lands, leader-side dedup absorbs the
-        ordinary forward that follows; if it is lost on the wire or
-        refused by a peer that has not flipped to the new view yet (a
-        real race: this restart runs the moment THIS node completes the
-        view change, which can be ahead of its peers), the unchanged
-        chain retries it instead of stranding it until the complain
-        stage.  An accelerated chain was the first design and livelocked
-        the lossy-network gate both ways: early complains re-triggered
-        view changes, and a dropped one-shot forward stalled the drain."""
-        item = self._items.get(info)
-        if item is None or self._closed or self._stopped:
-            return
-        remaining = max(
-            self._forward_timeout() - FORWARD_TIMEOUT_FLOOR, 0.0
-        )
-        item.timer = self._scheduler.schedule(
-            remaining, lambda: self._on_request_to(request, info)
-        )
-        self._th.on_request_timeout(request, info)
 
     def _forward_timeout(self) -> float:
         """The effective forward timeout for the next timer arm: the
@@ -777,10 +892,11 @@ class Pool:
 
     def close(self) -> None:
         self._closed = True
+        self._cancel_wheel()
+        for q in self._timer_qs:
+            q.clear()
         for info in list(self._items.keys()):
             item = self._items.pop(info)
-            if item.timer is not None:
-                item.timer.cancel()
             self._size_bytes -= len(item.request)
             self._move_to_del(info)
         for fut in self._space_waiters:

@@ -261,6 +261,15 @@ class InFlightData:
         #: caches (the ViewChanger's hot-standby ViewData keys on it
         #: together with Checkpoint.version, ISSUE 15)
         self.version = 0
+        #: single-subscriber mutation hook (the ViewChanger's event-driven
+        #: hot-standby prebuild)
+        self.on_mutate = None
+
+    def _bump(self) -> None:
+        self.version += 1
+        cb = self.on_mutate
+        if cb is not None:
+            cb()
 
     def in_flight_proposal(self):
         if self._window:
@@ -275,7 +284,7 @@ class InFlightData:
     def store_proposal(self, proposal) -> None:
         self._proposal = proposal
         self._prepared = False
-        self.version += 1
+        self._bump()
 
     def store_prepares(self, view: int, seq: int) -> None:
         if self._proposal is None:
@@ -286,19 +295,19 @@ class InFlightData:
                 return
             raise RuntimeError("stored prepares but proposal is not initialized")
         self._prepared = True
-        self.version += 1
+        self._bump()
 
     def clear(self) -> None:
         self._proposal = None
         self._prepared = False
         self._window.clear()
-        self.version += 1
+        self._bump()
 
     # -- windowed API (pipeline_depth > 1) ---------------------------------
 
     def store_proposal_at(self, seq: int, proposal) -> None:
         self._window[seq] = [proposal, False]
-        self.version += 1
+        self._bump()
 
     def store_prepares_at(self, seq: int) -> None:
         slot = self._window.get(seq)
@@ -307,7 +316,7 @@ class InFlightData:
                 f"stored prepares at seq {seq} but its proposal is not initialized"
             )
         slot[1] = True
-        self.version += 1
+        self._bump()
 
     def clear_below(self, seq: int) -> None:
         """Drop window rungs for delivered sequences (< ``seq``).
@@ -320,7 +329,7 @@ class InFlightData:
         for s in stale:
             del self._window[s]
         if stale:
-            self.version += 1
+            self._bump()
         if not self._window and self._proposal is not None \
                 and getattr(self._proposal, "metadata", b""):
             from ..codec import decode
@@ -330,7 +339,7 @@ class InFlightData:
             if md.latest_sequence < seq:
                 self._proposal = None
                 self._prepared = False
-                self.version += 1
+                self._bump()
 
     def prune_synced(self, synced_seq: int) -> None:
         """A sync advanced the checkpoint to ``synced_seq``: drop what it

@@ -298,6 +298,13 @@ class ViewChanger:
     #: tests tighten it
     STRAGGLER_WAIT: float = 5.0
 
+    #: scheduler-seconds of state quiet before a mutation-driven standby
+    #: rebuild fires.  Short enough to land well inside the detection
+    #: floor (a complaint is at least DETECTION_FLOOR=50ms of silence
+    #: away), long enough that a window of back-to-back commits costs one
+    #: timer reschedule per mutation instead of one ViewData sign each
+    STANDBY_REBUILD_DEBOUNCE: float = 0.02
+
     def __init__(
         self,
         *,
@@ -322,6 +329,7 @@ class ViewChanger:
         metrics_view: Optional[ViewMetrics] = None,
         vc_phases=None,
         recorder=None,
+        scheduler=None,
     ):
         self.self_id = self_id
         self.n = n
@@ -404,6 +412,20 @@ class ViewChanger:
         self._standby_key: Optional[tuple] = None
         self.standby_prebuilds = 0
         self.standby_hits = 0
+        # Event-driven prebuild (ISSUE 15 residual b): checkpoint/ladder
+        # mutations notify _note_state_mutation, which debounces on the
+        # shared scheduler (mutation bursts — every commit bumps both
+        # versions several times — collapse to ONE rebuild, fired only
+        # once the state goes quiet) and enqueues a "standby" event.  The
+        # tick-time prebuild stays as the no-scheduler fallback and
+        # belt-and-braces refresh; the event path is what closes the
+        # cache-hit gap, because the moment mutations STOP (leader dead,
+        # cluster idle) is exactly when the next complaint finds the
+        # cache key still matching.
+        self.scheduler = scheduler
+        self._standby_timer = None
+        self._standby_event_queued = False
+        self.standby_event_rebuilds = 0
 
         self._in_flight_view: Optional[View] = None
         self._in_flight_decide: Optional[asyncio.Future] = None
@@ -439,6 +461,13 @@ class ViewChanger:
             self._events.get_nowait()
         self._queued_msgs = 0
         self._pending_changes = 0
+        self._standby_event_queued = False
+        # event-driven standby prebuild: subscribe to checkpoint/ladder
+        # mutations (single-subscriber seam; this ViewChanger owns it)
+        if self.checkpoint is not None:
+            self.checkpoint.on_mutate = self._note_state_mutation
+        if self.in_flight is not None:
+            self.in_flight.on_mutate = self._note_state_mutation
         self._task = create_logged_task(
             self._run(frozenset(self._prior_tasks)),
             name=f"viewchanger-{self.self_id}", logger=self.logger,
@@ -453,6 +482,9 @@ class ViewChanger:
     def close(self) -> None:
         if not self._stopped:
             self._stopped = True
+            if self._standby_timer is not None:
+                self._standby_timer.cancel()
+                self._standby_timer = None
             if self.controller_started_event is not None:
                 self.controller_started_event.set()  # release the start barrier
             self._space_event.set()  # release blocked async senders
@@ -588,6 +620,12 @@ class ViewChanger:
                     self._check_if_resend_view_change(evt[1])
                     self._check_if_timeout(evt[1])
                     self._maybe_prebuild_standby()
+                elif kind == "standby":
+                    self._standby_event_queued = False
+                    before = self.standby_prebuilds
+                    self._maybe_prebuild_standby()
+                    if self.standby_prebuilds != before:
+                        self.standby_event_rebuilds += 1
                 elif kind == "inform":
                     self._inform_new_view(evt[1])
                 elif kind == "restore":
@@ -609,6 +647,32 @@ class ViewChanger:
         return blacklist_of(prop)
 
     # -- hot-standby ViewData (ISSUE 15) -----------------------------------
+
+    def _note_state_mutation(self) -> None:
+        """Checkpoint / in-flight ladder mutation hook (loop-synchronous:
+        every mutation site runs on the shared event loop).  Debounced —
+        the rebuild fires only once the state stays quiet for
+        STANDBY_REBUILD_DEBOUNCE, so a burst of per-commit version bumps
+        costs timer reschedules, not ViewData signatures."""
+        if self._stopped:
+            return
+        if self.scheduler is not None:
+            if self._standby_timer is not None:
+                self._standby_timer.cancel()
+            self._standby_timer = self.scheduler.schedule(
+                self.STANDBY_REBUILD_DEBOUNCE, self._fire_standby_rebuild
+            )
+        else:
+            # no scheduler wired (bare unit-test construction): rebuild
+            # eagerly on the next loop turn
+            self._fire_standby_rebuild()
+
+    def _fire_standby_rebuild(self) -> None:
+        self._standby_timer = None
+        if self._stopped or self._standby_event_queued:
+            return
+        self._standby_event_queued = True  # 1-slot: coalesce until processed
+        self._events.put_nowait(("standby",))
 
     def _standby_state_key(self, next_view: int) -> tuple:
         """Everything a ViewData is built from, as cheap version counters:

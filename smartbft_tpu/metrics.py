@@ -864,6 +864,34 @@ class LogScaleHistogram:
         if other.min_seen < self.min_seen:
             self.min_seen = other.min_seen
 
+    def export_state(self) -> dict:
+        """JSON-able FULL state (geometry + raw buckets) — the wire shape
+        the per-shard affinity-sweep workers ship to the parent so the
+        merged percentiles come from :meth:`merge_from`'s exact bucket
+        sum, never a percentile-of-percentiles."""
+        return {
+            "low": self.low,
+            "growth": self.growth,
+            "buckets": list(self.buckets),
+            "count": self.count,
+            "total": self.total,
+            "max_seen": self.max_seen,
+            "min_seen": self.min_seen if self.count else None,
+        }
+
+    @classmethod
+    def from_state(cls, state: dict) -> "LogScaleHistogram":
+        """Rebuild a histogram from :meth:`export_state` output."""
+        h = cls(low=state["low"], growth=state["growth"],
+                nbuckets=len(state["buckets"]))
+        h.buckets = [int(n) for n in state["buckets"]]
+        h.count = int(state["count"])
+        h.total = float(state["total"])
+        h.max_seen = float(state["max_seen"])
+        if state.get("min_seen") is not None:
+            h.min_seen = float(state["min_seen"])
+        return h
+
     def nonzero_buckets(self) -> dict:
         """Sparse bucket dump for the bench row's ``histogram`` block:
         {upper_edge_ms: count} for every non-empty bucket."""
@@ -964,6 +992,30 @@ class CommitLatencyTracker:
         hist.observe(dt)
         if self._current_phase is not None:
             self._current_phase["hist"].observe(dt)
+
+    def on_committed_batch(self, entries) -> None:
+        """Resolve a whole committed wave of
+        :class:`~smartbft_tpu.shard.mux.CommittedEntry` in one pass: one
+        clock read and one per-shard histogram lookup per wave instead of
+        per request — the egress half of the batched deliver fan-out."""
+        now = None
+        for e in entries:
+            hist = None  # resolved lazily: entries of pure control traffic
+            for key in e.request_ids:  # must not materialize a histogram
+                t0 = self._pending.pop(key, None)
+                if t0 is None:
+                    continue
+                if now is None:
+                    now = self._clock()
+                if hist is None:
+                    hist = self.per_shard.get(e.shard_id)
+                    if hist is None:
+                        hist = self.per_shard[e.shard_id] = LogScaleHistogram()
+                dt = max(now - t0, 0.0)
+                self.aggregate.observe(dt)
+                hist.observe(dt)
+                if self._current_phase is not None:
+                    self._current_phase["hist"].observe(dt)
 
     # -- phases ------------------------------------------------------------
 

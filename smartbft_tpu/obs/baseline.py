@@ -50,8 +50,10 @@ __all__ = [
     "main",
 ]
 
-#: units where a SMALLER value is better (latency-shaped)
-LOWER_IS_BETTER_UNITS = {"ms", "us", "us/sig", "logical_ms", "s"}
+#: units where a SMALLER value is better (latency-shaped, plus critpath
+#: segment shares — a segment REGAINING commit-path share is the round-18
+#: regression the commit-path guard rows exist to catch)
+LOWER_IS_BETTER_UNITS = {"ms", "us", "us/sig", "logical_ms", "s", "share"}
 
 #: host-weather fields carried into the baseline verbatim — the context a
 #: future reader needs to judge whether two rounds are comparable at all

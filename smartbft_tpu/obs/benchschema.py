@@ -185,6 +185,31 @@ ROW_SCHEMAS: dict = {
         "optional": {"count": _NUM, "offered_per_sec": _NUM,
                      "shards": _NUM, "timer": _DICT},
     },
+    # bench.py commitpath_guard_rows (ISSUE 16) — the open-loop
+    # saturation knee (highest swept offered load meeting the goodput +
+    # shed SLO), the longitudinal raw-speed pin
+    "open_loop_knee_tx_per_sec": {
+        "required": {"metric": _STR, "value": _NUM, "unit": _STR},
+        "optional": {"goodput_per_sec": _NUM, "p99_ms": _NUM,
+                     "beyond_sweep": (bool,)},
+    },
+    # bench.py commitpath_guard_rows (ISSUE 16) — HEALTHY-phase critical
+    # path segment shares (unit "share", lower is better): the two
+    # segments the round-18 commit-path work cut
+    "critpath_*": {
+        "required": {"metric": _STR, "value": _NUM, "unit": _STR},
+        "optional": {"phase": _STR, "requests": _NUM,
+                     "dominant_segment": _STR, "sums_consistent": (bool,),
+                     "offered_per_sec": _NUM},
+    },
+    # bench.py commitpath_guard_rows (ISSUE 16) — per-S knee of the
+    # process-per-shard affinity sweep
+    "open_loop_affinity_s*": {
+        "required": {"metric": _STR, "value": _NUM, "unit": _STR,
+                     "shards": _NUM},
+        "optional": {"loop_affinity": _STR, "goodput_per_sec": _NUM,
+                     "p99_ms": _NUM, "beyond_sweep": (bool,)},
+    },
     # obs.baseline.tiny_logical_row — the tier-1 regression-gate row
     # (value = mean logical commit latency; percentiles ride in "latency")
     "tiny_logical_commit_ms": {

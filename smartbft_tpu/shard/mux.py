@@ -97,13 +97,20 @@ class DeliveryMux:
     """
 
     def __init__(self, shard_ids: Sequence[int],
-                 on_deliver: Optional[Callable[[CommittedEntry], None]] = None):
+                 on_deliver: Optional[Callable[[CommittedEntry], None]] = None,
+                 on_deliver_batch: Optional[
+                     Callable[[list[CommittedEntry]], None]] = None):
         self._cursors: dict[int, _ShardCursor] = {
             int(s): _ShardCursor() for s in shard_ids
         }
         self.combined: list[CommittedEntry] = []
         self._pruned = 0  # entries dropped by prune(); indexes stay absolute
         self._on_deliver = on_deliver
+        # egress twin of the view's ingest_batch: when set, a whole wave of
+        # entries reaches the application in ONE call (stream order inside
+        # the list) instead of one callback dispatch per decision;
+        # on_deliver is then never called
+        self._on_deliver_batch = on_deliver_batch
         self._epoch = 0
         #: request ids delivered before the current epoch's flip that must
         #: never re-deliver after it (explicit cross-epoch dedup).  REBUILT
@@ -127,60 +134,96 @@ class DeliveryMux:
 
     def ingest(self, shard_id: int, decision, *, seq: int,
                request_ids: Iterable = ()) -> CommittedEntry:
+        return self.ingest_batch(
+            shard_id, [(seq, request_ids, decision)]
+        )[0]
+
+    def ingest_batch(
+        self, shard_id: int, decisions: Sequence[tuple]
+    ) -> list[CommittedEntry]:
+        """Wave-batched feed: ``decisions`` is a consecutive run of
+        ``(seq, request_ids, decision)`` for ONE shard — the shape a
+        committed wave leaves the pipelined window in.  The cursor is
+        resolved once, every invariant (gapless, exactly-once, hand-off
+        dedup) is enforced across the whole run in one pass, and the
+        application sees ONE ``on_deliver_batch`` call per wave (falling
+        back to per-entry ``on_deliver``, in stream order).  A violation
+        raises AFTER the validated prefix is dispatched — callbacks track
+        the stream, so everything that entered ``combined`` reaches the
+        application exactly once.  ``ingest`` is the single-decision
+        special case."""
         cur = self._cursors.get(shard_id)
         if cur is None:
             raise ShardStreamViolation(
                 f"decision from unknown shard {shard_id}"
             )
-        if cur.retired:
-            raise ShardStreamViolation(
-                f"shard {shard_id} is retired (epoch {self._epoch}) but "
-                f"delivered seq {seq} — it committed past its drain barrier"
+        entries: list[CommittedEntry] = []
+        try:
+            self._ingest_run(shard_id, cur, decisions, entries)
+        finally:
+            # callbacks track the STREAM, not the call: every entry that
+            # entered `combined` is dispatched exactly once even when a
+            # later decision in the run violates (the violation still
+            # raises after the validated prefix is delivered)
+            if entries:
+                if self._on_deliver_batch is not None:
+                    self._on_deliver_batch(entries)
+                elif self._on_deliver is not None:
+                    for entry in entries:
+                        self._on_deliver(entry)
+        return entries
+
+    def _ingest_run(self, shard_id: int, cur: _ShardCursor,
+                    decisions: Sequence[tuple],
+                    entries: list) -> None:
+        for seq, request_ids, decision in decisions:
+            if cur.retired:
+                raise ShardStreamViolation(
+                    f"shard {shard_id} is retired (epoch {self._epoch}) but "
+                    f"delivered seq {seq} — it committed past its drain barrier"
+                )
+            if seq != cur.next_seq:
+                raise ShardStreamViolation(
+                    f"shard {shard_id} stream gap: got seq {seq}, "
+                    f"expected {cur.next_seq}"
+                )
+            ids = tuple(str(r) for r in request_ids)
+            # duplicates against everything delivered before AND within this
+            # very decision — both violate per-shard exactly-once — and, across
+            # an epoch flip, against the hand-off snapshot of every shard's
+            # unpruned history (a moved client's request must not commit twice)
+            seen_here: set = set()
+            dupes = []
+            handoff_dupes = []
+            for r in ids:
+                if r in cur.seen_requests or r in seen_here:
+                    dupes.append(r)
+                elif r in self._handoff_seen:
+                    handoff_dupes.append(r)
+                seen_here.add(r)
+            if dupes:
+                raise ShardStreamViolation(
+                    f"shard {shard_id} delivered duplicates at seq {seq}: "
+                    f"{sorted(set(dupes))}"
+                )
+            if handoff_dupes:
+                raise ShardStreamViolation(
+                    f"shard {shard_id} re-delivered handed-off requests at seq "
+                    f"{seq} (already committed before the epoch {self._epoch} "
+                    f"flip): {sorted(set(handoff_dupes))}"
+                )
+            cur.seen_requests.update(ids)
+            cur.next_seq += 1
+            cur.delivered += 1
+            cur.requests += len(ids)
+            entry = CommittedEntry(
+                shard_id=shard_id, seq=seq,
+                index=self._pruned + len(self.combined),
+                decision=decision, request_ids=ids,
+                epoch=self._epoch,
             )
-        if seq != cur.next_seq:
-            raise ShardStreamViolation(
-                f"shard {shard_id} stream gap: got seq {seq}, "
-                f"expected {cur.next_seq}"
-            )
-        ids = tuple(str(r) for r in request_ids)
-        # duplicates against everything delivered before AND within this
-        # very decision — both violate per-shard exactly-once — and, across
-        # an epoch flip, against the hand-off snapshot of every shard's
-        # unpruned history (a moved client's request must not commit twice)
-        seen_here: set = set()
-        dupes = []
-        handoff_dupes = []
-        for r in ids:
-            if r in cur.seen_requests or r in seen_here:
-                dupes.append(r)
-            elif r in self._handoff_seen:
-                handoff_dupes.append(r)
-            seen_here.add(r)
-        if dupes:
-            raise ShardStreamViolation(
-                f"shard {shard_id} delivered duplicates at seq {seq}: "
-                f"{sorted(set(dupes))}"
-            )
-        if handoff_dupes:
-            raise ShardStreamViolation(
-                f"shard {shard_id} re-delivered handed-off requests at seq "
-                f"{seq} (already committed before the epoch {self._epoch} "
-                f"flip): {sorted(set(handoff_dupes))}"
-            )
-        cur.seen_requests.update(ids)
-        cur.next_seq += 1
-        cur.delivered += 1
-        cur.requests += len(ids)
-        entry = CommittedEntry(
-            shard_id=shard_id, seq=seq,
-            index=self._pruned + len(self.combined),
-            decision=decision, request_ids=ids,
-            epoch=self._epoch,
-        )
-        self.combined.append(entry)
-        if self._on_deliver is not None:
-            self._on_deliver(entry)
-        return entry
+            self.combined.append(entry)
+            entries.append(entry)
 
     # -- epochs ------------------------------------------------------------
 

@@ -185,6 +185,7 @@ class ShardSet:
                  coalescer=None, *, journal: Optional[EpochJournal] = None,
                  drain_deadline: float = 30.0, retention: int = 4096,
                  on_deliver: Optional[Callable] = None,
+                 on_deliver_batch: Optional[Callable] = None,
                  clock: Optional[Callable[[], float]] = None,
                  recorder=None):
         """``shards``: shard handles, one per group; their ``shard_id``
@@ -220,7 +221,8 @@ class ShardSet:
         self.journal = journal
         self.drain_deadline = drain_deadline
         self.retention = retention
-        self.mux = DeliveryMux(sorted(self.shards), on_deliver=on_deliver)
+        self.mux = DeliveryMux(sorted(self.shards), on_deliver=on_deliver,
+                               on_deliver_batch=on_deliver_batch)
         #: per-shard chain cursor for poll_committed
         self._chain_pos: dict[int, int] = {s: 0 for s in self.shards}
         #: shards retired by scale-in flips (stopped, history in the mux)
@@ -527,14 +529,13 @@ class ShardSet:
         for sid in sorted(self.shards):
             pos = self._chain_pos[sid]
             fresh = self.shards[sid].poll_committed(pos)
-            for seq, request_ids, decision in fresh:
-                self.mux.ingest(sid, decision, seq=seq,
-                                request_ids=request_ids)
-            self._chain_pos[sid] = pos + len(fresh)
+            if fresh:
+                # wave-batched hand-off: one mux call (and one application
+                # callback) per shard per poll, not one per decision
+                self.mux.ingest_batch(sid, fresh)
+                self._chain_pos[sid] = pos + len(fresh)
         out = self.mux.since(start)
-        for e in out:
-            for rid in e.request_ids:
-                self.latency.on_committed(rid, e.shard_id)
+        self.latency.on_committed_batch(out)
         tr = self._transition
         if tr is not None and len(tr.barriers) < tr.old_s:
             marker = barrier_marker(tr.epoch)

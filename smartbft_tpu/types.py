@@ -126,6 +126,9 @@ class Checkpoint:
         #: (e.g. the controller's leader memo, which depends on the
         #: blacklist carried in the checkpoint metadata)
         self.version = 0
+        #: single-subscriber mutation hook (the ViewChanger's event-driven
+        #: hot-standby prebuild); called AFTER the lock is released
+        self.on_mutate = None
 
     def get(self) -> tuple[Proposal, tuple[Signature, ...]]:
         with self._lock:
@@ -136,6 +139,9 @@ class Checkpoint:
             self._proposal = proposal
             self._signatures = tuple(signatures)
             self.version += 1
+        cb = self.on_mutate
+        if cb is not None:
+            cb()
 
 
 def view_metadata_of(p: Proposal) -> ViewMetadata:

@@ -21,6 +21,14 @@ class BoundedMemo(Generic[K, V]):
         self.bound = bound
         self._map: dict[K, V] = {}
 
+    def get(self, key: K) -> Optional[V]:
+        return self._map.get(key)
+
+    def put(self, key: K, value: V) -> None:
+        if len(self._map) > self.bound:
+            self._map.clear()
+        self._map[key] = value
+
     def get_or(self, key: K, compute: Callable[[], V]) -> V:
         v = self._map.get(key)
         if v is None:

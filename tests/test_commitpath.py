@@ -1,0 +1,467 @@
+"""Commit-path raw speed (ISSUE 16): arrival-driven proposing, batched
+deliver fan-out, idle cadence decay, and the event-driven standby prebuild.
+
+Unit matrix over the new seams — the pool's arrival-rate EWMA (live-window
+decay included), the BatchBuilder's adaptive fill-plausibility gate (early
+partial, plausible wait, deadline bound), the DeliveryMux's wave-batched
+``ingest_batch``/``on_deliver_batch`` contract (one callback per wave,
+validated-prefix dispatch on violation, hand-off dedup across epochs), the
+controller's heartbeat-witnessed commit-interval idle decay and its
+HeartbeatMonitor feed, and the ViewChanger's mutation-driven debounced
+standby rebuild — plus the tier-1 scenarios the acceptance criteria pin:
+exactly-once delivery under the batched fan-out across a forced view change
+and across a mid-stream crash/restart.
+"""
+
+import asyncio
+
+import pytest
+
+from smartbft_tpu.core.batcher import BatchBuilder
+from smartbft_tpu.core.util import InFlightData
+from smartbft_tpu.messages import HeartBeat
+from smartbft_tpu.shard.mux import DeliveryMux, ShardStreamViolation
+from smartbft_tpu.testing.sharded import ShardedCluster, sharded_config
+from smartbft_tpu.testing.app import wait_for
+from smartbft_tpu.types import Checkpoint, Proposal
+from smartbft_tpu.utils.clock import Scheduler
+from smartbft_tpu.utils.logging import RecordingLogger
+
+from tests.test_core_units import make_pool
+from tests.test_controller_units import make_controller
+from tests.test_failover import Handler, make_monitor, observe_leader
+
+
+# ---------------------------------------------------------------- arrival rate
+
+
+def test_pool_arrival_rate_tracks_pacing_and_decays_idle():
+    """The admission-side EWMA reads the offered pace; once arrivals stop,
+    the live (unfolded) window is the freshest truth and the rate honestly
+    decays toward zero instead of repeating the busy-era figure."""
+
+    async def run():
+        s = Scheduler()
+        pool = make_pool(s, queue_size=200)
+        assert pool.arrival_rate() == 0.0  # cold pool: nothing measured
+        for k in range(50):
+            await pool.submit(b"a%d" % k)
+            s.advance_by(0.005)
+        # 50 admits over 0.25s of logical time: ~200/s whichever side of a
+        # window fold the last submit landed on
+        assert pool.arrival_rate() == pytest.approx(200.0, rel=0.1)
+        # idle: the overrun live window divides the same accum by an
+        # ever-growing span — the rate decays as 1/t instead of repeating
+        # the busy-era 200/s
+        s.advance_by(10.0)
+        assert pool.arrival_rate() < 10.0
+        s.advance_by(100.0)
+        assert pool.arrival_rate() < 0.5
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------- adaptive gate
+
+
+def _adaptive_batcher(s, pool, *, max_count=64, timeout=5.0):
+    b = BatchBuilder(
+        pool, s, max_msg_count=max_count, max_size_bytes=10_000,
+        batch_timeout=timeout, adaptive=True,
+    )
+    pool._on_submitted = b.on_submitted
+    return b
+
+
+def test_adaptive_proposes_partial_immediately_when_rate_cannot_fill():
+    """No measured arrival rate + a deficit = the wave cannot plausibly
+    fill: the one pooled request is proposed NOW, not after the cadence."""
+
+    async def run():
+        s = Scheduler()
+        pool = make_pool(s, queue_size=200)
+        b = _adaptive_batcher(s, pool)
+        await pool.submit(b"only")
+        batch = await b.next_batch()  # returns without any timer advance
+        assert batch == [b"only"]
+        assert b.early_proposes == 1
+
+    asyncio.run(run())
+
+
+def test_adaptive_waits_when_fill_is_plausible_then_fills():
+    """A measured 200/s pace makes a 14-request deficit trivially
+    plausible inside the cadence — the builder waits, and the wave goes
+    out FULL (no early propose counted)."""
+
+    async def run():
+        s = Scheduler()
+        pool = make_pool(s, queue_size=200)
+        b = _adaptive_batcher(s, pool, max_count=64)
+        for k in range(50):
+            await pool.submit(b"p%d" % k)
+            s.advance_by(0.005)
+        task = asyncio.ensure_future(b.next_batch())
+        for _ in range(5):
+            await asyncio.sleep(0)
+        assert not task.done()  # fill plausible: no early partial
+        for k in range(14):
+            await pool.submit(b"q%d" % k)
+        batch = await task
+        assert len(batch) == 64
+        assert b.early_proposes == 0
+
+    asyncio.run(run())
+
+
+def test_adaptive_deadline_still_bounds_the_wait():
+    """A plausible-looking fill that never materialises is still cut at
+    the configured cadence — the adaptive gate only ever SHORTENS."""
+
+    async def run():
+        s = Scheduler()
+        pool = make_pool(s, queue_size=200)
+        b = _adaptive_batcher(s, pool, max_count=64, timeout=5.0)
+        for k in range(60):
+            await pool.submit(b"r%d" % k)
+            s.advance_by(0.005)
+        task = asyncio.ensure_future(b.next_batch())
+        for _ in range(5):
+            await asyncio.sleep(0)
+        assert not task.done()
+        s.advance_by(6.0)  # arrivals stop; the deadline timer fires
+        batch = await task
+        assert len(batch) == 60
+        assert b.early_proposes == 0
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------- deliver fan-out
+
+
+def test_mux_batched_wave_dispatches_one_callback_in_stream_order():
+    waves = []
+    mux = DeliveryMux([0], on_deliver_batch=waves.append)
+    d1, d2 = object(), object()
+    entries = mux.ingest_batch(0, [(1, ["c:1"], d1), (2, ["c:2"], d2)])
+    assert len(entries) == 2
+    assert waves == [entries]  # ONE call for the whole wave
+    assert [e.seq for e in waves[0]] == [1, 2]
+    assert [e.request_ids for e in waves[0]] == [("c:1",), ("c:2",)]
+    # the single-decision ingest() is the same path: a wave of one
+    mux.ingest(0, object(), seq=3, request_ids=["c:3"])
+    assert len(waves) == 2 and len(waves[1]) == 1
+    assert mux.height(0) == 3
+
+
+def test_mux_batched_falls_back_to_per_entry_and_skips_empty():
+    got = []
+    mux = DeliveryMux([0], on_deliver=got.append)
+    mux.ingest_batch(0, [(1, ["a"], object()), (2, ["b"], object())])
+    assert [e.seq for e in got] == [1, 2]  # per-entry, stream order
+    assert mux.ingest_batch(0, []) == []
+    assert len(got) == 2  # empty wave: no callback at all
+
+
+def test_mux_violation_dispatches_validated_prefix_then_raises():
+    """Callbacks track the STREAM: everything that entered ``combined``
+    reaches the application exactly once even when a later decision in
+    the same wave violates."""
+    waves = []
+    mux = DeliveryMux([0], on_deliver_batch=waves.append)
+    with pytest.raises(ShardStreamViolation, match="stream gap"):
+        mux.ingest_batch(0, [(1, ["a"], object()), (3, ["b"], object())])
+    # seq 1 was validated before the gap: it is in the stream AND delivered
+    assert mux.height(0) == 1
+    assert len(waves) == 1 and [e.seq for e in waves[0]] == [1]
+    # a violating FIRST decision leaves nothing to dispatch
+    with pytest.raises(ShardStreamViolation, match="delivered duplicates"):
+        mux.ingest_batch(0, [(2, ["x", "x"], object())])
+    assert mux.height(0) == 1 and len(waves) == 1
+
+
+def test_mux_batched_dedup_within_and_across_waves():
+    mux = DeliveryMux([0])
+    mux.ingest_batch(0, [(1, ["k"], object())])
+    with pytest.raises(ShardStreamViolation, match="delivered duplicates"):
+        mux.ingest_batch(0, [(2, ["k"], object())])
+
+
+def test_mux_batched_handoff_dedup_and_retired_cursor():
+    """The cross-epoch hand-off horizon and the retired-cursor freeze both
+    hold on the wave-batched path, with the validated prefix delivered."""
+    waves = []
+    mux = DeliveryMux([0, 1], on_deliver_batch=waves.append)
+    mux.ingest_batch(0, [(1, ["moved"], object())])
+    mux.begin_epoch(1, [0, 1, 2])
+    with pytest.raises(ShardStreamViolation, match="handed-off"):
+        mux.ingest_batch(1, [(1, ["fresh"], object()), (2, ["moved"], object())])
+    assert [e.request_ids for e in waves[-1]] == [("fresh",)]
+    mux.begin_epoch(2, [0, 1], retire=[2])
+    with pytest.raises(ShardStreamViolation, match="retired"):
+        mux.ingest_batch(2, [(1, ["late"], object())])
+
+
+# ---------------------------------------------------------------- idle decay
+
+
+def test_commit_interval_idle_decay_needs_witnessed_silence():
+    """Commit silence relaxes the reported interval ONLY while the leader
+    keeps proving itself alive; unwitnessed silence keeps the tight
+    busy-era EWMA (a possibly-dead leader must be detected fast)."""
+    c = make_controller()
+    assert c.commit_interval_seconds() is None  # nothing measured yet
+    c._commit_gap_ewma = 0.05
+    c._last_commit_t = 100.0
+    # silence with NO sign of life: the busy-era cadence stands
+    assert c.commit_interval_seconds() == 0.05
+    # a heartbeat inside 2x the EWMA: still the EWMA (not yet a lull)
+    c.on_leader_sign_of_life(100.05)
+    assert c.commit_interval_seconds() == 0.05
+    # witnessed 1s lull: the silence span itself is reported
+    c.on_leader_sign_of_life(101.0)
+    assert c.commit_interval_seconds() == 1.0
+    # heartbeats stop: the reported idle FREEZES at the last witness
+    # instead of growing — a leader that died mid-lull must not keep
+    # relaxing the derived complain timer
+    assert c.commit_interval_seconds() == 1.0
+    # a sign of life older than the last commit proves nothing
+    c._last_commit_t = 102.0
+    assert c.commit_interval_seconds() == 0.05
+
+
+def test_heartbeat_receipt_feeds_sign_of_life():
+    """The monitor's heartbeat receipt hands the receive timestamp to the
+    commit-interval owner via the optional handler hook."""
+
+    class WitnessHandler(Handler):
+        def __init__(self):
+            super().__init__()
+            self.alive = []
+
+        def on_leader_sign_of_life(self, t):
+            self.alive.append(t)
+
+    from smartbft_tpu.core.heartbeat import FOLLOWER
+
+    clock = [5.0]
+    h = WitnessHandler()
+    mon = make_monitor(handler=h, now_fn=lambda: clock[0])
+    mon.change_role(FOLLOWER, 0, 1)
+    observe_leader(mon)
+    assert h.alive == [5.0]
+    clock[0] = 7.5
+    mon.process_msg(1, HeartBeat(view=0, seq=2))
+    assert h.alive == [5.0, 7.5]
+    # a handler without the hook is fine (getattr seam): no crash
+    mon2 = make_monitor(now_fn=lambda: clock[0])
+    mon2.change_role(FOLLOWER, 0, 1)
+    observe_leader(mon2)
+
+
+def test_local_pause_is_not_leader_silence():
+    """Local-pause detector: a tick landing far past the learned cadence
+    means THIS process was starved — the span is credited back to the
+    complain base instead of reading as leader silence, while genuine
+    silence at the learned cadence still fires the timeout."""
+    from smartbft_tpu.core.heartbeat import FOLLOWER
+
+    h = Handler()
+    mon = make_monitor(timeout=1.0, handler=h)
+    mon.change_role(FOLLOWER, 0, 1)
+    observe_leader(mon)
+    # warm the cadence expectation: regular 50ms ticks with fresh
+    # heartbeats keep the follower quiet
+    t = 0.05
+    for k in range(12):
+        mon.tick(t)
+        mon.process_msg(1, HeartBeat(view=0, seq=1))
+        t += 0.05
+    assert h.fired == []
+    # a 2s event-loop stall with NO heartbeat during it: without the
+    # discount, delta (2s) would blow past the 1s timer on the first
+    # post-stall tick — the pause must not depose a live leader
+    t += 2.0
+    mon.tick(t)
+    assert mon.local_pauses == 1
+    assert h.fired == []
+    # genuine silence at the learned cadence: regular ticks, no
+    # heartbeats — the timer still fires
+    for _ in range(25):
+        t += 0.05
+        mon.tick(t)
+    assert h.fired == [(0, 1)]
+
+
+# ---------------------------------------------------------------- standby events
+
+
+def _standby_viewchanger(scheduler):
+    from smartbft_tpu.core.viewchanger import ViewChanger
+
+    return ViewChanger(
+        self_id=1, n=4, nodes_list=[1, 2, 3, 4], leader_rotation=False,
+        decisions_per_leader=0, speed_up_view_change=False,
+        logger=RecordingLogger("vc"), signer=None, verifier=None,
+        checkpoint=Checkpoint(), in_flight=InFlightData(), state=None,
+        resend_timeout=1.0, view_change_timeout=10.0, in_msg_q_size=50,
+        scheduler=scheduler,
+    )
+
+
+def test_state_mutations_debounce_into_one_standby_rebuild():
+    """A burst of checkpoint/ladder mutations costs timer reschedules, not
+    rebuilds: exactly ONE standby event fires once the state goes quiet
+    for STANDBY_REBUILD_DEBOUNCE."""
+
+    async def run():
+        s = Scheduler()
+        vc = _standby_viewchanger(s)
+        vc.controller_started_event = asyncio.Event()
+        vc.controller_started_event.set()
+        rebuilds = []
+
+        def spy():
+            rebuilds.append(s.now())
+            vc.standby_prebuilds += 1  # pretend the prebuild happened
+
+        vc._maybe_prebuild_standby = spy
+        vc.start(0)
+        try:
+            # three mutations in a burst: two checkpoint sets via the
+            # registered on_mutate hook, one ladder bump
+            vc.checkpoint.set(Proposal(), [])
+            vc.checkpoint.set(Proposal(), [])
+            vc.in_flight.store_proposal(Proposal())
+            for _ in range(5):
+                await asyncio.sleep(0)
+            assert rebuilds == []  # debounce still pending
+            s.advance_by(vc.STANDBY_REBUILD_DEBOUNCE + 0.01)
+            for _ in range(10):
+                await asyncio.sleep(0)
+            assert len(rebuilds) == 1  # the burst coalesced
+            assert vc.standby_event_rebuilds == 1
+        finally:
+            await vc.stop()
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------- scenarios
+
+
+def _commitpath_config(s, i):
+    """Sharded fast config with the round-18 commit path ON: adaptive
+    arrival-driven proposing over the pipelined (launch-shadowed) window."""
+    return sharded_config(i, depth=2, request_batch_adaptive=True)
+
+
+def test_exactly_once_batched_fanout_across_view_change(tmp_path):
+    """Acceptance scenario: the wave-batched deliver fan-out preserves
+    per-shard gapless exactly-once across a forced view change — every
+    submitted request reaches the application callback exactly once, and
+    at least one callback carries a whole multi-decision wave."""
+
+    async def run():
+        c = ShardedCluster(
+            tmp_path, shards=2, n=4, depth=2, seed=31,
+            config_fn=_commitpath_config,
+        )
+        waves = []
+        c.set.mux._on_deliver_batch = waves.append
+        await c.start()
+        try:
+            submitted = set()
+
+            async def feed(sid, tag, count):
+                for j in range(count):
+                    cid = c.client_for_shard(sid, j % 2)
+                    rid = f"{tag}-{j}"
+                    await c.submit(cid, rid)
+                    submitted.add(f"{cid}:{rid}")
+
+            # phase 1: commit a burst WITHOUT polling, then poll once —
+            # the whole run leaves the window as one ingest_batch wave
+            await feed(0, "p1", 8)
+            await wait_for(lambda: c.shard(0).height() >= 3,
+                           c.scheduler, 90.0)
+            c.poll()
+            assert any(len(w) > 1 for w in waves), [len(w) for w in waves]
+
+            # phase 2: shard 0's leader goes mute; shard 1 keeps going
+            muted = c.shard(0).mute_leader()
+            await feed(1, "p2", 6)
+            await wait_for(
+                lambda: c.shard(0).leader_id() not in (0, muted),
+                c.scheduler, 120.0,
+            )
+            # phase 3: the new leader drains fresh submissions
+            await feed(0, "p3", 6)
+            await wait_for(
+                lambda: c.committed_requests() == len(submitted),
+                c.scheduler, 120.0,
+            )
+            c.check_invariants()
+            delivered = [r for w in waves for e in w for r in e.request_ids]
+            assert len(delivered) == len(set(delivered)), "duplicate delivery"
+            assert set(delivered) == submitted
+        finally:
+            await c.stop()
+
+    asyncio.run(run())
+
+
+def test_exactly_once_batched_fanout_across_restart(tmp_path):
+    """Acceptance scenario: a follower crash + restart mid-stream neither
+    drops nor re-delivers — the combined stream stays exactly-once under
+    the batched fan-out while quorum keeps committing."""
+
+    async def run():
+        c = ShardedCluster(
+            tmp_path, shards=1, n=4, depth=2, seed=33,
+            config_fn=_commitpath_config,
+        )
+        waves = []
+        c.set.mux._on_deliver_batch = waves.append
+        await c.start()
+        try:
+            submitted = set()
+
+            async def feed(tag, count):
+                for j in range(count):
+                    cid = c.client_for_shard(0, j % 2)
+                    rid = f"{tag}-{j}"
+                    await c.submit(cid, rid)
+                    submitted.add(f"{cid}:{rid}")
+
+            await feed("pre", 6)
+            await wait_for(lambda: c.shard(0).height() >= 2,
+                           c.scheduler, 90.0)
+            c.poll()
+
+            # crash a follower mid-stream: 3 of 4 stay a quorum
+            victim = next(i for i in range(1, 5)
+                          if i != c.shard(0).leader_id())
+            await c.shard(0).crash(victim)
+            await feed("down", 6)
+            await wait_for(
+                lambda: c.committed_requests() >= 12,
+                c.scheduler, 120.0,
+            )
+
+            # restart it (old WAL) and keep the stream flowing
+            await c.shard(0).restart(victim)
+            await feed("post", 6)
+            await wait_for(
+                lambda: c.committed_requests() == len(submitted),
+                c.scheduler, 180.0,
+            )
+            c.check_invariants()
+            delivered = [r for w in waves for e in w for r in e.request_ids]
+            assert len(delivered) == len(set(delivered)), "duplicate delivery"
+            assert set(delivered) == submitted
+        finally:
+            await c.stop()
+
+    asyncio.run(run())

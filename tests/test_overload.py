@@ -889,6 +889,7 @@ def test_openloop_bench_sweep_point_row_shape():
         rates="150", duration=1.0, drain=1.5, shards=1, nodes=4, batch=8,
         pool_size=64, admission=0.8, clients=64, zipf=1.1,
         degraded_rate=0.0, phase_duration=0.0, no_degraded=True, cpu=True,
+        no_adaptive=False, affinity="shared", sweep_shards="",
     )
     row = asyncio.run(openloop.run_sweep_point(150.0, args))
     assert row["bench"] == "openloop"
@@ -897,6 +898,11 @@ def test_openloop_bench_sweep_point_row_shape():
     assert {"p50_ms", "p95_ms", "p99_ms", "count", "shed"} <= set(row["latency"])
     assert {"offered", "acked", "shed_rate", "peak_occupancy"} \
         <= set(row["open_loop"])
+    # round-18 bench hygiene: rows are self-describing about loop topology
+    # and carry the honest (loopback: 0.0) RTT envelope
+    assert row["loop_affinity"] == "shared"
+    assert row["rtt_s_max"] == 0.0
+    assert row["adaptive_batching"] is True and row["batch_max"] == 8
     knee = openloop.find_knee([row])
     assert "last_ok" in knee and "first_overloaded" in knee
     # the assembler consumes real child rows end-to-end
