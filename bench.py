@@ -794,6 +794,73 @@ def assemble_transport_row(rows: list, flavor: str) -> dict:
     }
 
 
+def rejoin_guard_rows(rows: list) -> list:
+    """The ISSUE 17 flat-rejoin pin: ONE scalar row derived from the
+    rejoin sweep so ``--check-baseline`` catches an O(1)-rejoin
+    regression — the deep-history snapshot rejoin's wall clock over the
+    shallow one (unit ``x``, lower is better; the committed baseline
+    pins the ideal 1.0 with a 100% allowance, i.e. deep must stay
+    within 2x shallow).  The replay control's same ratio rides along
+    as context (it is O(depth) by design — hundreds of x).  Pure
+    function, importable; returns [] without both snapshot points."""
+    snaps, replays = {}, {}
+    for r in rows:
+        h = r.get("history_decisions")
+        if not isinstance(h, (int, float)) \
+                or not isinstance(r.get("value"), (int, float)):
+            continue
+        {"snapshot": snaps, "replay": replays}.get(r.get("mode"), {})[h] = r
+    if len(snaps) < 2:
+        return []
+    small, deep = min(snaps), max(snaps)
+    if not snaps[small]["value"]:
+        return []
+    row = {
+        "metric": "rejoin_flatness_vs_depth",
+        "value": round(snaps[deep]["value"] / snaps[small]["value"], 4),
+        "unit": "x",
+        "history_small": int(small),
+        "history_deep": int(deep),
+        "snapshot_small_s": snaps[small]["value"],
+        "snapshot_deep_s": snaps[deep]["value"],
+        "interval": snaps[deep].get("interval"),
+    }
+    if small in replays and deep in replays and replays[small]["value"]:
+        row["replay_ratio"] = round(
+            replays[deep]["value"] / replays[small]["value"], 4)
+    return [row]
+
+
+def rejoin_bench() -> None:
+    """Run benchmarks/rejoin.py (snapshot-install vs full-chain-replay
+    rejoin at shallow vs deep history, real LedgerFile/SnapshotStore/
+    verification end to end) and emit its ``rejoin_*`` rows plus the
+    flat-vs-depth guard row."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    histories = os.environ.get("SMARTBFT_BENCH_REJOIN_HISTORIES",
+                               "100,100000")
+    cmd = [sys.executable, os.path.join(here, "benchmarks", "rejoin.py"),
+           "--histories", histories]
+    timeout = float(os.environ.get("SMARTBFT_BENCH_REJOIN_TIMEOUT", "560"))
+    proc = subprocess.run(
+        cmd, timeout=timeout, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),  # no device in this bench
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"rejoin bench failed: "
+            f"{proc.stderr.decode(errors='replace')[-400:]}"
+        )
+    rows = [json.loads(l) for l in proc.stdout.decode().splitlines()
+            if l.strip()]
+    if not rows:
+        raise RuntimeError("rejoin bench produced no rows")
+    for row in rows:
+        _emit(row)
+    for guard_row in rejoin_guard_rows(rows):
+        _emit(guard_row)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument(
@@ -828,6 +895,15 @@ def main() -> None:
              "Network and through real sockets on localhost, emitting a "
              "`transport` block (bytes on the wire, frames/flush, "
              "reconnects) in the JSON row",
+    )
+    ap.add_argument(
+        "--rejoin", action="store_true",
+        default=os.environ.get("SMARTBFT_BENCH_REJOIN", "") == "1",
+        help="additionally run the rejoin bench (benchmarks/rejoin.py): "
+             "snapshot-install vs full-chain-replay rejoin wall clock and "
+             "bytes at shallow vs deep decision history "
+             "(SMARTBFT_BENCH_REJOIN_HISTORIES, default 100,100000), "
+             "emitting `rejoin_*` rows plus the flat-vs-depth guard row",
     )
     ap.add_argument(
         "--check-baseline", nargs="?", const="BASELINE_OBS.json",
@@ -874,6 +950,12 @@ def main() -> None:
             transport_bench(args.transport)
         except Exception as exc:  # noqa: BLE001 — transport row is additive
             _log(f"bench: transport bench failed ({type(exc).__name__}: {exc})")
+
+    if args.rejoin:
+        try:
+            rejoin_bench()
+        except Exception as exc:  # noqa: BLE001 — rejoin row is additive
+            _log(f"bench: rejoin bench failed ({type(exc).__name__}: {exc})")
 
     if os.environ.get("SMARTBFT_BENCH_E2E", "1") == "1":
         try:

@@ -1,0 +1,335 @@
+"""Rejoin bench (ISSUE 17): O(1) snapshot install vs O(depth) replay.
+
+The claim under test: a replica rejoining a cluster whose history is
+10^5 decisions deep should pay roughly what a replica rejoining a 10^2
+cluster pays — because it installs a verified snapshot (bounded app
+state + anchor certificate) and replays only the post-horizon tail,
+instead of re-verifying and re-applying the whole chain.  The control
+row is the same rejoin with snapshots disabled: full chain replay,
+paged at ``MAX_SYNC_DECISIONS`` like the real sync path, which is
+honestly O(depth).
+
+The bench drives the REAL durable components end to end — the framed
+:class:`~smartbft_tpu.net.launch.LedgerFile` (append, compact,
+recovery), the crash-safe :class:`~smartbft_tpu.snapshot.SnapshotStore`,
+``parse_snapshot_blob``/``verify_snapshot`` (the exact install-time
+verification the socket replica runs, anchor certificate included) and
+``verify_tail`` with the full quorum check per paged batch — but feeds
+them a synthesized committed history instead of running live consensus,
+so a 10^5-deep donor builds in seconds and the measured section is
+purely the JOINER's work:
+
+* snapshot mode: chunked fetch of the donor's snapshot file (the
+  FT_SNAP chunk size), structural parse, anchor verification, crash-safe
+  install (store save + ledger compact-to-base), then tail verify +
+  replay past the horizon;
+* replay mode: page the donor's chain in ``MAX_SYNC_DECISIONS`` batches,
+  re-encode/decode each frame (the serving + receiving codec work),
+  verify continuity AND certificates, append every decision.
+
+Both modes finish by asserting the joiner's chained ledger digest and
+chained request-id digest are BIT-IDENTICAL to the donor's — a rejoin
+that arrived at a different state would be a wrong answer computed
+quickly.
+
+Output: one JSON line per (history, mode) through the pure
+``assemble_rejoin_row`` pinned in ``smartbft_tpu.obs.benchschema``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from smartbft_tpu.codec import decode, encode  # noqa: E402
+from smartbft_tpu.core.util import compute_quorum  # noqa: E402
+from smartbft_tpu.messages import Proposal, Signature, ViewMetadata  # noqa: E402
+from smartbft_tpu.net.framing import WireDecision, encode_frame  # noqa: E402
+from smartbft_tpu.net.framing import FT_SYNC_RESP as _FT_LEDGER  # noqa: E402
+from smartbft_tpu.net.launch import LedgerFile  # noqa: E402
+from smartbft_tpu.net.transport import MAX_SYNC_DECISIONS  # noqa: E402
+from smartbft_tpu.obs.benchschema import assemble_rejoin_row  # noqa: E402
+from smartbft_tpu.snapshot import (  # noqa: E402
+    CHAIN_SEED,
+    RECENT_IDS_CAP,
+    AppState,
+    SnapshotStore,
+    chain_update,
+    encode_snapshot_blob,
+    fold_ids,
+    make_manifest,
+    parse_snapshot_blob,
+    verify_snapshot,
+    verify_tail,
+)
+from smartbft_tpu.testing.app import BatchPayload, TestRequest  # noqa: E402
+
+#: cluster shape the synthesized certificates model (n=4 -> quorum 3),
+#: matching the socket smoke cluster the live rejoin harness drives
+NODES = (1, 2, 3, 4)
+
+
+def _log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+class DonorHistory:
+    """A synthesized committed chain of ``depth`` decisions with real
+    certificates: one request per decision, quorum signatures, chained
+    ledger + request-id digests tracked at every height."""
+
+    def __init__(self, depth: int, payload_bytes: int):
+        quorum, _f = compute_quorum(len(NODES))
+        filler = b"x" * payload_bytes
+        self.depth = depth
+        self.wire: list[WireDecision] = []
+        self.frames: list[bytes] = []
+        self.rids: list[str] = []
+        #: chain digest AT each height: chains[h] covers decisions 1..h
+        self.chains: list[bytes] = [CHAIN_SEED]
+        self.ids_digest = CHAIN_SEED
+        chain = CHAIN_SEED
+        for seq in range(1, depth + 1):
+            rid = f"bench:j-{seq}"
+            req = encode(TestRequest(client_id="bench",
+                                     request_id=f"j-{seq}", payload=filler))
+            proposal = Proposal(
+                header=b"",
+                payload=encode(BatchPayload(requests=[req])),
+                metadata=encode(ViewMetadata(view_id=0, latest_sequence=seq)),
+            )
+            sigs = [Signature(signer=i, value=b"sig-%d" % i, msg=b"")
+                    for i in NODES[:quorum]]
+            wd = WireDecision(proposal=proposal, signatures=sigs)
+            self.wire.append(wd)
+            self.frames.append(encode_frame(_FT_LEDGER, encode(wd)))
+            self.rids.append(rid)
+            chain = chain_update(chain, proposal.payload, proposal.metadata)
+            self.chains.append(chain)
+            self.ids_digest = fold_ids(self.ids_digest, [rid])
+
+    def snapshot_blob(self, height: int) -> bytes:
+        """The donor's snapshot file image at ``height`` (what the
+        FT_SNAP chunk plane would serve), anchor certificate included."""
+        state = AppState(
+            request_count=height,
+            ids_digest=fold_ids(CHAIN_SEED, self.rids[:height]),
+            recent_ids=self.rids[max(0, height - RECENT_IDS_CAP):height],
+        )
+        blob = encode(state)
+        anchor = self.wire[height - 1]
+        manifest = make_manifest(height, self.chains[height], blob,
+                                 anchor.proposal, list(anchor.signatures))
+        return encode_snapshot_blob(manifest, blob)
+
+
+class Joiner:
+    """The rejoining replica's durable state: a fresh LedgerFile +
+    SnapshotStore in its own directory, plus the in-memory chain/ids
+    digests a live replica folds on every deliver."""
+
+    def __init__(self, root: str):
+        os.makedirs(root, exist_ok=True)
+        self.ledger = LedgerFile(os.path.join(root, "ledger.bin"))
+        self.ledger.read_all()
+        self.ledger.open_append()
+        self.store = SnapshotStore(os.path.join(root, "snapshots"))
+        self.height = 0
+        self.chain = CHAIN_SEED
+        self.ids_digest = CHAIN_SEED
+
+    def apply(self, wd: WireDecision, rid: str) -> None:
+        from smartbft_tpu.types import Decision
+
+        self.ledger.append(Decision(proposal=wd.proposal,
+                                    signatures=tuple(wd.signatures)))
+        self.chain = chain_update(self.chain, wd.proposal.payload,
+                                  wd.proposal.metadata)
+        self.ids_digest = fold_ids(self.ids_digest, [rid])
+        self.height += 1
+
+    def close(self) -> None:
+        self.ledger.close()
+
+
+def _fetch_chunked(path: str, chunk_bytes: int) -> tuple[bytes, int]:
+    """Read a snapshot file the way the FT_SNAP plane ships it: bounded
+    chunks off the file, reassembled by the receiver.  Returns
+    (blob, chunk_count)."""
+    parts = []
+    chunks = 0
+    with open(path, "rb") as fh:
+        while True:
+            data = fh.read(chunk_bytes)
+            if not data:
+                break
+            parts.append(data)
+            chunks += 1
+    return b"".join(parts), chunks
+
+
+def rejoin_snapshot(donor: DonorHistory, snap_path: str, tail_from: int,
+                    root: str, chunk_bytes: int) -> dict:
+    """One timed snapshot-mode rejoin; returns the measurement dict."""
+    quorum, _f = compute_quorum(len(NODES))
+    members = frozenset(NODES)
+    joiner = Joiner(root)
+    t0 = time.perf_counter()
+    # 1. chunked fetch + structural parse (torn/tamper detection)
+    blob, chunks = _fetch_chunked(snap_path, chunk_bytes)
+    parsed = parse_snapshot_blob(blob)
+    assert parsed is not None, "donor snapshot failed structural parse"
+    manifest, state = parsed
+    # 2. anchor-certificate verification (the install gate)
+    err = verify_snapshot(manifest, state, quorum, members)
+    assert err is None, f"donor snapshot failed verification: {err}"
+    # 3. crash-safe install: store save, THEN ledger compact-to-base
+    joiner.store.save(manifest, state)
+    anchor_wire = encode(donor.wire[manifest.height - 1])
+    joiner.ledger.compact(manifest.height, manifest.chain_digest, [],
+                          app_state=state, anchor=anchor_wire)
+    joiner.height = manifest.height
+    joiner.chain = manifest.chain_digest
+    joiner.ids_digest = decode(AppState, state).ids_digest
+    # 4. tail verify + replay past the horizon (paged like live sync)
+    replayed = 0
+    tail_bytes = 0
+    pos = tail_from
+    while pos < donor.depth:
+        page = donor.wire[pos:pos + MAX_SYNC_DECISIONS]
+        raw = [encode(wd) for wd in page]
+        tail_bytes += sum(len(r) for r in raw)
+        wds = [decode(WireDecision, r) for r in raw]
+        err = verify_tail(wds, pos, quorum=quorum, members=members)
+        assert err is None, f"tail verification failed: {err}"
+        for i, wd in enumerate(wds):
+            joiner.apply(wd, donor.rids[pos + i])
+        replayed += len(wds)
+        pos += len(wds)
+    elapsed = time.perf_counter() - t0
+    assert joiner.chain == donor.chains[donor.depth], \
+        "snapshot rejoin arrived at a DIFFERENT chain digest"
+    assert joiner.ids_digest == donor.ids_digest, \
+        "snapshot rejoin arrived at a DIFFERENT ids digest"
+    joiner.close()
+    snap_bytes = os.path.getsize(snap_path)
+    return {
+        "rejoin_s": elapsed,
+        "bytes": snap_bytes + tail_bytes,
+        "snapshot_bytes": snap_bytes,
+        "chunks": chunks,
+        "replayed": replayed,
+    }
+
+
+def rejoin_replay(donor: DonorHistory, root: str) -> dict:
+    """One timed full-chain-replay rejoin (the O(depth) control)."""
+    quorum, _f = compute_quorum(len(NODES))
+    members = frozenset(NODES)
+    joiner = Joiner(root)
+    t0 = time.perf_counter()
+    total_bytes = 0
+    pos = 0
+    while pos < donor.depth:
+        page = donor.wire[pos:pos + MAX_SYNC_DECISIONS]
+        raw = [encode(wd) for wd in page]
+        total_bytes += sum(len(r) for r in raw)
+        wds = [decode(WireDecision, r) for r in raw]
+        err = verify_tail(wds, pos, quorum=quorum, members=members)
+        assert err is None, f"tail verification failed: {err}"
+        for i, wd in enumerate(wds):
+            joiner.apply(wd, donor.rids[pos + i])
+        pos += len(wds)
+    elapsed = time.perf_counter() - t0
+    assert joiner.chain == donor.chains[donor.depth], \
+        "replay rejoin arrived at a DIFFERENT chain digest"
+    assert joiner.ids_digest == donor.ids_digest, \
+        "replay rejoin arrived at a DIFFERENT ids digest"
+    joiner.close()
+    return {
+        "rejoin_s": elapsed,
+        "bytes": total_bytes,
+        "replayed": donor.depth,
+    }
+
+
+def run_point(depth: int, *, tail: int, payload_bytes: int, reps: int,
+              chunk_bytes: int, work_root: str) -> list[dict]:
+    """Both modes at one history depth; best-of-``reps`` wall clock
+    (rejoin is a latency-shaped metric: the best rep is the machine's
+    honest capability, the spread is host weather)."""
+    t0 = time.perf_counter()
+    donor = DonorHistory(depth, payload_bytes)
+    _log(f"rejoin: donor depth={depth} built in "
+         f"{time.perf_counter() - t0:.1f}s")
+    snap_height = max(1, depth - tail)
+    snap_path = os.path.join(work_root, f"donor-{depth}.snap")
+    with open(snap_path, "wb") as fh:
+        fh.write(donor.snapshot_blob(snap_height))
+    results = []
+    for mode in ("snapshot", "replay"):
+        best = None
+        for rep in range(reps):
+            root = os.path.join(work_root, f"joiner-{depth}-{mode}-{rep}")
+            if mode == "snapshot":
+                m = rejoin_snapshot(donor, snap_path, snap_height, root,
+                                    chunk_bytes)
+            else:
+                m = rejoin_replay(donor, root)
+            shutil.rmtree(root, ignore_errors=True)
+            if best is None or m["rejoin_s"] < best["rejoin_s"]:
+                best = m
+        _log(f"rejoin: h={depth} mode={mode} best {best['rejoin_s']:.4f}s "
+             f"({best['bytes']} bytes, {best['replayed']} replayed)")
+        results.append({"depth": depth, "mode": mode, **best})
+    return results
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--histories", default="100,100000",
+                    help="comma-separated history depths (decisions)")
+    ap.add_argument("--tail", type=int, default=16,
+                    help="decisions past the snapshot horizon (the tail a "
+                         "snapshot-mode joiner still replays)")
+    ap.add_argument("--payload-bytes", type=int, default=96)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--chunk-bytes", type=int, default=1 << 20)
+    args = ap.parse_args()
+    depths = sorted({int(h) for h in args.histories.split(",") if h.strip()})
+    small = depths[0]
+    small_by_mode: dict[str, float] = {}
+    with tempfile.TemporaryDirectory(prefix="sbft-rejoin-") as work_root:
+        for depth in depths:
+            for m in run_point(depth, tail=args.tail,
+                               payload_bytes=args.payload_bytes,
+                               reps=args.reps, chunk_bytes=args.chunk_bytes,
+                               work_root=work_root):
+                vs_small = None
+                if m["depth"] == small:
+                    small_by_mode[m["mode"]] = m["rejoin_s"]
+                elif small_by_mode.get(m["mode"]):
+                    vs_small = m["rejoin_s"] / small_by_mode[m["mode"]]
+                row = assemble_rejoin_row(
+                    history=m["depth"], mode=m["mode"],
+                    rejoin_s=m["rejoin_s"], bytes_transferred=m["bytes"],
+                    decisions_replayed=m["replayed"],
+                    snapshot_bytes=m.get("snapshot_bytes"),
+                    snap_chunks=m.get("chunks"),
+                    interval=args.tail,
+                    vs_small_history=vs_small,
+                )
+                print(json.dumps(row), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

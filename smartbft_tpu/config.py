@@ -325,6 +325,23 @@ class Configuration:
     autoscale_min_shards: int = 1
     autoscale_max_shards: int = 8
 
+    # Snapshots + log compaction (smartbft_tpu/snapshot/ — the PBFT
+    # stable-checkpoint discipline, ISSUE 17).  Consumed by the socket
+    # ReplicaApp and the in-process testing App; round-tripped by
+    # testing.reconfig.ConfigMirror so a reconfiguration cannot silently
+    # turn compaction off (or on) for part of the cluster.
+    # - snapshot_interval_decisions: capture a snapshot (and truncate the
+    #   ledger/WAL prefix behind it) every N committed decisions.  0
+    #   (default) disables snapshots entirely — full-chain catch-up and
+    #   unbounded ledger growth, the pre-ISSUE-17 behavior, which several
+    #   existing harness oracles (committed_ids over the whole history)
+    #   rely on.
+    # - snapshot_chunk_bytes: FT_SNAP_RESP chunk payload size for state
+    #   transfer; must leave frame-envelope headroom under
+    #   transport_max_frame_bytes (validated below).
+    snapshot_interval_decisions: int = 0
+    snapshot_chunk_bytes: int = 1024 * 1024
+
     def validate(self) -> None:
         def positive(name: str) -> None:
             v = getattr(self, name)
@@ -418,6 +435,21 @@ class Configuration:
             raise ConfigError(
                 "verify_flush_hold should not be negative "
                 "(0 disables occupancy-aware flush gating)"
+            )
+        if self.snapshot_interval_decisions < 0:
+            raise ConfigError(
+                "snapshot_interval_decisions should not be negative "
+                "(0 disables snapshots and log compaction)"
+            )
+        if self.snapshot_chunk_bytes <= 0:
+            raise ConfigError(
+                "snapshot_chunk_bytes should be greater than zero"
+            )
+        if self.snapshot_chunk_bytes > self.transport_max_frame_bytes - 65536:
+            raise ConfigError(
+                "snapshot_chunk_bytes must sit at least 64 KiB under "
+                "transport_max_frame_bytes (chunk + envelope must fit one "
+                "frame, or every state transfer poisons its connection)"
             )
         if not (0.0 < self.admission_high_water <= 1.0):
             raise ConfigError(

@@ -677,6 +677,16 @@ class Pool:
         self._note_drained(1)
         self._release_space()
 
+    def seed_processed(self, infos) -> None:
+        """Pre-arm the dedup memory with ALREADY-COMMITTED request ids
+        (snapshot install / reshard handoff, ISSUE 17): a node seeded
+        from a donor snapshot never saw those requests delivered, but a
+        client resubmitting one must get ReqAlreadyProcessedError, not a
+        second delivery.  Bounded by the same eviction as the delivery
+        path."""
+        for info in infos:
+            self._move_to_del(info)
+
     def _move_to_del(self, info: RequestInfo) -> None:
         if info in self._del_map:
             return

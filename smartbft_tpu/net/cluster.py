@@ -22,6 +22,13 @@ chaos action          socket-level meaning
 ``disconnect``        blackhole every link of the node, both directions
 ``partition``/``heal``  drop_link on each cross-group pair, both endpoints
 ``slow_link``         per-flush delay on every link of the node
+``crash_during_snapshot``  wait (bounded) for the node's next snapshot
+                      capture to land, then SIGKILL immediately — the
+                      process dies with the fresh snapshot on disk and
+                      the compaction/offer plumbing at an arbitrary
+                      point (ISSUE 17; the deterministic between-write-
+                      and-truncate points are pinned by the unit tests
+                      over SnapshotStore + LedgerFile)
 ====================  ====================================================
 
 (Framing poison — garbage bytes on a live connection — is exercised by
@@ -197,6 +204,14 @@ class SocketCluster:
 
     def spawn(self, node_id: int) -> None:
         h = self.replicas[node_id]
+        if h.proc is not None and h.proc.poll() is None:
+            # A second spawn would fork a TWIN replica sharing the same
+            # ledger/WAL/socket paths — the twin survives kill() and
+            # silently keeps committing, wrecking every chaos oracle.
+            raise RuntimeError(
+                f"replica {node_id} is already running (pid "
+                f"{h.proc.pid}); kill() it before spawning again"
+            )
         # Popen dups the log fd into the child; close the parent's handle
         # so restart-heavy soaks don't accumulate one fd per spawn
         with open(os.path.join(self.root, f"replica-{node_id}.log"), "ab") as log:
@@ -386,22 +401,56 @@ class SocketCluster:
             return f"down({type(e).__name__})"
 
     def check_fork_free(self) -> None:
-        """Pairwise-identical ledger prefixes via control-channel digests."""
+        """Pairwise-identical ledger prefixes via control-channel digests.
+
+        Snapshot-aware (ISSUE 17): a replica that compacted PAST the
+        comparison height cannot recompute that prefix digest (the
+        decisions are gone — by design), so it is skipped for the
+        prefix comparison; replicas at EQUAL heights are additionally
+        compared on their full chained digest AND their chained
+        request-id digest, which survive compaction at any horizon.
+        """
         heights = self.heights()
         live = [i for i, h in heights.items() if h >= 0]
         if len(live) < 2:
             return
         m = min(heights[i] for i in live)
-        digests = {
-            i: self.control(i).call(cmd="ledger_digest", upto=m)["digest"]
+        resp = {
+            i: self.control(i).call(cmd="ledger_digest", upto=m)
             for i in live
         }
-        ref = digests[live[0]]
-        for i in live[1:]:
-            assert digests[i] == ref, (
-                f"ledger fork: node {live[0]} and node {i} diverge within "
-                f"the first {m} decisions"
-            )
+        comparable = [i for i in live if int(resp[i].get("base", 0)) <= m]
+        if len(comparable) >= 2:
+            ref = resp[comparable[0]]["digest"]
+            for i in comparable[1:]:
+                assert resp[i]["digest"] == ref, (
+                    f"ledger fork: node {comparable[0]} and node {i} "
+                    f"diverge within the first {m} decisions"
+                )
+        # equal-height replicas must agree on the FULL digests too —
+        # this is the check that still bites when compaction horizons
+        # differ (and the exactly-once oracle across snapshot installs)
+        by_height: dict[int, list[int]] = {}
+        for i in live:
+            by_height.setdefault(heights[i], []).append(i)
+        for h, group in by_height.items():
+            if len(group) < 2:
+                continue
+            full = {
+                i: self.control(i).call(cmd="ledger_digest", upto=h)
+                for i in group
+            }
+            ref_i = group[0]
+            for i in group[1:]:
+                assert full[i]["digest"] == full[ref_i]["digest"], (
+                    f"ledger fork at height {h}: node {ref_i} vs node {i}"
+                )
+                assert (full[i].get("ids_digest")
+                        == full[ref_i].get("ids_digest")), (
+                    f"request-id stream diverges at height {h}: "
+                    f"node {ref_i} vs node {i} (lost or doubled "
+                    f"delivery across a snapshot install)"
+                )
 
     def committed_ids(self, node_id: int) -> list[str]:
         return self.control(node_id).call(cmd="committed_ids")["ids"]
@@ -446,6 +495,13 @@ class SocketCluster:
             except (OSError, ControlError):
                 pass
         return out
+
+    def snapshot_stats(self, node_id: int) -> dict:
+        """One replica's snapshot/disk posture (cmd=snapshot, ISSUE 17):
+        ``height``, ``base_height``, ``snapshot_height``,
+        ``snapshot_age_decisions``, ``snapshot_disk_bytes``,
+        ``ledger_disk_bytes``, ``wal_disk_bytes``, ``sync_poisoned``."""
+        return self.control(node_id).call(cmd="snapshot")
 
     def fault(self, node_id: int, action: str, peer: int = 0,
               delay: float = 0.0) -> None:
@@ -782,6 +838,10 @@ def run_socket_schedule(
             cluster.fault(node, "slow_link", delay=evt.fraction)
         elif evt.action == "unslow_link":
             cluster.fault(node, "slow_link", delay=0.0)
+        elif evt.action == "crash_during_snapshot":
+            _kill_at_next_snapshot(cluster, node,
+                                   window=evt.fraction or 10.0)
+            faulted.add(node)
         else:
             raise ValueError(f"unsupported socket chaos action: {evt.action}")
         report.events_fired.append((evt.action, node))
@@ -872,6 +932,31 @@ def run_socket_schedule(
     return report
 
 
+def _snapshot_height_or(cluster: SocketCluster, node_id: int,
+                        default: int = -1) -> int:
+    try:
+        return int(cluster.snapshot_stats(node_id).get("snapshot_height", 0))
+    except (OSError, ControlError, json.JSONDecodeError):
+        return default
+
+
+def _kill_at_next_snapshot(cluster: SocketCluster, node_id: int,
+                           *, window: float = 10.0) -> None:
+    """SIGKILL ``node_id`` the moment its NEXT snapshot capture lands
+    (bounded by ``window`` seconds — kills at the deadline regardless, so
+    a schedule can never hang on a capture that does not come).  The
+    process dies with the fresh snapshot file on disk and the
+    compaction/truncation/offer plumbing interrupted at whatever point
+    the race hits; recovery must reconcile."""
+    before = _snapshot_height_or(cluster, node_id)
+    deadline = time.monotonic() + max(window, 0.1)
+    while time.monotonic() < deadline:
+        if _snapshot_height_or(cluster, node_id) > before:
+            break
+        time.sleep(0.01)
+    cluster.kill(node_id)
+
+
 def kill_rejoin_schedule(*, crash_at: float = 2.0,
                          restart_at: float = 5.0) -> list[ChaosEvent]:
     """SIGKILL the current leader mid-burst; respawn it; it must recover
@@ -924,4 +1009,228 @@ def socket_soak(*, rounds: int = 2, n: int = 4, transport: str = "uds",
                         f"{report.events_fired} committed="
                         f"{report.final_committed} heights={report.heights}"
                         f" verdicts={report.verdicts} — OK"
+                    )
+
+
+# --------------------------------------------------------------------------
+# snapshot state transfer: O(1) rejoin over real sockets (ISSUE 17)
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class SnapshotRejoinReport:
+    """What a snapshot-rejoin run observed (the oracle inputs)."""
+
+    victim: int = 0
+    victim_height_at_kill: int = 0
+    donor_snapshot_height: int = 0
+    victim_base_after: int = 0
+    victim_height_after: int = 0
+    snap_chunks_received: int = 0
+    snap_chunks_sent_total: int = 0
+    snap_bytes_received: int = 0
+    sync_poisoned_total: int = 0
+    rejoin_seconds: float = 0.0
+    requests: int = 0
+    events: list = field(default_factory=list)
+
+
+def run_snapshot_rejoin(
+    cluster: SocketCluster,
+    *,
+    victim: int = 2,
+    warmup: int = 8,
+    history: int = 48,
+    crash_during_snapshot: bool = False,
+    mid_fetch_donor_kill: bool = False,
+    settle_timeout: float = 180.0,
+) -> SnapshotRejoinReport:
+    """Drive the snapshot state-transfer rejoin end-to-end over real
+    processes: commit ``warmup``, SIGKILL ``victim`` (optionally racing
+    its own snapshot capture), grow the chain by ``history`` until every
+    donor's snapshot horizon has moved PAST the victim's crash height —
+    the donors have by then also COMPACTED past it, so a chain-replay
+    tail is no longer even possible — then respawn the victim and require
+    it to come back via snapshot install + tail.
+
+    ``mid_fetch_donor_kill`` SIGKILLs the serving donor while the victim
+    is mid-chunk (then respawns it): the fetch must resume or fail over
+    to another offer, never wedge.
+
+    The cluster MUST be built with ``snapshot_interval_decisions > 0``
+    in ``config_overrides``.  NOTE: :func:`run_socket_schedule`'s
+    resubmission oracle reads ``committed_ids`` (suffix-only once a
+    replica compacts) and is NOT snapshot-safe; this runner uses the
+    count/ids-digest oracles, which survive compaction.
+
+    Returns the report; raises AssertionError/TimeoutError on any
+    violated invariant (rejoined-but-not-via-snapshot counts as one).
+    """
+    report = SnapshotRejoinReport(victim=victim)
+    lead = cluster.wait_leader()
+    if victim == lead:
+        victim = next(i for i in cluster.live_ids() if i != lead)
+        report.victim = victim
+    total = 0
+
+    def _submit_one() -> None:
+        nonlocal total
+        cluster.submit(lead, "snaprejoin", f"sr-{total}")
+        total += 1
+
+    for _ in range(warmup):
+        _submit_one()
+    cluster.wait_committed(total, timeout=settle_timeout)
+
+    # -- kill the victim (racing its own capture when asked) ------------
+    victim_h = cluster.heights().get(victim, 0)
+    if crash_during_snapshot:
+        before = _snapshot_height_or(cluster, victim)
+        deadline = time.monotonic() + settle_timeout / 3
+        while (_snapshot_height_or(cluster, victim) <= before
+               and time.monotonic() < deadline):
+            _submit_one()
+            try:
+                victim_h = cluster.control(victim).call(cmd="height")["height"]
+            except (OSError, ControlError):
+                pass
+            time.sleep(0.05)
+        cluster.kill(victim)
+        report.events.append("crash_during_snapshot")
+    else:
+        cluster.kill(victim)
+        report.events.append("crash")
+    report.victim_height_at_kill = victim_h
+    donors = [i for i in cluster.live_ids() if i != victim]
+
+    # -- grow history until every donor's horizon passed the victim -----
+    for _ in range(history):
+        _submit_one()
+    cluster.wait_committed(total, timeout=settle_timeout, nodes=donors)
+    deadline = time.monotonic() + settle_timeout / 2
+    while min(_snapshot_height_or(cluster, d) for d in donors) <= victim_h:
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"donor snapshot horizon never passed the victim's crash "
+                f"height {victim_h}: "
+                f"{[(d, _snapshot_height_or(cluster, d)) for d in donors]}"
+            )
+        _submit_one()
+        cluster.wait_committed(total, timeout=settle_timeout, nodes=donors)
+        time.sleep(0.05)
+    report.donor_snapshot_height = min(
+        _snapshot_height_or(cluster, d) for d in donors
+    )
+
+    # -- respawn: the rejoin itself --------------------------------------
+    t0 = time.monotonic()
+    cluster.restart(victim)
+    report.events.append("restart")
+    if mid_fetch_donor_kill:
+        fetch_deadline = time.monotonic() + settle_timeout / 2
+        while time.monotonic() < fetch_deadline:
+            try:
+                st = cluster.control(victim).call(cmd="stats")["transport"]
+                if int(st.get("snap_chunks_received", 0)) > 0:
+                    break
+            except (OSError, ControlError):
+                pass
+            time.sleep(0.005)
+        # kill the busiest non-leader donor mid-transfer, then respawn it
+        stats = cluster.transport_stats()
+        candidates = [d for d in donors if d != lead] or donors
+        serving = max(
+            candidates,
+            key=lambda d: stats.get(d, {}).get("snap_chunks_sent", 0),
+        )
+        cluster.kill(serving)
+        report.events.append(f"donor_kill:{serving}")
+        time.sleep(1.0)
+        cluster.restart(serving)
+        report.events.append(f"donor_restart:{serving}")
+    cluster.wait_committed(total, timeout=settle_timeout)
+    cluster.wait_quiescent(timeout=settle_timeout)
+    report.rejoin_seconds = round(time.monotonic() - t0, 3)
+    report.requests = total
+
+    # -- oracles ---------------------------------------------------------
+    vs = cluster.snapshot_stats(victim)
+    report.victim_base_after = int(vs.get("base_height", 0))
+    report.victim_height_after = int(vs.get("height", 0))
+    stats = cluster.transport_stats()
+    report.snap_chunks_received = int(
+        stats.get(victim, {}).get("snap_chunks_received", 0))
+    report.snap_bytes_received = int(
+        stats.get(victim, {}).get("snap_bytes_received", 0))
+    report.snap_chunks_sent_total = sum(
+        int(s.get("snap_chunks_sent", 0)) for s in stats.values())
+    report.sync_poisoned_total = sum(
+        int(s.get("sync_poisoned", 0)) for s in stats.values())
+    assert report.victim_base_after > victim_h, (
+        f"victim rejoined by CHAIN REPLAY, not snapshot install: base "
+        f"{report.victim_base_after} <= crash height {victim_h}"
+    )
+    assert report.snap_chunks_received > 0, (
+        "victim caught up without receiving a single snapshot chunk"
+    )
+    assert report.snap_chunks_sent_total > 0, "no donor served chunks"
+    assert report.sync_poisoned_total == 0, (
+        f"honest-cluster run tripped the poisoning guard "
+        f"{report.sync_poisoned_total} times"
+    )
+    heights = cluster.heights()
+    assert len(set(heights.values())) == 1, f"heights diverge: {heights}"
+    cluster.check_fork_free()
+    return report
+
+
+def snapshot_soak(*, rounds: int = 2, n: int = 4, transport: str = "uds",
+                  interval: int = 8, verbose: bool = True) -> None:
+    """``chaos --soak --snapshots`` (ISSUE 17): the truncating soak.
+    Each round runs rejoin-via-snapshot then crash-during-snapshot (with
+    a donor SIGKILLed mid-chunk in the second) against a fresh cluster
+    captured every ``interval`` decisions with deliberately tiny chunks
+    (multi-chunk transfers even for small states).  Beyond the rejoin
+    oracles, each round pins the DISK BOUND: every replica's live ledger
+    suffix stays within ~2 capture intervals of its snapshot horizon no
+    matter how long the chain grows, and the final cluster verdict is
+    not critical (snapshot.lag_intervals unbreached)."""
+    overrides = {
+        "snapshot_interval_decisions": interval,
+        "snapshot_chunk_bytes": 1024,
+    }
+    for r in range(rounds):
+        for name, kwargs in (
+            ("rejoin-via-snapshot", {}),
+            ("crash-during-snapshot",
+             {"crash_during_snapshot": True, "mid_fetch_donor_kill": True}),
+        ):
+            with tempfile.TemporaryDirectory(prefix="sbft-snap-") as root:
+                cluster = SocketCluster(root, n=n, transport=transport,
+                                        config_overrides=overrides)
+                try:
+                    cluster.start()
+                    cluster.wait_leader()
+                    report = run_snapshot_rejoin(cluster, **kwargs)
+                    for i in cluster.live_ids():
+                        s = cluster.snapshot_stats(i)
+                        suffix = int(s["height"]) - int(s["base_height"])
+                        assert suffix <= 2 * interval + 8, (
+                            f"node {i} ledger suffix unbounded: {suffix} "
+                            f"decisions past its snapshot horizon "
+                            f"(interval {interval})"
+                        )
+                        assert int(s["ledger_disk_bytes"]) > 0
+                    verdict = cluster.cluster_health()
+                    assert verdict["status"] != "critical", verdict
+                finally:
+                    cluster.stop()
+                if verbose:
+                    print(
+                        f"snapshot round {r} [{name}]: events="
+                        f"{report.events} requests={report.requests} "
+                        f"victim_h@kill={report.victim_height_at_kill} "
+                        f"base_after={report.victim_base_after} "
+                        f"chunks={report.snap_chunks_received} "
+                        f"rejoin={report.rejoin_seconds}s — OK"
                     )

@@ -25,7 +25,13 @@ Frame types:
   SPI surface, also how the pool forwards requests to the leader);
 * ``FT_SYNC_REQ`` / ``FT_SYNC_RESP`` — ledger catch-up for the
   multi-process cluster (a restarted replica has no in-process shared
-  ledger to sync from), correlated by nonce;
+  ledger to sync from), correlated by nonce; a SYNC_RESP may carry a
+  snapshot OFFER instead of (or alongside) a tail when the requester is
+  behind the responder's snapshot horizon (ISSUE 17);
+* ``FT_SNAP_REQ`` / ``FT_SNAP_RESP`` — chunked snapshot state transfer
+  (ISSUE 17): byte-offset paging of one snapshot file under the frame
+  cap, nonce-correlated, resumable after reconnect by re-requesting
+  from the current offset;
 * ``FT_REJECT``     — structured shed notice travelling the REVERSE
   direction of an ``FT_REQUEST``: the receiving replica's pool refused
   the request (admission gate / bounded-wait timeout), and the sender —
@@ -74,10 +80,12 @@ FT_SYNC_REQ = 4
 FT_SYNC_RESP = 5
 FT_REJECT = 6
 FT_TRACE = 7
+FT_SNAP_REQ = 8
+FT_SNAP_RESP = 9
 
 _KNOWN_TYPES = frozenset(
     (FT_HELLO, FT_CONSENSUS, FT_REQUEST, FT_SYNC_REQ, FT_SYNC_RESP,
-     FT_REJECT, FT_TRACE)
+     FT_REJECT, FT_TRACE, FT_SNAP_REQ, FT_SNAP_RESP)
 )
 
 
@@ -247,16 +255,63 @@ class WireDecision:
 @wiremsg
 class SyncBatch:
     """Response to :class:`SyncRequest` — the responder's ledger tail,
-    capped at ``max_sync_decisions`` per round trip (the requester loops)."""
+    capped per round trip in BOTH decisions (``MAX_SYNC_DECISIONS``) and
+    encoded bytes (a margin under ``transport_max_frame_bytes`` — a deep
+    tail must page across nonce-correlated continuation requests, never
+    exceed the frame cap in one reply).
+
+    Snapshot offer (ISSUE 17): when the responder has compacted its
+    ledger behind a snapshot horizon above the requested height — or the
+    requester is simply too far behind — ``snapshot_height`` /
+    ``snapshot_bytes`` / ``snapshot_digest`` describe the snapshot the
+    requester should fetch over FT_SNAP_REQ/FT_SNAP_RESP instead of
+    paging the whole chain.  ``snapshot_height == 0`` means no offer;
+    ``decisions`` then starts at ``from_height`` as before.  An offer
+    can ride WITH a (possibly empty) tail: the requester installs the
+    snapshot first, then pages the suffix."""
 
     nonce: int = 0
     from_height: int = 0
     total_height: int = 0
     decisions: list[WireDecision] = None  # type: ignore[assignment]
+    snapshot_height: int = 0
+    snapshot_bytes: int = 0
+    snapshot_digest: bytes = b""
 
     def __post_init__(self):
         if self.decisions is None:
             object.__setattr__(self, "decisions", [])
+
+
+@wiremsg
+class SnapshotFetchRequest:
+    """Fetch one chunk of the peer's snapshot at ``height`` starting at
+    byte ``offset`` (nonce-correlated like :class:`SyncRequest`).
+    Resume-after-reconnect = re-issuing from the current offset — the
+    requester buffers received chunks in memory only, so a crashed
+    transfer restarts clean."""
+
+    nonce: int = 0
+    height: int = 0
+    offset: int = 0
+    max_bytes: int = 0
+
+
+@wiremsg
+class SnapshotChunk:
+    """One bounded slice of snapshot file bytes (manifest + state blob,
+    exactly the on-disk format).  ``total_bytes`` lets the requester
+    pre-size and detect completion; ``last`` marks the final chunk.  A
+    responder whose snapshot at ``height`` is gone (superseded mid-
+    transfer) answers ``total_bytes == 0`` — the requester restarts
+    against the peer's CURRENT offer."""
+
+    nonce: int = 0
+    height: int = 0
+    total_bytes: int = 0
+    offset: int = 0
+    data: bytes = b""
+    last: bool = False
 
 
 # --------------------------------------------------------------------------

@@ -72,6 +72,8 @@ from .framing import (
     FT_HELLO,
     FT_REJECT,
     FT_REQUEST,
+    FT_SNAP_REQ,
+    FT_SNAP_RESP,
     FT_SYNC_REQ,
     FT_SYNC_RESP,
     FT_TRACE,
@@ -79,6 +81,8 @@ from .framing import (
     FrameError,
     Hello,
     RejectFrame,
+    SnapshotChunk,
+    SnapshotFetchRequest,
     SyncBatch,
     SyncRequest,
     TraceCtx,
@@ -100,8 +104,19 @@ CONNECT_TIMEOUT = 3.0
 HANDSHAKE_TIMEOUT = 5.0
 
 #: SyncBatch responses are capped at this many decisions per round trip;
-#: the requester loops until caught up
+#: the requester loops until caught up.  A BYTE budget additionally caps
+#: each batch under the frame cap (see ``_serve_sync``) — a deep tail of
+#: fat decisions pages across continuation requests instead of emitting
+#: one over-cap frame that would poison the connection it rides on.
 MAX_SYNC_DECISIONS = 256
+
+#: frame-envelope headroom reserved out of max_frame_bytes when budgeting
+#: a SyncBatch / SnapshotChunk (codec framing + the non-payload fields)
+FRAME_ENVELOPE_BYTES = 65536
+
+#: resume attempts for one snapshot transfer before giving up (each
+#: retry re-requests from the current offset — the reconnect-resume path)
+SNAP_FETCH_RETRIES = 8
 
 #: bounded memory of inbound request trace contexts (key -> (origin, hop))
 #: used to continue the hop chain when this node re-forwards a request;
@@ -123,6 +138,17 @@ class TransportMetrics:
         "malformed_frames", "connections_dropped", "handshake_rejected",
         "sync_requests", "sync_responses", "rejects_sent", "rejects_received",
         "trace_frames_sent", "trace_frames_received", "trace_ctxs_sent",
+        # ISSUE 17: sync paging + snapshot state transfer.  sync_batches /
+        # sync_bytes count SERVED SyncBatch replies and their decision
+        # payload bytes (the paging satellite's accounting); the snap_*
+        # counters meter the chunked snapshot RPC on both sides; and
+        # sync_poisoned counts inbound batches/snapshots REJECTED by the
+        # embedder's certificate verification (bumped by the app layer —
+        # the transport is payload-agnostic, the counter lives here so it
+        # rides the same transport_snapshot()/bench surface).
+        "sync_batches", "sync_bytes", "snap_requests", "snap_chunks_sent",
+        "snap_chunks_received", "snap_bytes_sent", "snap_bytes_received",
+        "sync_poisoned",
     )
 
     def __init__(self) -> None:
@@ -213,8 +239,20 @@ class SocketComm(Comm):
         self._req_hops: "OrderedDict[str, tuple[int, int]]" = OrderedDict()
         self.consensus = None
         #: multi-process sync server hook: (from_height) -> (decisions,
-        #: total_height) with decisions a list[framing.WireDecision]
+        #: total_height) with decisions a list[framing.WireDecision]; the
+        #: embedder should materialize at most MAX_SYNC_DECISIONS — the
+        #: transport additionally byte-budgets the reply under the frame
+        #: cap and pages the rest via continuation requests
         self.sync_server: Optional[Callable[[int], tuple[list, int]]] = None
+        #: snapshot state-transfer hook (ISSUE 17), duck-typed:
+        #:   describe() -> Optional[(height, total_bytes, digest)] — the
+        #:     snapshot currently on offer (None = no snapshot);
+        #:   read_chunk(height, offset, max_bytes) ->
+        #:     (total_bytes, data, last) — one bounded slice of the
+        #:     snapshot file at `height`; total_bytes == 0 means that
+        #:     snapshot is gone (superseded mid-transfer) and the
+        #:     requester must restart against the current offer.
+        self.snapshot_server = None
         #: optional embedder hook: (sender_id, framing.RejectFrame) called
         #: on every received FT_REJECT (the peer shed a request this node
         #: forwarded); the last few frames are kept in `rejects` either way
@@ -232,6 +270,7 @@ class SocketComm(Comm):
         self._reader_tasks: set[asyncio.Task] = set()
         self._inbound_writers: set[asyncio.StreamWriter] = set()
         self._sync_waiters: dict[int, asyncio.Future] = {}
+        self._snap_waiters: dict[int, asyncio.Future] = {}
         self._sync_nonce = 0
         self._started = False
         self._closing = False
@@ -342,6 +381,10 @@ class SocketComm(Comm):
             if not fut.done():
                 fut.cancel()
         self._sync_waiters.clear()
+        for fut in self._snap_waiters.values():
+            if not fut.done():
+                fut.cancel()
+        self._snap_waiters.clear()
         scheme, hostpath, _ = parse_addr(self.listen)
         if scheme == "uds":
             import os
@@ -745,6 +788,12 @@ class SocketComm(Comm):
                 elif ftype == FT_SYNC_RESP:
                     await self._flush_consensus(run)
                     self._resolve_sync(payload)
+                elif ftype == FT_SNAP_REQ:
+                    await self._flush_consensus(run)
+                    self._serve_snapshot(sender, payload)
+                elif ftype == FT_SNAP_RESP:
+                    await self._flush_consensus(run)
+                    self._resolve_snapshot(payload)
                 else:  # FT_HELLO after handshake: tolerated no-op
                     continue
             await self._flush_consensus(run)
@@ -828,13 +877,39 @@ class SocketComm(Comm):
         if self.sync_server is None:
             return
         decisions, total = self.sync_server(req.from_height)
+        # double cap: decision count AND encoded bytes under the frame
+        # cap.  At least one decision always ships (the loop's progress
+        # guarantee); an over-budget single decision still fits the frame
+        # because transport_max_frame_bytes exceeds any legal proposal by
+        # the validated envelope headroom.
+        budget = self.max_frame_bytes - FRAME_ENVELOPE_BYTES
+        picked: list = []
+        used = 0
+        for wd in decisions[:MAX_SYNC_DECISIONS]:
+            size = len(encode(wd))
+            if picked and used + size > budget:
+                break
+            picked.append(wd)
+            used += size
+        offer_height = offer_bytes = 0
+        offer_digest = b""
+        snap = self.snapshot_server
+        if snap is not None:
+            desc = snap.describe()
+            if desc is not None and desc[0] > req.from_height:
+                offer_height, offer_bytes, offer_digest = desc
         resp = SyncBatch(
             nonce=req.nonce,
             from_height=req.from_height,
             total_height=total,
-            decisions=decisions[:MAX_SYNC_DECISIONS],
+            decisions=picked,
+            snapshot_height=offer_height,
+            snapshot_bytes=offer_bytes,
+            snapshot_digest=offer_digest,
         )
         self._enqueue(sender, encode_frame(FT_SYNC_RESP, encode(resp)))
+        self.metrics.sync_batches += 1
+        self.metrics.sync_bytes += used
 
     def _resolve_sync(self, payload: bytes) -> None:
         resp = decode(SyncBatch, payload)  # CodecError -> drop conn (caller)
@@ -863,6 +938,95 @@ class SocketComm(Comm):
             return None
         finally:
             self._sync_waiters.pop(nonce, None)
+
+    # ------------------------------------------------------------ snapshot RPC
+
+    def _serve_snapshot(self, sender: int, payload: bytes) -> None:
+        req = decode(SnapshotFetchRequest, payload)  # CodecError -> drop conn
+        self.metrics.snap_requests += 1
+        snap = self.snapshot_server
+        if snap is None:
+            return
+        max_bytes = min(
+            req.max_bytes or self.max_frame_bytes,
+            self.max_frame_bytes - FRAME_ENVELOPE_BYTES,
+        )
+        total, data, last = snap.read_chunk(req.height, req.offset, max_bytes)
+        chunk = SnapshotChunk(
+            nonce=req.nonce,
+            height=req.height,
+            total_bytes=total,
+            offset=req.offset,
+            data=data,
+            last=last,
+        )
+        self._enqueue(sender, encode_frame(FT_SNAP_RESP, encode(chunk)))
+        self.metrics.snap_chunks_sent += 1
+        self.metrics.snap_bytes_sent += len(data)
+
+    def _resolve_snapshot(self, payload: bytes) -> None:
+        chunk = decode(SnapshotChunk, payload)  # CodecError -> drop conn
+        self.metrics.snap_chunks_received += 1
+        self.metrics.snap_bytes_received += len(chunk.data)
+        fut = self._snap_waiters.pop(chunk.nonce, None)
+        if fut is not None and not fut.done():
+            fut.set_result(chunk)
+
+    async def request_snapshot_chunk(
+        self, target: int, height: int, offset: int, max_bytes: int,
+        timeout: float = 2.0,
+    ) -> Optional[SnapshotChunk]:
+        """One chunk round trip; None on timeout / peer down."""
+        self._sync_nonce += 1
+        nonce = self._sync_nonce
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._snap_waiters[nonce] = fut
+        req = SnapshotFetchRequest(nonce=nonce, height=height,
+                                   offset=offset, max_bytes=max_bytes)
+        t0 = perf_counter()
+        self._enqueue(target, encode_frame(FT_SNAP_REQ, encode(req)))
+        try:
+            chunk = await asyncio.wait_for(fut, timeout)
+            self._note_rtt(target, perf_counter() - t0)
+            return chunk
+        except (asyncio.TimeoutError, asyncio.CancelledError):
+            return None
+        finally:
+            self._snap_waiters.pop(nonce, None)
+
+    async def fetch_snapshot(
+        self, target: int, height: int, *, chunk_bytes: int = 1024 * 1024,
+        timeout: float = 2.0,
+    ) -> Optional[bytes]:
+        """Fetch the peer's whole snapshot file at ``height``, chunk by
+        chunk under the frame cap.  A lost chunk (reconnect, timeout)
+        re-requests from the CURRENT offset — partial progress is kept in
+        memory only, so resume is just re-asking; ``SNAP_FETCH_RETRIES``
+        consecutive losses abandon the transfer.  None when the peer no
+        longer serves ``height`` (superseded mid-transfer: the caller
+        restarts against the peer's current offer) or on abandonment."""
+        buf = bytearray()
+        retries = 0
+        while True:
+            chunk = await self.request_snapshot_chunk(
+                target, height, len(buf), chunk_bytes, timeout
+            )
+            if chunk is None:
+                retries += 1
+                if retries > SNAP_FETCH_RETRIES:
+                    return None
+                continue  # resume: re-request the same offset
+            if chunk.total_bytes == 0:
+                return None  # snapshot gone on the responder
+            if chunk.offset != len(buf) or (not chunk.data and not chunk.last):
+                retries += 1  # stale chunk / empty non-final slice
+                if retries > SNAP_FETCH_RETRIES:
+                    return None
+                continue  # re-request the current offset
+            retries = 0
+            buf += chunk.data
+            if chunk.last or len(buf) >= chunk.total_bytes:
+                return bytes(buf)
 
     # ------------------------------------------------------------ RTT
 

@@ -53,7 +53,10 @@ __all__ = [
 #: units where a SMALLER value is better (latency-shaped, plus critpath
 #: segment shares — a segment REGAINING commit-path share is the round-18
 #: regression the commit-path guard rows exist to catch)
-LOWER_IS_BETTER_UNITS = {"ms", "us", "us/sig", "logical_ms", "s", "share"}
+#: ("x" is the ratio unit of the rejoin flatness guard — deep-history
+#: rejoin wall over shallow, where growing IS the regression)
+LOWER_IS_BETTER_UNITS = {"ms", "us", "us/sig", "logical_ms", "s", "share",
+                         "x"}
 
 #: host-weather fields carried into the baseline verbatim — the context a
 #: future reader needs to judge whether two rounds are comparable at all
@@ -67,6 +70,9 @@ WEATHER_FIELDS = ("launch_probe_ms", "baseline_launch_probe_ms", "cores",
 DEFAULT_THRESHOLD_PCT = 35.0
 FAMILY_THRESHOLD_PCT = {
     "tiny_logical_commit_ms": 100.0,
+    # pinned at the ideal 1.0: fail only when deep-history snapshot
+    # rejoin exceeds 2x the shallow one (the ISSUE 17 acceptance bound)
+    "rejoin_flatness_vs_depth": 100.0,
 }
 
 

@@ -26,8 +26,8 @@ from __future__ import annotations
 
 from typing import Optional
 
-__all__ = ["SCHEMA_VERSION", "ROW_SCHEMAS", "identify_row", "validate_row",
-           "validate_rows"]
+__all__ = ["SCHEMA_VERSION", "ROW_SCHEMAS", "assemble_rejoin_row",
+           "identify_row", "validate_row", "validate_rows"]
 
 #: bump when a row family's required shape changes incompatibly
 SCHEMA_VERSION = 1
@@ -210,6 +210,29 @@ ROW_SCHEMAS: dict = {
         "optional": {"loop_affinity": _STR, "goodput_per_sec": _NUM,
                      "p99_ms": _NUM, "beyond_sweep": (bool,)},
     },
+    # assemble_rejoin_row (ISSUE 17) — rejoin wall-clock + bytes at a
+    # given history depth, snapshot-install vs chain-replay control.
+    # The flat-vs-depth guard pins the deep-history snapshot row within
+    # 2x the shallow one (vs O(depth) for the replay control).
+    "rejoin_*": {
+        "required": {"metric": _STR, "value": _NUM, "unit": _STR,
+                     "history_decisions": _NUM, "mode": _STR,
+                     "bytes_transferred": _NUM},
+        "optional": {"decisions_replayed": _NUM, "snapshot_bytes": _NUM,
+                     "snap_chunks": _NUM, "requests": _NUM,
+                     "vs_small_history": _NUM, "interval": _NUM},
+    },
+    # bench.py rejoin_guard_rows (ISSUE 17) — deep-over-shallow snapshot
+    # rejoin wall ratio (unit "x", lower is better); the committed
+    # baseline pins the ideal 1.0 with a 100% allowance, encoding the
+    # acceptance bound "deep rejoin within 2x shallow" directly.  Listed
+    # as an EXACT family so it wins over the rejoin_* wildcard.
+    "rejoin_flatness_vs_depth": {
+        "required": {"metric": _STR, "value": _NUM, "unit": _STR,
+                     "history_small": _NUM, "history_deep": _NUM},
+        "optional": {"snapshot_small_s": _NUM, "snapshot_deep_s": _NUM,
+                     "replay_ratio": _NUM, "interval": _NUM},
+    },
     # obs.baseline.tiny_logical_row — the tier-1 regression-gate row
     # (value = mean logical commit latency; percentiles ride in "latency")
     "tiny_logical_commit_ms": {
@@ -219,6 +242,43 @@ ROW_SCHEMAS: dict = {
         "optional": {"nodes": _NUM, "seed": _NUM, "p50_ms": _NUM},
     },
 }
+
+
+def assemble_rejoin_row(*, history: int, mode: str, rejoin_s: float,
+                        bytes_transferred: int,
+                        decisions_replayed: Optional[int] = None,
+                        snapshot_bytes: Optional[int] = None,
+                        snap_chunks: Optional[int] = None,
+                        interval: Optional[int] = None,
+                        vs_small_history: Optional[float] = None) -> dict:
+    """The ``rejoin_*`` bench row (ISSUE 17), as a PURE function so the
+    tier-1 schema gate can validate synthetic rows without running the
+    bench.  ``mode`` is ``"snapshot"`` (offer + install + tail) or
+    ``"replay"`` (the full chain-replay control); ``vs_small_history``
+    is this row's wall-clock over the smallest swept history's — the
+    flat-vs-depth guard the baseline pins (snapshot mode must stay ~1.0
+    while the replay control grows with depth)."""
+    if mode not in ("snapshot", "replay"):
+        raise ValueError(f"mode must be 'snapshot' or 'replay', got {mode!r}")
+    row = {
+        "metric": f"rejoin_wall_s_h{int(history)}_{mode}",
+        "value": round(float(rejoin_s), 4),
+        "unit": "s",
+        "history_decisions": int(history),
+        "mode": mode,
+        "bytes_transferred": int(bytes_transferred),
+    }
+    if decisions_replayed is not None:
+        row["decisions_replayed"] = int(decisions_replayed)
+    if snapshot_bytes is not None:
+        row["snapshot_bytes"] = int(snapshot_bytes)
+    if snap_chunks is not None:
+        row["snap_chunks"] = int(snap_chunks)
+    if interval is not None:
+        row["interval"] = int(interval)
+    if vs_small_history is not None:
+        row["vs_small_history"] = round(float(vs_small_history), 4)
+    return row
 
 
 def identify_row(row: dict) -> Optional[str]:

@@ -51,6 +51,7 @@ __all__ = [
     "pool_signal_source",
     "coalescer_signal_source",
     "wal_signal_source",
+    "snapshot_signal_source",
     "latency_signal_source",
     "EventLatch",
 ]
@@ -191,6 +192,28 @@ def wal_signal_source(wal) -> Callable:
         if fsync.get("count"):
             return {"wal.fsync_p99_ms": float(fsync.get("p99_ms", 0.0))}
         return {}
+
+    return signals
+
+
+def snapshot_signal_source(disk_fn: Callable[[], dict]) -> Callable:
+    """``snapshot.lag_intervals`` from an embedder's disk snapshot dict
+    (``ReplicaApp.disk_snapshot`` / ``testing.app.App.disk_snapshot``):
+    decisions committed since the last snapshot, normalized by the
+    configured interval so the SLO bound is static across deployments.
+    Emits nothing when snapshots are disabled (interval 0) — an absent
+    signal never breaches, matching the spec's opt-in contract."""
+
+    def signals() -> dict:
+        try:
+            disk = disk_fn() or {}
+        except Exception:  # noqa: BLE001 — telemetry only
+            return {}
+        interval = disk.get("snapshot_interval", 0) or 0
+        if interval <= 0:
+            return {}
+        age = disk.get("snapshot_age_decisions", 0) or 0
+        return {"snapshot.lag_intervals": float(age) / float(interval)}
 
     return signals
 

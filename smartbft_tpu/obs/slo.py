@@ -209,6 +209,17 @@ def default_slo_spec(*, fast_window_s: float = 5.0,
             bound=250.0, critical_bound=2000.0, kind="ceiling", **w,
             description="group-commit fsync p99 (the durability budget)",
         ),
+        SLORule(
+            name="snapshot.lag_intervals",
+            signal="snapshot.lag_intervals",
+            bound=3.0, critical_bound=10.0, kind="ceiling", budget=0.2, **w,
+            description="decisions since the last snapshot, in units of the "
+                        "configured snapshot interval (ISSUE 17: the "
+                        "disk-bound objective — a stuck capture loop lets "
+                        "the ledger/WAL prefix grow without bound; only "
+                        "emitted when snapshots are enabled, so replicas "
+                        "running without compaction never breach it)",
+        ),
     ))
 
 

@@ -146,6 +146,23 @@ class ShardHandle(abc.ABC):
         occ = self.pool_occupancy() or {}
         return int(occ.get("waiters", 0))
 
+    # -- snapshot handoff surface (optional; ISSUE 17) ---------------------
+
+    def capture_snapshot(self) -> Optional[dict]:
+        """Donor side of the scale-out handoff: a JSON-able application
+        snapshot of this shard's committed state (chained digests,
+        committed count, recent request ids).  None = unsupported — new
+        groups then start fresh, the pre-snapshot behavior."""
+        return None
+
+    def install_snapshot(self, snapshot: dict) -> None:
+        """Receiver side: seed this NOT-YET-STARTED group from a donor's
+        :meth:`capture_snapshot` so scale-out is O(1) in the donor's
+        history (dedup memory armed, digests chained)."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not accept snapshot handoff"
+        )
+
 
 @dataclass
 class _Transition:
@@ -623,6 +640,7 @@ class ShardSet:
                          deadline=deadline)
         self._transition = tr
         new_handles: dict[int, object] = {}
+        handoffs: dict[int, Optional[int]] = {}
         flipped = False
         try:
             for sid in range(s_old, s_new):
@@ -631,6 +649,27 @@ class ShardSet:
                 # (start raised halfway) must still be stopped by the
                 # abort cleanup, not leak its tasks/registrations
                 new_handles[sid] = h
+                # snapshot-based handoff (ISSUE 17): seed the new group
+                # from a donor's application snapshot BEFORE it starts —
+                # scale-out is then O(1) in the donor's history instead
+                # of starting fresh.  Donor choice is deterministic
+                # (sid % s_old); a handle pair that does not support the
+                # surface (capture returns None) keeps the fresh start.
+                donor_sid = sid % s_old
+                donor = self.shards.get(donor_sid)
+                snap = donor.capture_snapshot() if donor is not None \
+                    else None
+                if snap is not None:
+                    h.install_snapshot(snap)
+                    handoffs[sid] = donor_sid
+                    if self.recorder.enabled:
+                        self.recorder.record(
+                            "ctl.reshard_handoff", epoch=epoch,
+                            seq=int(snap.get("height", 0)),
+                            extra={"to": sid, "from": donor_sid},
+                        )
+                else:
+                    handoffs[sid] = None
                 await h.start()
                 # visible to polling immediately (it commits nothing until
                 # the flip routes clients to it), so the flip itself stays
@@ -693,6 +732,9 @@ class ShardSet:
                     (time.monotonic() - tr.started) * 1e3, 2
                 ),
                 "parked_submits_peak": tr.parked_peak,
+                # scale-out handoff provenance: new shard -> donor shard
+                # (None = fresh start; {} on scale-in)
+                "handoffs": handoffs,
             }
             self.reshard_stats["transitions"] += 1
             self.reshard_stats["last"] = summary

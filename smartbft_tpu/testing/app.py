@@ -206,6 +206,14 @@ class App(Application, Assembler, Comm, Signer, Verifier, RequestInspector,
         self.verification_seq = 0
         self.delay_sync_by: float = 0.0
         self.membership_changed = False
+        # snapshot handoff provenance (ISSUE 17): a node seeded from a
+        # donor shard's snapshot starts with the donor's chained digests
+        # and committed-request count instead of replaying its history
+        self.base_height = 0
+        self.base_digest = ""
+        self.base_ids_digest = ""
+        self.base_request_count = 0
+        self.base_recent_ids: list[str] = []
         self.consensus: Optional[Consensus] = None
         self._wal = None
         # transport seam: either the in-process Network (default) or a real
@@ -503,10 +511,12 @@ class App(Application, Assembler, Comm, Signer, Verifier, RequestInspector,
             self.comm.attach(self.consensus)
             await self.comm.start()
             await self.consensus.start()
+            self._seed_pool_dedup()
             return
         self.node.consensus = self.consensus
         self.node.start()
         await self.consensus.start()
+        self._seed_pool_dedup()
 
     async def stop(self) -> None:
         if self.consensus is not None:
@@ -563,6 +573,77 @@ class App(Application, Assembler, Comm, Signer, Verifier, RequestInspector,
 
     def height(self) -> int:
         return self.shared.height(self.id)
+
+    # -- snapshot handoff (ISSUE 17) ---------------------------------------
+
+    def capture_snapshot(self) -> dict:
+        """Chained application snapshot of this node's committed state —
+        the in-process twin of ``smartbft_tpu.snapshot``'s capture: the
+        chain digest and request-id digest fold over any installed base
+        first, so snapshots CHAIN across repeated handoffs and two nodes
+        with the same committed history produce identical digests no
+        matter how many snapshot installs either went through."""
+        from ..snapshot import (
+            CHAIN_SEED,
+            RECENT_IDS_CAP,
+            chain_update,
+            fold_ids,
+        )
+
+        chain = (bytes.fromhex(self.base_digest)
+                 if self.base_digest else CHAIN_SEED)
+        ids_digest = (bytes.fromhex(self.base_ids_digest)
+                      if self.base_ids_digest else CHAIN_SEED)
+        count = self.base_request_count
+        recent = list(self.base_recent_ids)
+        ledger = self.ledger()
+        for d in ledger:
+            chain = chain_update(chain, d.proposal.payload,
+                                 d.proposal.metadata)
+            try:
+                ids = [str(i) for i in
+                       self.requests_from_proposal(d.proposal)]
+            except Exception:  # noqa: BLE001 — foreign payload shape
+                ids = []
+            ids_digest = fold_ids(ids_digest, ids)
+            count += len(ids)
+            recent.extend(ids)
+        return {
+            "height": self.base_height + len(ledger),
+            "chain_digest": chain.hex(),
+            "ids_digest": ids_digest.hex(),
+            "request_count": count,
+            "recent_ids": recent[-RECENT_IDS_CAP:],
+        }
+
+    def install_base_state(self, snapshot: dict) -> None:
+        """Seed this NOT-YET-STARTED node from a donor's
+        :meth:`capture_snapshot` — the receiver half of the scale-out
+        handoff.  The donor's recent request ids arm the pool's dedup
+        memory at :meth:`start`, so a client resubmitting a request the
+        donor already committed is refused instead of double-delivered."""
+        if self.consensus is not None:
+            raise RuntimeError(
+                f"node {self.id}: install_base_state on a started node"
+            )
+        self.base_height = int(snapshot.get("height", 0))
+        self.base_digest = str(snapshot.get("chain_digest", ""))
+        self.base_ids_digest = str(snapshot.get("ids_digest", ""))
+        self.base_request_count = int(snapshot.get("request_count", 0))
+        self.base_recent_ids = [str(r) for r in
+                                snapshot.get("recent_ids", [])]
+
+    def _seed_pool_dedup(self) -> None:
+        pool = getattr(self.consensus, "pool", None)
+        if pool is None or not self.base_recent_ids \
+                or not hasattr(pool, "seed_processed"):
+            return
+        infos = []
+        for rid in self.base_recent_ids:
+            client, sep, req = rid.partition(":")
+            if sep:
+                infos.append(RequestInfo(client_id=client, request_id=req))
+        pool.seed_processed(infos)
 
 
 async def wait_for(predicate, scheduler: Scheduler, timeout: float = 30.0, step: float = 0.05):

@@ -157,6 +157,22 @@ class ChaosEvent:
       actually in flight, so the crash always lands mid-drain/mid-flip;
     - ``crash`` / ``restart`` with ``shard`` set: the plain pair, scoped
       to one group.
+
+    Snapshot actions (socket-level only — consumed by
+    :func:`~smartbft_tpu.net.cluster.run_socket_schedule` against a
+    ``SocketCluster`` built with ``snapshot_interval_decisions > 0``):
+
+    - ``crash_during_snapshot``: wait (bounded by ``fraction`` seconds,
+      default 10) for the node's NEXT snapshot capture to land, then
+      SIGKILL immediately — the process dies with the fresh snapshot on
+      disk and the ledger-compaction/offer plumbing interrupted at an
+      arbitrary point; recovery must reconcile.  The deterministic crash
+      points (between snapshot write and ledger truncate, torn files,
+      mid-chunk) are pinned by the ``tests/test_snapshot.py`` unit tests;
+      :func:`~smartbft_tpu.net.cluster.run_snapshot_rejoin` is the
+      snapshot-safe end-to-end runner (``run_socket_schedule``'s
+      ``committed_ids`` resubmission oracle sees only the post-horizon
+      suffix once a replica compacts).
     """
 
     at: float
@@ -1674,11 +1690,25 @@ def main(argv: Optional[list[str]] = None) -> int:
     )
     ap.add_argument(
         "--transport", default="uds", choices=("uds", "tcp"),
-        help="--sockets transport flavor",
+        help="--sockets / --snapshots transport flavor",
+    )
+    ap.add_argument(
+        "--snapshots", action="store_true",
+        help="run the truncating soak at the SOCKET level (ISSUE 17): "
+             "kill-rejoin must come back via snapshot install (the donors "
+             "have compacted past the victim's crash height), "
+             "crash_during_snapshot races a capture with SIGKILL, a donor "
+             "dies mid-chunk; disk stays bounded, no poisoning, fork-free",
     )
     args = ap.parse_args(argv)
     if not args.soak:
         ap.error("nothing to do: pass --soak")
+    if args.snapshots:
+        from ..net.cluster import snapshot_soak
+
+        snapshot_soak(rounds=args.rounds, transport=args.transport)
+        print("chaos soak (snapshots): all rounds passed")
+        return 0
     if args.sockets:
         from ..net.cluster import socket_soak
 

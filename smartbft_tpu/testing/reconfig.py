@@ -80,6 +80,8 @@ _INT_FIELDS = (
     "autoscale_min_shards",
     "autoscale_max_shards",
     "flip_drain_windows",
+    "snapshot_interval_decisions",
+    "snapshot_chunk_bytes",
 )
 
 # transport_listen is deliberately NOT mirrored: like self_id it is a
@@ -121,6 +123,8 @@ class ConfigMirror:
     autoscale_min_shards: int = 1
     autoscale_max_shards: int = 8
     flip_drain_windows: int = 4
+    snapshot_interval_decisions: int = 0
+    snapshot_chunk_bytes: int = 1024 * 1024
     autoscale_high_occupancy_bp: int = 8500
     autoscale_low_occupancy_bp: int = 1500
     admission_high_water_bp: int = 10000

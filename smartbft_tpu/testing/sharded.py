@@ -254,6 +254,25 @@ class AppShard(ShardHandle):
                 f"{apps[0].id} and node {a.id}"
             )
 
+    # -- snapshot handoff (ISSUE 17) ----------------------------------------
+
+    def capture_snapshot(self) -> Optional[dict]:
+        """Donor side of the scale-out handoff: the probe app's chained
+        application snapshot (None when no replica is live)."""
+        try:
+            return self.probe_app().capture_snapshot()
+        except RuntimeError:
+            return None
+
+    def install_snapshot(self, snapshot: dict) -> None:
+        """Receiver side: seed every (not-yet-started) replica of this
+        NEW group from a donor snapshot — the group starts with the
+        donor's digests, committed count, and dedup memory instead of
+        fresh, O(1) in the donor's history."""
+        self.handoff_base = dict(snapshot)
+        for a in self.apps:
+            a.install_base_state(snapshot)
+
     # -- fault injection ----------------------------------------------------
 
     def mute_leader(self) -> int:

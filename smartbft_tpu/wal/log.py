@@ -384,6 +384,52 @@ class WriteAheadLogFile(WriteAheadLog):
         (writeaheadlog.go:381-394)."""
         self._append_record(LogRecord(type=CONTROL, truncate_to=True, data=b""))
 
+    def drop_stale_segments(self) -> int:
+        """Immediately delete files wholly behind the truncation point
+        (ISSUE 17 compaction).  Rotation already prunes them lazily
+        (:meth:`_open_next_file`); the snapshot flow calls this EAGERLY
+        after anchoring, so disk stays bounded by the snapshot interval
+        instead of the 64 MiB rotation cadence.  The truncation point is
+        keyed on the anchored sequence by construction: PersistedState
+        marks ``truncate_to`` on every ProposedRecord, so every segment
+        below ``_truncate_index`` holds only records the snapshot's
+        anchor certificate already covers.  Returns files deleted."""
+        with self._lock:
+            if self._closed or self._read_mode:
+                return 0
+            removed = 0
+            keep = []
+            for idx in self._active_indexes:
+                if idx < self._truncate_index and idx != self._index:
+                    try:
+                        os.remove(os.path.join(self._dir, _file_name(idx)))
+                        removed += 1
+                        self._log.debugf("Deleted log file: %s",
+                                         _file_name(idx))
+                    except OSError:
+                        keep.append(idx)
+                else:
+                    keep.append(idx)
+            self._active_indexes = keep
+            self._metrics.count_of_files.set(len(keep))
+            if removed:
+                _fsync_dir(self._dir)
+            return removed
+
+    def disk_bytes(self) -> int:
+        """Total bytes of the live WAL segments — the disk-bound gauge
+        (``wal.disk_bytes``) the ISSUE 17 SLO watches for unbounded
+        growth."""
+        with self._lock:
+            indexes = list(self._active_indexes)
+        total = 0
+        for idx in indexes:
+            try:
+                total += os.path.getsize(os.path.join(self._dir, _file_name(idx)))
+            except OSError:
+                pass
+        return total
+
     def crc(self) -> int:
         with self._lock:
             return self._crc
