@@ -861,6 +861,62 @@ def rejoin_bench() -> None:
         _emit(guard_row)
 
 
+def assemble_byzantine_row(healthy: dict, degraded: dict) -> dict:
+    """Fold the paired Byzantine latency probes (no actor vs an active
+    vote-forgery flood, SAME cluster + open-loop load) into the ONE
+    ``--byzantine`` degraded-mode row.  Pure function, importable — the
+    schema drift gate pins the ``byzantine_forge_p99_ms`` family through
+    it.  The row's value is the honest-path request p99 WITH the forger
+    flooding; ``healthy_p99_ms``/``vs_healthy`` carry the no-actor
+    control so the baseline can bound the forger's latency tax."""
+    h_lat = healthy.get("latency") or {}
+    d_lat = degraded.get("latency") or {}
+    h99, d99 = h_lat.get("p99_ms"), d_lat.get("p99_ms")
+    if not isinstance(d99, (int, float)) or not isinstance(h99, (int, float)):
+        raise RuntimeError(
+            f"byzantine probes resolved no p99 (healthy={h99!r}, "
+            f"degraded={d99!r}) — no spike request ever committed"
+        )
+    row = {
+        "metric": "byzantine_forge_p99_ms",
+        "value": round(float(d99), 3),
+        "unit": "ms",
+        "healthy_p99_ms": round(float(h99), 3),
+        "forged": degraded.get("forged"),
+        "shun_events": degraded.get("shun_events"),
+        "shed_votes": degraded.get("shed_votes"),
+        "spike_acked": degraded.get("spike_acked"),
+        "healthy_spike_acked": healthy.get("spike_acked"),
+        "latency": d_lat,
+        "healthy_latency": h_lat,
+    }
+    if h99:
+        row["vs_healthy"] = round(float(d99) / float(h99), 2)
+    return row
+
+
+def byzantine_bench() -> None:
+    """Run the paired Byzantine degraded-mode probes (ISSUE 18): open-
+    loop arrivals against the n=4 forgery-rejecting toy-crypto cluster,
+    once clean and once with an f=1 actor flooding forged votes at the
+    shared verify plane.  The emitted row bounds what the flood costs
+    HONEST clients once the per-sender accounting shuns and sheds the
+    forger — the longitudinal pin that the defense keeps working."""
+    import asyncio
+
+    from smartbft_tpu.testing.chaos import byzantine_latency_probe
+
+    rate = float(os.environ.get("SMARTBFT_BENCH_BYZ_RATE", "30"))
+
+    async def paired():
+        healthy = await byzantine_latency_probe(forge=False, rate=rate)
+        degraded = await byzantine_latency_probe(forge=True, rate=rate)
+        return healthy, degraded
+
+    healthy, degraded = asyncio.run(paired())
+    _emit(assemble_byzantine_row(healthy, degraded))
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument(
@@ -904,6 +960,15 @@ def main() -> None:
              "bytes at shallow vs deep decision history "
              "(SMARTBFT_BENCH_REJOIN_HISTORIES, default 100,100000), "
              "emitting `rejoin_*` rows plus the flat-vs-depth guard row",
+    )
+    ap.add_argument(
+        "--byzantine", action="store_true",
+        default=os.environ.get("SMARTBFT_BENCH_BYZANTINE", "") == "1",
+        help="additionally run the Byzantine degraded-mode probe "
+             "(testing.chaos.byzantine_latency_probe): honest-path "
+             "request p99 under an active vote-forgery flood vs the same "
+             "cluster's no-actor control, emitting the "
+             "byzantine_forge_p99_ms row the baseline bounds",
     )
     ap.add_argument(
         "--check-baseline", nargs="?", const="BASELINE_OBS.json",
@@ -956,6 +1021,12 @@ def main() -> None:
             rejoin_bench()
         except Exception as exc:  # noqa: BLE001 — rejoin row is additive
             _log(f"bench: rejoin bench failed ({type(exc).__name__}: {exc})")
+
+    if args.byzantine:
+        try:
+            byzantine_bench()
+        except Exception as exc:  # noqa: BLE001 — byzantine row is additive
+            _log(f"bench: byzantine probe failed ({type(exc).__name__}: {exc})")
 
     if os.environ.get("SMARTBFT_BENCH_E2E", "1") == "1":
         try:

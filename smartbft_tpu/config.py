@@ -275,6 +275,26 @@ class Configuration:
     verify_mesh_topology: str = "1d"
     verify_flush_hold: float = 0.0
 
+    # Per-sender misbehavior accounting (ISSUE 18 — no reference
+    # counterpart: the reference drops an invalid vote and forgets who
+    # sent it).  Every cryptographically provable invalid verdict
+    # (bad signature value, digest-binding forgery, unknown signer) is
+    # attributed to its signer in a node-LOCAL MisbehaviorTable; a sender
+    # whose decayed score crosses the threshold is shunned — its
+    # Prepare/Commit votes are dropped at intake BEFORE reaching the
+    # verify plane (a vote-forgery flood stops costing device launches)
+    # and its forwarded client requests lose the admission-gate bypass.
+    # Local-only by design: the shared window-boundary blacklist stays a
+    # pure function of replicated view-change evidence.
+    # - misbehavior_shun_threshold: provable-invalid score at which a
+    #   sender is shunned (honest senders score ~0; an honest replica's
+    #   votes simply verify).
+    # - misbehavior_decay_interval: seconds between score-halving ticks —
+    #   the redemption path: a sender that stops forging drains below
+    #   half the threshold and is released.
+    misbehavior_shun_threshold: int = 8
+    misbehavior_decay_interval: float = 30.0
+
     # Real-socket transport (smartbft_tpu/net/ — no reference counterpart:
     # the reference is a library whose embedder supplies Comm; these knobs
     # configure the transport we ship).  Consumed by SocketComm.from_config
@@ -435,6 +455,17 @@ class Configuration:
             raise ConfigError(
                 "verify_flush_hold should not be negative "
                 "(0 disables occupancy-aware flush gating)"
+            )
+        if self.misbehavior_shun_threshold < 1:
+            raise ConfigError(
+                "misbehavior_shun_threshold should be at least 1, got "
+                f"{self.misbehavior_shun_threshold}"
+            )
+        if self.misbehavior_decay_interval <= 0:
+            raise ConfigError(
+                "misbehavior_decay_interval should be positive (the decay "
+                "tick is also the shun-release/redemption path), got "
+                f"{self.misbehavior_decay_interval}"
             )
         if self.snapshot_interval_decisions < 0:
             raise ConfigError(

@@ -22,6 +22,7 @@ from .config import Configuration
 from .core.batcher import BatchBuilder
 from .core.controller import Controller
 from .core.heartbeat import FOLLOWER, LEADER, HeartbeatMonitor
+from .core.misbehavior import MisbehaviorTable
 from .core.pool import Pool, PoolOptions
 from .core.proposer import ProposalMaker
 from .core.state import PersistedState
@@ -108,6 +109,17 @@ class Consensus:
         self.vc_phases = ViewChangePhaseTracker(
             clock=self.scheduler.now, node=f"n{config.self_id}",
             recorder=self.recorder, metrics=self.metrics.view_change,
+        )
+        # per-sender misbehavior accounting (ISSUE 18): node-LOCAL — fed
+        # by the verifier's per-signer invalid-verdict attribution
+        # (configure_misbehavior seam), read by the Controller to shed
+        # shunned senders' votes at intake and revoke their forwarded-
+        # request admission bypass; decayed on a ticker (redemption).
+        self.misbehavior = MisbehaviorTable(
+            self_id=config.self_id,
+            shun_threshold=config.misbehavior_shun_threshold,
+            logger=logger,
+            recorder=self.recorder,
         )
         self._own_scheduler = scheduler is None
         self._clock_driver: Optional[WallClockDriver] = None
@@ -197,6 +209,16 @@ class Consensus:
                 )
             except Exception as e:  # noqa: BLE001 — wiring must not kill start
                 self.logger.warnf("verify-plane fault wiring failed: %r", e)
+        # per-sender misbehavior accounting (ISSUE 18): verifiers with the
+        # seam feed every per-signer invalid verdict into this node's
+        # MisbehaviorTable; verifiers without it stay attribution-only.
+        configure_misbehavior = getattr(
+            self.verifier, "configure_misbehavior", None)
+        if configure_misbehavior is not None:
+            try:
+                configure_misbehavior(self.misbehavior)
+            except Exception as e:  # noqa: BLE001 — wiring must not kill start
+                self.logger.warnf("misbehavior-table wiring failed: %r", e)
         # occupancy-aware flush gating (verify_flush_hold): wired before
         # the mesh so a graduated engine's first waves already gate.
         # configure_hold keeps explicit constructor holds (the shared-
@@ -427,6 +449,13 @@ class Consensus:
             raise RuntimeError("no leader")
         await self.controller.submit_request(req, forwarded=internal)
 
+    def misbehavior_snapshot(self) -> dict:
+        """This node's per-sender misbehavior accounting (ISSUE 18):
+        lifetime cause counts, decayed shun scores, the current shun set,
+        intake sheds, and shared-blacklist corroborations — read by the
+        chaos oracles and the bench `byzantine` row."""
+        return self.misbehavior.snapshot()
+
     def pool_occupancy(self) -> dict:
         """This node's request-pool backpressure snapshot (empty before
         start).  The sharded front door (shard.ShardSet) reads this from
@@ -540,6 +569,9 @@ class Consensus:
             # the commit inter-arrival EWMA lives in scheduler time — the
             # same domain as the heartbeat/complain timers it feeds
             clock=self.scheduler.now,
+            # intake-side shun enforcement (ISSUE 18): survives reconfig
+            # rebuilds because the table lives on the facade
+            misbehavior=self.misbehavior,
         )
         # ViewChanger wiring (consensus.go:445-450,466-470)
         self.view_changer.application = self.controller.deliver
@@ -703,6 +735,13 @@ class Consensus:
         self._tickers.append(
             Ticker(self.scheduler, self.viewchanger_tick_interval,
                    lambda: self.view_changer.tick(self.scheduler.now()))
+        )
+        self._tickers.append(
+            # misbehavior decay (ISSUE 18): halve per-sender provable
+            # scores on a fixed cadence — the redemption path that
+            # releases shunned senders once they stop forging
+            Ticker(self.scheduler, self.config.misbehavior_decay_interval,
+                   lambda: self.misbehavior.decay())
         )
         self._tickers.append(
             # ADAPTIVE cadence (ISSUE 15): the monitor's check interval

@@ -133,6 +133,7 @@ class Controller(RequestTimeoutHandler):
         recorder=None,
         vc_phases=None,
         clock=None,
+        misbehavior=None,
     ):
         self.id = self_id
         self.n = n
@@ -170,6 +171,12 @@ class Controller(RequestTimeoutHandler):
         #: obs.ViewChangePhaseTracker — the first delivery in a new view
         #: closes an open view-change round's `first_commit` phase
         self.vc_phases = vc_phases
+        #: core.misbehavior.MisbehaviorTable (ISSUE 18) or None: shunned
+        #: senders' votes are dropped at intake (a vote-forgery flood
+        #: stops costing verify-plane launches) and their forwarded
+        #: requests lose the admission-gate bypass
+        self.misbehavior = misbehavior
+        self._shunned_drops = 0  # throttled warn counter (intake shed)
 
         self.quorum = 0
         self.curr_view = None
@@ -333,8 +340,16 @@ class Controller(RequestTimeoutHandler):
         except Exception as e:
             self.logger.warnf("Got bad request from %d: %s", sender, e)
             return None
+        # shunned forwarders lose the admission-gate bypass (ISSUE 18):
+        # forwarded=True exists because an honest follower's forward
+        # already holds a pool slot cluster-side — a sender this node has
+        # caught forging votes gets no such credit, so its submissions
+        # compete through the front-door gate and are shed FIRST under
+        # overload while honest shards keep their SLO
+        forwarded = not (self.misbehavior is not None
+                         and self.misbehavior.is_shunned(sender))
         try:
-            await self.submit_request(req, forwarded=True)
+            await self.submit_request(req, forwarded=forwarded)
         except Exception as e:
             # the reference warns on forwarded-submit failure too
             # (controller.go:258-263); a full pool here is routine under
@@ -397,6 +412,32 @@ class Controller(RequestTimeoutHandler):
 
     # ------------------------------------------------------------------ routing
 
+    def _intake_filter(self, sender: int, m: Message) -> bool:
+        """Misbehavior gate for the PrePrepare/Prepare/Commit intake
+        (ISSUE 18) — True means DROP.  Only Prepare/Commit votes from
+        locally shunned senders are shed: PrePrepares, view-change
+        traffic, and heartbeats always pass, so the liveness machinery
+        that produces SHARED evidence against a bad leader keeps running
+        even when this node has privately written the sender off.  A
+        stale-view message is counted observationally (never shuns —
+        honest replicas racing a view change emit them) and still flows
+        to the view, whose own view gating drops it pre-verification."""
+        mb = self.misbehavior
+        if mb is None:
+            return False
+        if isinstance(m, (Prepare, Commit)) and mb.is_shunned(sender):
+            mb.note_shed(sender)
+            self._shunned_drops += 1
+            if self._shunned_drops == 1 or self._shunned_drops % 1000 == 0:
+                self.logger.warnf(
+                    "Dropping vote from shunned sender %d at intake "
+                    "(%d sheds so far)", sender, self._shunned_drops,
+                )
+            return True
+        if view_number_of_msg(m) < self.curr_view_number:
+            mb.note(sender, "stale_view")
+        return False
+
     def _route_view_message_tail(self, sender: int, m: Message) -> None:
         """Shared tail of pre-prepare/prepare/commit routing: view-change
         evidence fan-out + artificial leader heartbeat (both intakes)."""
@@ -411,6 +452,8 @@ class Controller(RequestTimeoutHandler):
     def process_messages(self, sender: int, m: Message) -> None:
         """Dispatch inbound consensus messages (controller.go:321-344)."""
         if isinstance(m, (PrePrepare, Prepare, Commit)):
+            if self._intake_filter(sender, m):
+                return
             if self.curr_view is not None:
                 self.curr_view.handle_message(sender, m)
             self._route_view_message_tail(sender, m)
@@ -432,6 +475,8 @@ class Controller(RequestTimeoutHandler):
         View/ViewChanger intake may suspend the sending task on a full
         inbox; every other route is synchronous."""
         if isinstance(m, (PrePrepare, Prepare, Commit)):
+            if self._intake_filter(sender, m):
+                return
             if self.curr_view is not None:
                 intake = getattr(self.curr_view, "handle_message_async", None)
                 if intake is not None:
@@ -502,7 +547,8 @@ class Controller(RequestTimeoutHandler):
         run: list = []
         for sender, m in items:
             if isinstance(m, (PrePrepare, Prepare, Commit)):
-                run.append((sender, m))
+                if not self._intake_filter(sender, m):
+                    run.append((sender, m))
                 continue
             self._flush_view_run(run)
             self.process_messages(sender, m)
@@ -513,7 +559,8 @@ class Controller(RequestTimeoutHandler):
         run: list = []
         for sender, m in items:
             if isinstance(m, (PrePrepare, Prepare, Commit)):
-                run.append((sender, m))
+                if not self._intake_filter(sender, m):
+                    run.append((sender, m))
                 continue
             await self._flush_view_run_async(run)
             await self.process_messages_async(sender, m)
@@ -832,6 +879,11 @@ class Controller(RequestTimeoutHandler):
         view has drained (its propose gate confines the window to the
         delivery frontier's window), and no in-flight sequence above the
         anchor can hold a commit quorum when the view is torn down."""
+        if blacklist and self.misbehavior is not None:
+            # corroboration accounting (ISSUE 18): the SHARED deterministic
+            # blacklist named these nodes — record which of them this
+            # node's local misbehavior table had independently suspected
+            self.misbehavior.note_blacklisted(blacklist)
         view = self.curr_view_number
         dec = self.curr_decisions_in_view
         curr_leader = get_leader_id(

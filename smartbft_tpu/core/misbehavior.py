@@ -1,0 +1,217 @@
+"""Per-sender misbehavior accounting: the defense substrate for ISSUE 18.
+
+PBFT tolerates f lying replicas, but *tolerating* is not *free*: every
+forged Prepare/Commit a Byzantine sender pushes at the shared verify plane
+costs real device launch capacity (the amplification attack the coalescer
+invites — Mir-BFT's request-duplication flood, aimed at signatures), and
+until this table existed a failed verify verdict vanished into an
+aggregate failure count nobody could act on.
+
+:class:`MisbehaviorTable` turns per-signer verify attribution (the
+``crypto.provider`` paths now report WHO signed every invalid verdict)
+into a local defense decision:
+
+* **accounting** — per-sender counters by cause, exported via
+  :meth:`snapshot` (bench `byzantine` rows, chaos oracles) and mirrored
+  into the embedder's metrics by the provider;
+* **shunning** — a sender whose *cryptographically provable* misbehavior
+  (invalid signature values, digest-binding forgeries, unknown-signer
+  claims) crosses ``shun_threshold`` within a decay window is locally
+  shunned: the Controller drops its Prepare/Commit votes at intake
+  (BEFORE they reach the verify plane, so the flood stops costing
+  launches) and its forwarded client requests lose the PR 8
+  admission-gate bypass (forgers are shed first under overload);
+* **redemption** — :meth:`decay` halves every score (the Consensus facade
+  ticks it), so a sender that stops misbehaving drains back below the
+  release threshold and is un-shunned: transient key-rollover mishaps do
+  not amount to a permanent local partition.
+
+What shunning deliberately does NOT do: touch the deterministic
+window-boundary blacklist (``core.util.compute_blacklist_update``).  That
+blacklist is recomputed identically by every replica from *shared*
+view-change evidence; feeding node-local observations into it would fork
+the computation.  The two layers compose instead: equivocating leaders
+land on the shared blacklist via the view changes they cause, while vote
+forgers — who never need to be leader to burn launch capacity — are cut
+off locally by this table.  :meth:`note_blacklisted` records when the
+shared blacklist corroborates a local suspect (the ``corroborated``
+counter chaos oracles read).
+
+Only provable causes count toward shunning.  Observational causes
+(``stale_view`` replays, wrong-digest votes) are counted for visibility
+but never shun: an honest replica racing a view change emits both.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+__all__ = ["MisbehaviorTable", "PROVABLE_CAUSES", "OBSERVED_CAUSES"]
+
+#: causes that are cryptographically attributable to the sender — no
+#: third party can make an honest node emit one (signatures don't forge)
+PROVABLE_CAUSES = frozenset({
+    "invalid_sig",       # well-formed vote, signature value fails the engine
+    "binding_mismatch",  # signed ConsenterSigMsg binds a foreign digest
+    "unknown_signer",    # claims a signer id outside the membership
+})
+
+#: causes an honest sender can exhibit under races/faults — counted for
+#: the operator, never fed into the shun score
+OBSERVED_CAUSES = frozenset({
+    "stale_view",        # replayed message from a view this node left
+    "sync_poisoned",     # tampered sync material (net-layer attribution)
+})
+
+
+class MisbehaviorTable:
+    """Per-sender misbehavior scores with threshold shunning and decay.
+
+    Thread-safe: verify attribution arrives from coalescer worker threads
+    (the sync provider paths) while intake shedding reads ``is_shunned``
+    on the event loop.  The shunned set is mirrored into a lock-free
+    frozenset so the hot intake path costs one attribute read + one set
+    membership test.
+    """
+
+    def __init__(self, *, self_id: int = 0, shun_threshold: int = 8,
+                 release_threshold: Optional[int] = None,
+                 logger=None, recorder=None, metrics=None):
+        """``shun_threshold``: provable-cause score at which a sender is
+        shunned (8 = far above anything honest: an honest replica's votes
+        simply verify).  ``release_threshold``: decayed score at which a
+        shunned sender is released (default half the shun threshold —
+        hysteresis against flapping at the boundary)."""
+        if shun_threshold < 1:
+            raise ValueError(f"shun_threshold must be >= 1, got {shun_threshold}")
+        self.self_id = self_id
+        self.shun_threshold = shun_threshold
+        self.release_threshold = (
+            release_threshold if release_threshold is not None
+            else max(1, shun_threshold // 2)
+        )
+        if self.release_threshold >= shun_threshold:
+            raise ValueError("release_threshold must be below shun_threshold")
+        self.logger = logger
+        self.recorder = recorder
+        self.metrics = metrics  # BlacklistMetrics-shaped or None
+        self._lock = threading.Lock()
+        #: sender -> cause -> lifetime count (never decays; the export)
+        self._counts: dict[int, dict[str, int]] = {}
+        #: sender -> decayed provable score (the shun input)
+        self._scores: dict[int, float] = {}
+        #: lock-free mirror for the intake hot path
+        self._shunned: frozenset[int] = frozenset()
+        #: votes dropped at intake per shunned sender
+        self._shed: dict[int, int] = {}
+        self.shun_events = 0
+        self.release_events = 0
+        #: local suspects later confirmed by the SHARED deterministic
+        #: blacklist (note_blacklisted) — the corroboration oracle
+        self.corroborated: set[int] = set()
+
+    # ------------------------------------------------------------ recording
+
+    def note(self, sender: int, cause: str, n: int = 1) -> None:
+        """Record ``n`` observations of ``cause`` against ``sender``.
+        Provable causes feed the shun score; observed causes only count."""
+        if n <= 0 or sender == self.self_id:
+            # a replica never shuns itself — its own verify failures are
+            # an engine/keyring problem, not wire misbehavior
+            return
+        with self._lock:
+            by_cause = self._counts.setdefault(sender, {})
+            by_cause[cause] = by_cause.get(cause, 0) + n
+            if cause not in PROVABLE_CAUSES:
+                return
+            score = self._scores.get(sender, 0.0) + n
+            self._scores[sender] = score
+            if sender in self._shunned or score < self.shun_threshold:
+                return
+            self._shunned = self._shunned | {sender}
+            self.shun_events += 1
+            shunned_now = len(self._shunned)
+        if self.metrics is not None:
+            self.metrics.count_black_list.set(float(shunned_now))
+        if self.recorder is not None and getattr(self.recorder, "enabled", False):
+            self.recorder.record("misbehavior.shun", key=f"sender-{sender}",
+                                 extra={"cause": cause, "score": score})
+        if self.logger is not None:
+            self.logger.warnf(
+                "MISBEHAVIOR: shunning sender %d (provable score %.0f >= %d, "
+                "last cause %s) — votes dropped at intake, forward bypass "
+                "revoked", sender, score, self.shun_threshold, cause,
+            )
+
+    def note_shed(self, sender: int, n: int = 1) -> None:
+        """Count votes dropped at intake because ``sender`` is shunned."""
+        with self._lock:
+            self._shed[sender] = self._shed.get(sender, 0) + n
+
+    def note_blacklisted(self, nodes) -> None:
+        """The SHARED deterministic blacklist named ``nodes``: record which
+        of them this table had independently suspected (score > 0)."""
+        with self._lock:
+            for node in nodes:
+                if self._scores.get(node, 0.0) > 0 or node in self._shunned:
+                    self.corroborated.add(int(node))
+
+    # ------------------------------------------------------------ reading
+
+    def is_shunned(self, sender: int) -> bool:
+        return sender in self._shunned
+
+    def shunned(self) -> frozenset[int]:
+        return self._shunned
+
+    def score(self, sender: int) -> float:
+        with self._lock:
+            return self._scores.get(sender, 0.0)
+
+    def counts(self, sender: int) -> dict:
+        with self._lock:
+            return dict(self._counts.get(sender, {}))
+
+    # ------------------------------------------------------------ lifecycle
+
+    def decay(self, factor: float = 0.5) -> None:
+        """Halve every provable score; release shunned senders that have
+        drained below the release threshold.  The Consensus facade ticks
+        this on the shared scheduler, so logical-clock tests control
+        redemption timing exactly."""
+        released = []
+        with self._lock:
+            for sender in list(self._scores):
+                score = self._scores[sender] * factor
+                if score < 0.5:
+                    del self._scores[sender]
+                    score = 0.0
+                else:
+                    self._scores[sender] = score
+                if sender in self._shunned and score <= self.release_threshold:
+                    self._shunned = self._shunned - {sender}
+                    self.release_events += 1
+                    released.append(sender)
+            shunned_now = len(self._shunned)
+        if released:
+            if self.metrics is not None:
+                self.metrics.count_black_list.set(float(shunned_now))
+            if self.logger is not None:
+                self.logger.infof(
+                    "MISBEHAVIOR: released %s from the local shun set "
+                    "(decayed below %d)", released, self.release_threshold,
+                )
+
+    def snapshot(self) -> dict:
+        """Accounting export (bench `byzantine` rows, chaos oracles)."""
+        with self._lock:
+            return {
+                "by_sender": {s: dict(c) for s, c in self._counts.items()},
+                "scores": dict(self._scores),
+                "shunned": sorted(self._shunned),
+                "shed_votes": dict(self._shed),
+                "shun_events": self.shun_events,
+                "release_events": self.release_events,
+                "corroborated": sorted(self.corroborated),
+            }

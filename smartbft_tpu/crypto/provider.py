@@ -1438,6 +1438,15 @@ class CryptoProvider:
         # churns the tail one entry at a time instead of wiping the honest
         # working set (and can never grow memory past the bound)
         self._sig_msg_memo: LruMemo[bytes, "ConsenterSigMsg"] = LruMemo(8192)
+        # per-signer invalid-verdict attribution (ISSUE 18): every failed
+        # consenter-sig verdict names WHO signed it instead of vanishing
+        # into the aggregate failure count.  invalid_by_signer is the
+        # always-on local export; the labeled counter and misbehavior
+        # table are wired late (configure_fault_policy /
+        # configure_misbehavior) by the Consensus facade.
+        self.invalid_by_signer: dict[int, dict[str, int]] = {}
+        self._invalid_vote_counter = None
+        self.misbehavior = None
         if coalescer is not None and engine is not None \
                 and coalescer.engine is not engine:
             raise ValueError("shared coalescer wraps a different engine")
@@ -1526,9 +1535,37 @@ class CryptoProvider:
                 and getattr(self._coalescer.engine, "pad_sizes", None)
                 is not None):
             fallback_engine = HostVerifyEngine(scheme=self.scheme)
+        if metrics is not None and self._invalid_vote_counter is None:
+            self._invalid_vote_counter = getattr(
+                metrics, "count_invalid_votes", None)
         self._coalescer.configure(
             policy=policy, fallback_engine=fallback_engine, metrics=metrics
         )
+
+    def configure_misbehavior(self, table) -> None:
+        """Late misbehavior wiring (Consensus._wire_verify_plane): every
+        per-signer invalid verdict this provider attributes also feeds the
+        node's :class:`~smartbft_tpu.core.misbehavior.MisbehaviorTable`,
+        which the Controller reads to shed shunned senders at intake."""
+        self.misbehavior = table
+
+    def _note_invalid(self, signer, cause: str) -> None:
+        """Attribute one failed verdict to ``signer`` — local dict, the
+        labeled ``consensus.tpu.count_invalid_votes`` counter, and the
+        misbehavior table when wired.  Never raises: attribution must not
+        turn a clean rejection into a verify-plane error."""
+        try:
+            by_cause = self.invalid_by_signer.setdefault(int(signer), {})
+            by_cause[cause] = by_cause.get(cause, 0) + 1
+            if self._invalid_vote_counter is not None:
+                self._invalid_vote_counter.with_labels(str(signer)).add(1)
+            if self.misbehavior is not None:
+                self.misbehavior.note(int(signer), cause)
+        except Exception:
+            logging.getLogger("smartbft_tpu.crypto").warning(
+                "invalid-vote attribution failed for signer %r", signer,
+                exc_info=True,
+            )
 
     def configure_flush_hold(self, hold: Optional[float],
                              explicit: bool = False) -> None:
@@ -1705,9 +1742,19 @@ class CryptoProvider:
         return decoded.aux
 
     def verify_consenter_sig(self, signature: Signature, proposal: Proposal) -> bytes:
-        aux = self._check_binding(signature, proposal)
-        ok = self.engine.verify([self._item(signature)])[0]
+        try:
+            aux = self._check_binding(signature, proposal)
+        except Exception:
+            self._note_invalid(signature.signer, "binding_mismatch")
+            raise
+        try:
+            item = self._item(signature)
+        except Exception:
+            self._note_invalid(signature.signer, "unknown_signer")
+            raise
+        ok = self.engine.verify([item])[0]
         if not ok:
+            self._note_invalid(signature.signer, "invalid_sig")
             raise ValueError(f"invalid consenter signature from {signature.signer}")
         return aux
 
@@ -1725,41 +1772,65 @@ class CryptoProvider:
         items, idxs = [], []
         digest = proposal_digest(proposal)  # once per batch, not per sig
         for i, sig in enumerate(signatures):
+            # the two pre-engine rejections attribute separately: a digest-
+            # binding forgery is a different lie than an out-of-membership
+            # signer claim, and both are cheaper than the engine verdict
+            # they used to be indistinguishable from
             try:
                 aux = self._check_binding(sig, proposal, digest)
-                items.append(self._item(sig))
-                idxs.append(i)
-                auxes.append(aux)
             except Exception:
                 auxes.append(None)
+                self._note_invalid(sig.signer, "binding_mismatch")
+                continue
+            try:
+                items.append(self._item(sig))
+            except Exception:
+                auxes.append(None)
+                self._note_invalid(sig.signer, "unknown_signer")
+                continue
+            idxs.append(i)
+            auxes.append(aux)
         return auxes, items, idxs
 
-    @staticmethod
-    def _apply_mask(auxes, idxs, mask):
+    def _apply_mask(self, auxes, idxs, mask, signatures=None):
         for pos, i in enumerate(idxs):
             if not mask[pos]:
                 auxes[i] = None
+                if signatures is not None:
+                    self._note_invalid(signatures[i].signer, "invalid_sig")
         return auxes
 
     def verify_consenter_sigs_batch(
         self, signatures: Sequence[Signature], proposal: Proposal
     ) -> list[Optional[bytes]]:
         auxes, items, idxs = self._collect(signatures, proposal)
-        return self._apply_mask(auxes, idxs, self._verify_items(items))
+        return self._apply_mask(auxes, idxs, self._verify_items(items),
+                                signatures)
 
     async def verify_consenter_sigs_batch_async(
         self, signatures: Sequence[Signature], proposal: Proposal
     ) -> list[Optional[bytes]]:
         """Async path the View prefers: coalesces with concurrent callers."""
         auxes, items, idxs = self._collect(signatures, proposal)
-        return self._apply_mask(auxes, idxs, await self._verify_items_async(items))
+        return self._apply_mask(auxes, idxs,
+                                await self._verify_items_async(items),
+                                signatures)
 
     def verify_signature(self, signature: Signature) -> None:
         try:
-            ok = self.engine.verify([self._item(signature)])[0]
+            item = self._item(signature)
+        except Exception as exc:
+            cause = ("unknown_signer"
+                     if signature.signer not in self.keyring.public_keys
+                     else "invalid_sig")
+            self._note_invalid(signature.signer, cause)
+            raise ValueError(f"malformed signature from {signature.signer}: {exc}")
+        try:
+            ok = self.engine.verify([item])[0]
         except Exception as exc:
             raise ValueError(f"malformed signature from {signature.signer}: {exc}")
         if not ok:
+            self._note_invalid(signature.signer, "invalid_sig")
             raise ValueError(f"invalid signature from {signature.signer}")
 
     def auxiliary_data(self, msg: bytes) -> bytes:
@@ -1934,7 +2005,8 @@ class BlsCryptoProvider(CryptoProvider):
         auxes, items, idxs = self._collect(signatures, proposal)
         split = self._canonical_split(signatures, items, idxs)
         if split is None:
-            return self._apply_mask(auxes, idxs, self._verify_items(items))
+            return self._apply_mask(auxes, idxs, self._verify_items(items),
+                                    signatures)
         lane, chosen, rest = split
         results = self.engine.verify([lane] + [items[p] for p in rest])
         chosen_results = None
@@ -1942,7 +2014,7 @@ class BlsCryptoProvider(CryptoProvider):
             # canonical lane failed: attribute only the chosen subset
             chosen_results = self.engine.verify([items[p] for p in chosen])
         mask = self._merge_split_verdicts(split, results, chosen_results, len(items))
-        return self._apply_mask(auxes, idxs, mask)
+        return self._apply_mask(auxes, idxs, mask, signatures)
 
     async def verify_consenter_sigs_batch_async(
         self, signatures: Sequence[Signature], proposal: Proposal
@@ -1951,7 +2023,8 @@ class BlsCryptoProvider(CryptoProvider):
         split = self._canonical_split(signatures, items, idxs)
         if split is None:
             return self._apply_mask(auxes, idxs,
-                                    await self._verify_items_async(items))
+                                    await self._verify_items_async(items),
+                                    signatures)
         lane, chosen, rest = split
         results = await self._coalescer.submit(
             [lane] + [items[p] for p in rest], tag=self.verify_tag
@@ -1962,4 +2035,4 @@ class BlsCryptoProvider(CryptoProvider):
                 [items[p] for p in chosen], tag=self.verify_tag
             )
         mask = self._merge_split_verdicts(split, results, chosen_results, len(items))
-        return self._apply_mask(auxes, idxs, mask)
+        return self._apply_mask(auxes, idxs, mask, signatures)

@@ -747,6 +747,16 @@ class TPUCryptoMetrics:
         # `hold` sub-block of every bench row's `mesh` block
         self.count_waves_held = _c(p, "tpu", "count_waves_held")
         self.count_hold_depth_gain = _c(p, "tpu", "count_hold_depth_gain")
+        #: invalid vote verdicts ATTRIBUTED BY SIGNER (ISSUE 18): the
+        #: provider increments `.with_labels(str(signer))` on every failed
+        #: consenter-sig verdict (bad signature value, digest-binding
+        #: forgery, unknown signer), so a forgery flood shows WHO instead
+        #: of vanishing into the aggregate failure count — the export the
+        #: per-sender misbehavior table and bench `byzantine` rows read
+        self.count_invalid_votes = _c(
+            p, "tpu", "count_invalid_votes",
+            help="failed consenter-sig verdicts attributed by signer id",
+        )
 
 
 def tpu_counters_aggregate(providers: Sequence[InMemoryProvider]) -> dict:

@@ -82,6 +82,15 @@ from .transport import MAX_SYNC_DECISIONS, SocketComm
 from .framing import FT_SYNC_RESP as _FT_LEDGER  # noqa: E402
 from .framing import FT_SNAP_REQ as _FT_LEDGER_BASE  # noqa: E402
 
+#: donor-shun threshold (ISSUE 18): once a peer has served this many
+#: poisoned sync tails / snapshot blobs, the synchronizer stops asking it
+#: at all — a liar that keeps lying costs one request timeout per sync
+#: round forever otherwise.  Certificate checks already make the lies
+#: harmless; this just stops paying for them.  Deliberately small and
+#: not config-plumbed: honest donors score 0 (stale races skip QUIETLY in
+#: phase 1 and never count), so any nonzero streak is a tamperer.
+SYNC_DONOR_SHUN_THRESHOLD = 3
+
 
 @wiremsg
 class LedgerBaseRef:
@@ -700,7 +709,16 @@ class ReplicaApp(Application, Assembler, Comm, Signer, Verifier,
         for _round in range(64):  # bound: 64 * MAX_SYNC_DECISIONS decisions
             with self.lock:
                 my_height = self._base_height + len(self.ledger)
-            peers = list(self.peers)
+            # donor shun (ISSUE 18): peers with a poisoning streak are not
+            # even asked — unless EVERY peer is shunned, in which case ask
+            # all of them (a fully partitioned rejoiner must still be able
+            # to make progress off whichever donor has stopped lying; the
+            # certificate checks below stay the actual safety boundary)
+            peers = [p for p in self.peers
+                     if self.sync_poisoned.get(p, 0)
+                     < SYNC_DONOR_SHUN_THRESHOLD]
+            if not peers:
+                peers = list(self.peers)
             results = await asyncio.gather(*[
                 self.transport.request_sync(p, my_height, timeout=1.0)
                 for p in peers
@@ -750,7 +768,13 @@ class ReplicaApp(Application, Assembler, Comm, Signer, Verifier,
         """Fetch + verify + install the best snapshot on offer; True when
         one was installed (the caller loops to pull the tail past it)."""
         offers = [(p, b) for p, b in batches
-                  if b.snapshot_height > my_height and b.snapshot_bytes > 0]
+                  if b.snapshot_height > my_height and b.snapshot_bytes > 0
+                  # donor shun (ISSUE 18): a peer can cross the threshold
+                  # MID-ROUND (poisoned tail above, then its offer lands
+                  # here), so re-check before paying for a chunked
+                  # multi-frame snapshot transfer from a known tamperer
+                  and self.sync_poisoned.get(p, 0)
+                  < SYNC_DONOR_SHUN_THRESHOLD]
         offers.sort(key=lambda pb: pb[1].snapshot_height, reverse=True)
         for peer, batch in offers:
             data = await self.transport.fetch_snapshot(

@@ -233,6 +233,19 @@ ROW_SCHEMAS: dict = {
         "optional": {"snapshot_small_s": _NUM, "snapshot_deep_s": _NUM,
                      "replay_ratio": _NUM, "interval": _NUM},
     },
+    # bench.py assemble_byzantine_row (ISSUE 18) — honest-path request
+    # p99 WITH an f=1 actor flooding forged votes at the shared verify
+    # plane (per-sender accounting shuns + sheds it), next to the same
+    # cluster's no-actor control; the baseline bounds the forger's
+    # latency tax on honest clients
+    "byzantine_forge_p99_ms": {
+        "required": {"metric": _STR, "value": _NUM, "unit": _STR,
+                     "healthy_p99_ms": _NUM},
+        "optional": {"vs_healthy": _NUM, "forged": _NUM,
+                     "shun_events": _NUM, "shed_votes": _NUM,
+                     "spike_acked": _NUM, "healthy_spike_acked": _NUM,
+                     "latency": _LATENCY_BLOCK, "healthy_latency": _DICT},
+    },
     # obs.baseline.tiny_logical_row — the tier-1 regression-gate row
     # (value = mean logical commit latency; percentiles ride in "latency")
     "tiny_logical_commit_ms": {

@@ -255,6 +255,11 @@ class App(Application, Assembler, Comm, Signer, Verifier, RequestInspector,
             # occupancy-gating seam: Configuration.verify_flush_hold
             # reaches the shared coalescer the same way
             self.configure_flush_hold = crypto.configure_flush_hold
+        if crypto is not None and hasattr(crypto, "configure_misbehavior"):
+            # per-sender attribution seam (ISSUE 18): Consensus hands its
+            # MisbehaviorTable to the provider so failed verify verdicts
+            # are charged to the signer instead of the aggregate counter
+            self.configure_misbehavior = crypto.configure_misbehavior
 
     # ------------------------------------------------------------------ app
 

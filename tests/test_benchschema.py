@@ -227,3 +227,28 @@ def test_viewchange_guard_rows_validate_and_degrade_gracefully():
     degraded["phases"] = {}
     degraded["viewchange"] = {}
     assert bench.viewchange_guard_rows(rows) == []
+
+
+def test_byzantine_row_validates_and_guards_missing_p99():
+    """The ISSUE 18 degraded-mode pin: synthetic paired probes through
+    the SAME pure assemble fn ``bench.py --byzantine`` calls must
+    validate against the pinned schema; a probe that never committed a
+    spike request (no p99) fails loudly instead of emitting a drifting
+    row."""
+    import pytest
+
+    def probe(p99, forged=0, shun=0, shed=0):
+        return {"latency": _latency(p99), "spike_offered": 48,
+                "spike_acked": 40, "decisions": 44, "forged": forged,
+                "shun_events": shun, "shed_votes": shed}
+
+    row = bench.assemble_byzantine_row(
+        probe(90.0), probe(120.0, forged=60, shun=3, shed=200)
+    )
+    assert identify_row(row) == "byzantine_forge_p99_ms"
+    assert validate_row(row) == [], validate_row(row)
+    assert row["value"] == 120.0 and row["healthy_p99_ms"] == 90.0
+    assert row["vs_healthy"] == 1.33
+    assert row["shun_events"] == 3 and row["shed_votes"] == 200
+    with pytest.raises(RuntimeError, match="no spike request"):
+        bench.assemble_byzantine_row(probe(90.0), {"latency": {}})
