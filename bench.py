@@ -917,6 +917,34 @@ def byzantine_bench() -> None:
     _emit(assemble_byzantine_row(healthy, degraded))
 
 
+def mixed_read_bench() -> None:
+    """Run benchmarks/readplane.py (ISSUE 19): the mixed 95/5 read/write
+    sweep against the live socket cluster (quorum-read p99 next to the
+    same run's full-path write p99, the read-storm isolation check) plus
+    the n=4 vs n=8 read-capacity scaling point, emitting the
+    ``read_p99_ms`` and ``read_scaling_vs_n`` rows."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    scale = os.environ.get("SMARTBFT_BENCH_READ_SCALE", "4,8")
+    cmd = [sys.executable, os.path.join(here, "benchmarks", "readplane.py"),
+           "--scale-nodes", scale]
+    timeout = float(os.environ.get("SMARTBFT_BENCH_READ_TIMEOUT", "560"))
+    proc = subprocess.run(
+        cmd, timeout=timeout, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),  # no device in this bench
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"read-plane bench failed: "
+            f"{proc.stderr.decode(errors='replace')[-400:]}"
+        )
+    rows = [json.loads(l) for l in proc.stdout.decode().splitlines()
+            if l.strip()]
+    if not rows:
+        raise RuntimeError("read-plane bench produced no rows")
+    for row in rows:
+        _emit(row)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument(
@@ -969,6 +997,16 @@ def main() -> None:
              "request p99 under an active vote-forgery flood vs the same "
              "cluster's no-actor control, emitting the "
              "byzantine_forge_p99_ms row the baseline bounds",
+    )
+    ap.add_argument(
+        "--mixed-read", action="store_true",
+        default=os.environ.get("SMARTBFT_BENCH_MIXED_READ", "") == "1",
+        help="additionally run the read-plane bench (benchmarks/"
+             "readplane.py): mixed 95/5 quorum-read/write wall p99s "
+             "against the live socket cluster, the read-storm shed "
+             "isolation check, and the n=4 vs n=8 read-capacity scaling "
+             "point (SMARTBFT_BENCH_READ_SCALE), emitting the "
+             "read_p99_ms and read_scaling_vs_n rows",
     )
     ap.add_argument(
         "--check-baseline", nargs="?", const="BASELINE_OBS.json",
@@ -1027,6 +1065,12 @@ def main() -> None:
             byzantine_bench()
         except Exception as exc:  # noqa: BLE001 — byzantine row is additive
             _log(f"bench: byzantine probe failed ({type(exc).__name__}: {exc})")
+
+    if args.mixed_read:
+        try:
+            mixed_read_bench()
+        except Exception as exc:  # noqa: BLE001 — read rows are additive
+            _log(f"bench: read-plane bench failed ({type(exc).__name__}: {exc})")
 
     if os.environ.get("SMARTBFT_BENCH_E2E", "1") == "1":
         try:

@@ -1,0 +1,320 @@
+"""Read-plane bench (ISSUE 19): replica-scaled reads that never touch
+consensus, measured against the REAL socket cluster.
+
+Three claims under test, each against live ``smartbft_tpu.net.launch``
+replica processes over UDS on this host:
+
+* **reads are cheap because they skip consensus** — the mixed 95/5
+  phase interleaves quorum reads (``cmd=read mode=quorum``: the control
+  edge fans the key to every peer over FT_READ_REQ and applies the f+1
+  match rule) with writes (``cmd=submit`` + poll-until-committed, the
+  full three-phase protocol) through the SAME cluster under the SAME
+  load, and reports both wall-clock p99s side by side.  The pinned
+  contrast is the read p99 staying far under the write p99: a read
+  costs fan-out RTTs, never a consensus round.
+
+* **read capacity scales with n** — a local read touches ONLY its
+  serving replica (no peer frames, no proposer, no verify launch), so
+  cluster read capacity is n x the per-replica service rate.  The
+  scaling phase measures that per-replica rate on an n=4 and an n=8
+  cluster and emits aggregate large/small with the per-replica rates
+  alongside: a flat-with-n service rate is the isolation invariant the
+  guard actually pins.  On a multi-core host the aggregate is realized
+  parallelism; on a 1-core rig (this one) it is capacity aggregation
+  under that measured invariant — same honesty rule as the S=16
+  affinity knee note in the committed baseline.
+
+* **a read storm degrades reads, never writes** — the storm phase
+  blasts local reads at one replica well past its token-bucket gate
+  (``read_gate_rate``) while a writer keeps submitting through the full
+  path; the row records sheds > 0 on the read side and every storm
+  write committed.
+
+Output: one ``read_p99_ms`` row and one ``read_scaling_vs_n`` row as
+JSON lines through the pure assemble functions pinned in
+``smartbft_tpu.obs.benchschema``.
+
+Run:  python benchmarks/readplane.py [--reads 190] [--writes 10]
+      [--scale-nodes 4,8] [--storm-reads 600]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from smartbft_tpu.net.cluster import SocketCluster  # noqa: E402
+from smartbft_tpu.obs.benchschema import (  # noqa: E402
+    assemble_read_row,
+    assemble_read_scaling_row,
+)
+
+#: per-replica sustained read gate for the mixed+storm cluster: far above
+#: what the sequential mixed loop offers, far below what the storm's
+#: hammering threads reach — so the SAME cluster serves both phases
+GATE_RATE = 400.0
+GATE_BURST = 64
+
+
+def _log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _p99(samples_ms: list) -> float:
+    if not samples_ms:
+        return 0.0
+    ordered = sorted(samples_ms)
+    return ordered[min(len(ordered) - 1, int(0.99 * len(ordered)))]
+
+
+def _seed_keys(cluster: SocketCluster, keys: int, payload: bytes) -> None:
+    """Commit one write per key so every replica's committed KV has the
+    keys the read phases will hammer."""
+    lead = cluster.wait_leader()
+    for k in range(1, keys + 1):
+        cluster.submit(lead, f"rd-c{k}", f"seed-{k}", payload)
+    cluster.wait_committed(keys, timeout=60.0)
+
+
+def _timed_write(cluster: SocketCluster, via: int, client: str, rid: str,
+                 payload: bytes, *, timeout: float = 30.0) -> float:
+    """One full-path write: submit, poll the same replica until its
+    committed request count moves past it.  Returns wall ms."""
+    before = cluster.committed(via)
+    t0 = time.perf_counter()
+    cluster.submit(via, client, rid, payload)
+    deadline = t0 + timeout
+    while cluster.committed(via) <= before:
+        if time.perf_counter() > deadline:
+            raise TimeoutError(f"write {rid} not committed within {timeout}s")
+        time.sleep(0.001)
+    return (time.perf_counter() - t0) * 1000.0
+
+
+def mixed_phase(cluster: SocketCluster, *, reads: int, writes: int,
+                keys: int, payload: bytes) -> dict:
+    """The 95/5 loop: quorum reads round-robin across entry replicas,
+    writes through the leader, every op timed wall-clock.  Also probes
+    the local and follower fast paths for their own p99s."""
+    lead = cluster.wait_leader()
+    ids = cluster.live_ids()
+    read_ms: list = []
+    write_ms: list = []
+    sheds = 0
+    per_write = max(1, reads // max(1, writes))
+    w = 0
+    for i in range(reads):
+        via = ids[i % len(ids)]
+        key = f"rd-c{1 + i % keys}"
+        t0 = time.perf_counter()
+        resp = cluster.control(via).call(cmd="read", key=key, mode="quorum",
+                                         max_lag=8)
+        read_ms.append((time.perf_counter() - t0) * 1000.0)
+        if resp.get("shed"):
+            sheds += 1
+        elif not resp.get("quorum"):
+            raise RuntimeError(f"quorum read lost quorum: {resp}")
+        if (i + 1) % per_write == 0 and w < writes:
+            w += 1
+            write_ms.append(_timed_write(cluster, lead, f"rd-c{1 + w % keys}",
+                                         f"mix-{w}", payload))
+    while w < writes:
+        w += 1
+        write_ms.append(_timed_write(cluster, lead, f"rd-c{1 + w % keys}",
+                                     f"mix-{w}", payload))
+    local_ms: list = []
+    follower_ms: list = []
+    probes = max(32, reads // 4)
+    for i in range(probes):
+        via = ids[i % len(ids)]
+        key = f"rd-c{1 + i % keys}"
+        t0 = time.perf_counter()
+        cluster.control(via).call(cmd="read", key=key, mode="local")
+        local_ms.append((time.perf_counter() - t0) * 1000.0)
+        t0 = time.perf_counter()
+        cluster.control(via).call(cmd="read", key=key, mode="follower",
+                                  max_lag=128)
+        follower_ms.append((time.perf_counter() - t0) * 1000.0)
+    return {
+        "read_p99_ms": _p99(read_ms),
+        "write_p99_ms": _p99(write_ms),
+        "local_p99_ms": _p99(local_ms),
+        "follower_p99_ms": _p99(follower_ms),
+        "reads": len(read_ms),
+        "writes": len(write_ms),
+        "sheds": sheds,
+    }
+
+
+def storm_phase(cluster: SocketCluster, *, storm_reads: int, hammers: int,
+                storm_writes: int, payload: bytes) -> dict:
+    """Blast local reads at ONE replica past its gate from ``hammers``
+    threads while a writer pushes full-path writes: the isolation
+    contract is sheds land on reads, every write still commits."""
+    target = cluster.live_ids()[0]
+    lead = cluster.wait_leader()
+    counts = {"served": 0, "shed": 0}
+    lock = threading.Lock()
+    per_thread = max(1, storm_reads // hammers)
+
+    def hammer(tid: int) -> None:
+        served = shed = 0
+        for i in range(per_thread):
+            resp = cluster.control(target).call(
+                cmd="read", key="rd-c1", mode="local")
+            if resp.get("shed"):
+                shed += 1
+            else:
+                served += 1
+        with lock:
+            counts["served"] += served
+            counts["shed"] += shed
+
+    committed = {"writes": 0}
+
+    def writer() -> None:
+        for k in range(storm_writes):
+            _timed_write(cluster, lead, "rd-storm", f"storm-{k}", payload)
+            committed["writes"] += 1
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=hammer, args=(t,))
+               for t in range(hammers)]
+    threads.append(threading.Thread(target=writer))
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - t0
+    offered = counts["served"] + counts["shed"]
+    return {
+        "offered": offered,
+        "offered_per_sec": round(offered / elapsed, 1) if elapsed else 0.0,
+        "sheds": counts["shed"],
+        "writes_submitted": storm_writes,
+        "writes_committed": committed["writes"],
+        "gate_rate": GATE_RATE,
+    }
+
+
+def per_replica_read_rate(cluster: SocketCluster, *, burst: int,
+                          keys: int, sample_replicas: int = 2) -> float:
+    """Mean local-read service rate (reads/s) over ``sample_replicas``
+    replicas, ``burst`` timed reads each — the quantity that must stay
+    flat as n grows for the aggregate-capacity claim to hold."""
+    rates = []
+    for via in cluster.live_ids()[:sample_replicas]:
+        t0 = time.perf_counter()
+        for i in range(burst):
+            cluster.control(via).call(cmd="read", key=f"rd-c{1 + i % keys}",
+                                      mode="local")
+        elapsed = time.perf_counter() - t0
+        rates.append(burst / elapsed)
+    return sum(rates) / len(rates)
+
+
+def scaling_point(n: int, *, burst: int, keys: int, payload: bytes) -> float:
+    """One fresh ungated n-replica cluster: seed, measure the
+    per-replica local-read service rate, tear down."""
+    root = tempfile.mkdtemp(prefix=f"readbench-n{n}-")
+    cluster = SocketCluster(root, n=n, config_overrides={
+        "read_gate_rate": 0.0,  # scaling measures service rate, not the gate
+    })
+    try:
+        cluster.start(ready_timeout=120.0)
+        _seed_keys(cluster, keys, payload)
+        return per_replica_read_rate(cluster, burst=burst, keys=keys)
+    finally:
+        cluster.stop()
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=4,
+                    help="mixed/storm cluster size (default 4)")
+    ap.add_argument("--reads", type=int, default=190,
+                    help="mixed-phase quorum reads (default 190 — with "
+                         "--writes 10 that is the 95/5 mix)")
+    ap.add_argument("--writes", type=int, default=10)
+    ap.add_argument("--keys", type=int, default=8,
+                    help="distinct seeded client keys the reads hit")
+    ap.add_argument("--payload", type=int, default=64)
+    ap.add_argument("--scale-nodes", default="4,8",
+                    help="small,large cluster sizes for the scaling row "
+                         "('' skips the scaling phase)")
+    ap.add_argument("--scale-burst", type=int, default=250,
+                    help="timed local reads per sampled replica")
+    ap.add_argument("--storm-reads", type=int, default=600)
+    ap.add_argument("--storm-threads", type=int, default=4)
+    ap.add_argument("--storm-writes", type=int, default=5)
+    args = ap.parse_args()
+    payload = b"r" * args.payload
+
+    root = tempfile.mkdtemp(prefix="readbench-")
+    cluster = SocketCluster(root, n=args.nodes, config_overrides={
+        "read_gate_rate": GATE_RATE, "read_gate_burst": GATE_BURST,
+    })
+    try:
+        _log(f"readplane: starting n={args.nodes} mixed/storm cluster")
+        cluster.start(ready_timeout=120.0)
+        _seed_keys(cluster, args.keys, payload)
+        mixed = mixed_phase(cluster, reads=args.reads, writes=args.writes,
+                            keys=args.keys, payload=payload)
+        _log(f"readplane: mixed 95/5 done — read p99 "
+             f"{mixed['read_p99_ms']:.1f}ms vs write p99 "
+             f"{mixed['write_p99_ms']:.1f}ms")
+        storm = storm_phase(cluster, storm_reads=args.storm_reads,
+                            hammers=args.storm_threads,
+                            storm_writes=args.storm_writes, payload=payload)
+        _log(f"readplane: storm done — {storm['sheds']}/{storm['offered']} "
+             f"reads shed at {storm['offered_per_sec']}/s offered, "
+             f"{storm['writes_committed']}/{storm['writes_submitted']} "
+             f"writes committed")
+        if storm["sheds"] <= 0:
+            raise RuntimeError(
+                f"storm never tripped the read gate ({storm}) — the "
+                f"isolation claim was not exercised"
+            )
+        if storm["writes_committed"] != storm["writes_submitted"]:
+            raise RuntimeError(f"storm starved writes: {storm}")
+        stats = cluster.control(cluster.live_ids()[0]).call(cmd="stats")
+        read_block = stats.get("read") or {}
+    finally:
+        cluster.stop()
+        shutil.rmtree(root, ignore_errors=True)
+
+    print(json.dumps(assemble_read_row(
+        read_p99_ms=mixed["read_p99_ms"], write_p99_ms=mixed["write_p99_ms"],
+        nodes=args.nodes, reads=mixed["reads"], writes=mixed["writes"],
+        mode="quorum", local_p99_ms=mixed["local_p99_ms"],
+        follower_p99_ms=mixed["follower_p99_ms"],
+        read_sheds=mixed["sheds"], storm=storm, read_stats=read_block,
+    )), flush=True)
+
+    if args.scale_nodes:
+        small_n, large_n = (int(x) for x in args.scale_nodes.split(","))
+        rate_small = scaling_point(small_n, burst=args.scale_burst,
+                                   keys=args.keys, payload=payload)
+        _log(f"readplane: n={small_n} per-replica rate {rate_small:.0f}/s")
+        rate_large = scaling_point(large_n, burst=args.scale_burst,
+                                   keys=args.keys, payload=payload)
+        _log(f"readplane: n={large_n} per-replica rate {rate_large:.0f}/s")
+        print(json.dumps(assemble_read_scaling_row(
+            per_replica_rate_small=rate_small,
+            per_replica_rate_large=rate_large,
+            nodes_small=small_n, nodes_large=large_n,
+        )), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -362,6 +362,29 @@ class Configuration:
     snapshot_interval_decisions: int = 0
     snapshot_chunk_bytes: int = 1024 * 1024
 
+    # The read/serving plane (smartbft_tpu/core/readplane.py — ISSUE 19,
+    # Castro–Liskov's read-only optimization).  Reads execute at replicas
+    # against committed state with NO ordering and bypass the write
+    # path's pool/admission gate entirely; they get their own
+    # token-bucket gate so a read storm degrades reads, never writes.
+    # Consumed by the socket ReplicaApp and the in-process testing App;
+    # round-tripped by ConfigMirror like every other knob.
+    # - read_gate_rate: sustained reads/second one replica serves before
+    #   shedding (0 = gate off, every read answered — the default, since
+    #   committed-state reads are one dict lookup under the lock).
+    # - read_gate_burst: bucket depth — the burst a replica absorbs
+    #   before the rate limit bites.
+    # - read_watch_buffer: per-subscriber committed-stream notification
+    #   cap; past it the OLDEST notification is dropped and counted
+    #   (the transport outbox-cap discipline — a slow subscriber must
+    #   never grow replica memory without bound).
+    # - read_max_watches: concurrent subscriptions one replica carries;
+    #   registration past it is refused loudly.
+    read_gate_rate: float = 0.0
+    read_gate_burst: int = 256
+    read_watch_buffer: int = 256
+    read_max_watches: int = 64
+
     def validate(self) -> None:
         def positive(name: str) -> None:
             v = getattr(self, name)
@@ -482,6 +505,15 @@ class Configuration:
                 "transport_max_frame_bytes (chunk + envelope must fit one "
                 "frame, or every state transfer poisons its connection)"
             )
+        if self.read_gate_rate < 0:
+            raise ConfigError(
+                "read_gate_rate should not be negative "
+                "(0 disables the read gate)"
+            )
+        for name in ("read_gate_burst", "read_watch_buffer",
+                     "read_max_watches"):
+            if getattr(self, name) < 1:
+                raise ConfigError(f"{name} should be at least 1")
         if not (0.0 < self.admission_high_water <= 1.0):
             raise ConfigError(
                 "admission_high_water must be in (0, 1] (a fraction of "

@@ -121,6 +121,14 @@ class Consensus:
             logger=logger,
             recorder=self.recorder,
         )
+        # committed-state read hook (ISSUE 19): the embedder registers a
+        # callable (key: str) -> Optional[tuple[bytes, int, bytes, int]]
+        # = (value, height, state_digest, anchor_height) answered from
+        # COMMITTED state only.  The facade exposes it (read_committed)
+        # so read-plane callers hold one handle per replica; consensus
+        # itself never calls it — reads bypass the pool/proposer/verify
+        # plane entirely, that is the whole point.
+        self.read_hook = None
         self._own_scheduler = scheduler is None
         self._clock_driver: Optional[WallClockDriver] = None
         self.viewchanger_tick_interval = viewchanger_tick_interval
@@ -455,6 +463,27 @@ class Consensus:
         intake sheds, and shared-blacklist corroborations — read by the
         chaos oracles and the bench `byzantine` row."""
         return self.misbehavior.snapshot()
+
+    def read_committed(self, key: str):
+        """Read-plane entry (ISSUE 19): the embedder-registered committed-
+        state read, or None when no hook is installed / nothing committed
+        for ``key``.  Returns (value, height, state_digest, anchor_height)
+        — the stamp a quorum-read client matches ``f+1`` ways and a
+        follower-read client checks against its staleness bound.  Never
+        touches the pool, the proposer, or the verify plane."""
+        if self.read_hook is None:
+            return None
+        return self.read_hook(key)
+
+    def delivery_frontier(self) -> dict:
+        """The committed delivery frontier this replica has reached: the
+        latest delivered sequence (checkpoint metadata), the view it
+        belongs to, and the commit inter-arrival EWMA — the freshness
+        reference a read client compares reply heights against (empty
+        before start)."""
+        if self.controller is None:
+            return {}
+        return self.controller.delivery_frontier()
 
     def pool_occupancy(self) -> dict:
         """This node's request-pool backpressure snapshot (empty before

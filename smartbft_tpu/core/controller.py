@@ -294,6 +294,19 @@ class Controller(RequestTimeoutHandler):
         liveness at ``t`` (same clock domain as ``clock``)."""
         self._leader_alive_at = t
 
+    def delivery_frontier(self) -> dict:
+        """The committed delivery frontier (ISSUE 19): the latest
+        delivered sequence, the current view, and the commit inter-
+        arrival EWMA.  The read plane's freshness reference — a client
+        holding a frontier can bound how stale a follower-read reply is
+        in DECISIONS (frontier seq minus reply height) instead of
+        guessing in wall time."""
+        return {
+            "seq": self.latest_seq(),
+            "view": self.curr_view_number,
+            "commit_gap_s": self._commit_gap_ewma,
+        }
+
     # ------------------------------------------------------------------ requests
 
     async def submit_request(self, request: bytes, *,

@@ -62,6 +62,10 @@ PROVABLE_CAUSES = frozenset({
 OBSERVED_CAUSES = frozenset({
     "stale_view",        # replayed message from a view this node left
     "sync_poisoned",     # tampered sync material (net-layer attribution)
+    "stale_read",        # read reply contradicting an f+1 committed stamp
+                         # (stale beyond the client's bound, or a digest
+                         # mismatch at matched height) — read replies are
+                         # unsigned, so this is evidence, never shun input
 })
 
 

@@ -1,0 +1,228 @@
+"""The read/serving plane's pure core (ISSUE 19).
+
+Castro–Liskov's read-only optimization: a read executes at replicas
+against COMMITTED state with no ordering — no pool, no proposer, no
+verify launch.  What makes that safe is entirely client-side judgement
+over the stamps replicas attach, and this module holds that judgement
+as pure functions so every embedder (socket control channel, in-process
+shard front door, chaos oracle, property tests) applies bit-identical
+rules:
+
+* :func:`quorum_read_decide` — the ``f+1`` match rule.  ``f+1``
+  bit-identical ``(found, value, height, state_digest)`` stamps contain
+  at least one honest replica, and an honest replica only stamps
+  committed state — so the value is committed.  Replies that contradict
+  the winning stamp are returned as OUTLIERS with a reason: a donor at
+  the same height with a different digest/value is provably
+  inconsistent with a committed stamp; a donor behind the winner past
+  the caller's lag bound served stale state.  Both are observed-only
+  evidence (``stale_read``) for the MisbehaviorTable — read replies are
+  unsigned, so they must never feed the provable shun score.
+* :func:`follower_read_accept` — the single-replica fast path's
+  staleness bound.  The client chooses ``max_lag_decisions`` and
+  rejects any reply whose anchor (the live height, or the snapshot
+  anchor-certificate height for a read-at-base) is older than its known
+  frontier by more than the bound.  Freshness is bounded in DECISIONS,
+  not wall time: the logical clock owns the tests.
+* :class:`TokenBucket` — the per-replica read gate.  Reads bypass the
+  write path's admission gate entirely (they must never queue behind
+  writes), so they get their own bucket: a read storm drains this
+  bucket and sheds READS with a retry-after hint while the write path
+  never sees it.
+
+Everything here is synchronous, lock-free and deterministic — callers
+own their locking and supply the clock.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Optional, Sequence
+
+
+def read_stamp(reply) -> tuple:
+    """The equality key of the ``f+1`` match rule.  Anything exposing
+    ``found``/``value``/``height``/``state_digest`` (the wire
+    ``ReadResponse``, the in-process reply) stamps identically."""
+    return (
+        bool(getattr(reply, "found", False)),
+        bytes(getattr(reply, "value", b"") or b""),
+        int(getattr(reply, "height", 0)),
+        bytes(getattr(reply, "state_digest", b"") or b""),
+    )
+
+
+@dataclass(frozen=True)
+class QuorumReadResult:
+    """Outcome of one quorum-read fan-out: the winning reply (None when
+    no stamp reached ``need`` matches), and the contradicting donors as
+    ``(sender, reason)`` pairs for observed-only attribution."""
+
+    winner: object = None
+    matches: int = 0
+    outliers: tuple = ()
+
+
+def quorum_read_decide(replies: Sequence[tuple[int, object]], need: int,
+                       *, max_lag_decisions: int = 0) -> QuorumReadResult:
+    """Apply the ``f+1`` match rule to ``(sender, reply)`` pairs.
+
+    ``need`` is how many bit-identical stamps prove commitment (f+1 —
+    the caller derives f from its membership).  Shed replies never
+    match and are never outliers: a shed is the gate working, not a
+    donor lying.  When several stamps reach ``need`` (only possible
+    while commits land mid-fan-out), the HIGHEST height wins — every
+    qualifying stamp is committed, so freshest is strictly better.
+    """
+    groups: dict[tuple, list[int]] = {}
+    usable: list[tuple[int, object]] = []
+    for sender, reply in replies:
+        if reply is None or getattr(reply, "shed", False):
+            continue
+        usable.append((sender, reply))
+        groups.setdefault(read_stamp(reply), []).append(sender)
+    winners = [(stamp, senders) for stamp, senders in groups.items()
+               if len(senders) >= need]
+    if not winners:
+        return QuorumReadResult(winner=None, matches=0, outliers=())
+    win_stamp, win_senders = max(winners, key=lambda sw: sw[0][2])
+    winner = next(r for s, r in usable
+                  if s in win_senders and read_stamp(r) == win_stamp)
+    win_height = win_stamp[2]
+    outliers: list[tuple[int, str]] = []
+    for sender, reply in usable:
+        stamp = read_stamp(reply)
+        if stamp == win_stamp:
+            continue
+        if stamp[2] == win_height:
+            # same height, different value/digest: inconsistent with a
+            # committed stamp — a tampered or forked read reply
+            outliers.append((sender, "digest_mismatch"))
+        elif stamp[2] < win_height - max_lag_decisions:
+            outliers.append((sender, "stale_beyond_bound"))
+        # a reply within the lag bound (or AHEAD of the winner) is an
+        # honest replica at a different frontier — never attributed
+    return QuorumReadResult(winner=winner, matches=len(win_senders),
+                            outliers=tuple(outliers))
+
+
+def follower_read_accept(reply, frontier_seq: int,
+                         max_lag_decisions: int) -> bool:
+    """The follower-read staleness rule: accept a single-replica reply
+    iff its anchor is no more than ``max_lag_decisions`` behind the
+    client's known frontier.  The anchor is the snapshot certificate
+    height for a read-at-base, the live height otherwise; a shed reply
+    is never accepted.  A reply AHEAD of the client's frontier is
+    always fresh (the client's frontier knowledge is the stale side)."""
+    if reply is None or getattr(reply, "shed", False):
+        return False
+    if getattr(reply, "at_base", False):
+        anchor = int(getattr(reply, "anchor_height", 0))
+    else:
+        anchor = int(getattr(reply, "height", 0))
+    return frontier_seq - anchor <= max_lag_decisions
+
+
+class TokenBucket:
+    """The per-replica read gate: ``rate`` tokens/second refill up to
+    ``burst``.  ``allow()`` spends one token or refuses; ``retry_after``
+    is the drain-rate-derived hint the shed reply carries (the FT_REJECT
+    contract).  The clock is injected so logical-clock tests drive it
+    deterministically; rate <= 0 disables the gate (always allow)."""
+
+    __slots__ = ("rate", "burst", "_clock", "_tokens", "_last", "sheds",
+                 "allowed")
+
+    def __init__(self, rate: float, burst: int,
+                 clock: Optional[Callable[[], float]] = None):
+        import time
+
+        self.rate = float(rate)
+        self.burst = max(1, int(burst))
+        self._clock = clock if clock is not None else time.monotonic
+        self._tokens = float(self.burst)
+        self._last = self._clock()
+        self.sheds = 0
+        self.allowed = 0
+
+    def _refill(self) -> None:
+        now = self._clock()
+        elapsed = now - self._last
+        if elapsed > 0:
+            self._tokens = min(self.burst, self._tokens + elapsed * self.rate)
+            self._last = now
+
+    def allow(self) -> bool:
+        if self.rate <= 0:
+            self.allowed += 1
+            return True
+        self._refill()
+        if self._tokens >= 1.0:
+            self._tokens -= 1.0
+            self.allowed += 1
+            return True
+        self.sheds += 1
+        return False
+
+    def retry_after(self) -> float:
+        """Seconds until one token exists (0 when a token is available
+        or the gate is disabled)."""
+        if self.rate <= 0:
+            return 0.0
+        self._refill()
+        deficit = 1.0 - self._tokens
+        return max(0.0, deficit / self.rate)
+
+    def occupancy(self) -> tuple[int, int]:
+        """(tokens spent of the burst window, burst) — the shed reply's
+        occupancy/high_water snapshot, mirroring the pool gate's."""
+        self._refill()
+        return self.burst - int(self._tokens), self.burst
+
+
+@dataclass
+class ReadStats:
+    """Serving-side read-plane counters, embedded per replica and
+    exported as the ``read`` stats block (control cmd=stats, ShardSet
+    stats_block, the bench ``read`` row's per-replica half)."""
+
+    served_live: int = 0
+    served_base: int = 0
+    not_found: int = 0
+    sheds: int = 0
+    base_refused: int = 0
+    watch_notifications: int = 0
+    watch_dropped: int = 0
+    #: lag (serving height minus reply anchor) observed per served read;
+    #: live reads serve at the frontier so this meters the at_base path
+    lag_sum: int = 0
+    lag_max: int = 0
+    served_total: int = field(init=False, default=0)
+
+    def note_served(self, *, at_base: bool, found: bool, lag: int = 0) -> None:
+        self.served_total += 1
+        if at_base:
+            self.served_base += 1
+        else:
+            self.served_live += 1
+        if not found:
+            self.not_found += 1
+        if lag > 0:
+            self.lag_sum += lag
+            if lag > self.lag_max:
+                self.lag_max = lag
+
+    def snapshot(self) -> dict:
+        served = self.served_total
+        return {
+            "served": served,
+            "served_live": self.served_live,
+            "served_base": self.served_base,
+            "not_found": self.not_found,
+            "sheds": self.sheds,
+            "base_refused": self.base_refused,
+            "watch_notifications": self.watch_notifications,
+            "watch_dropped": self.watch_dropped,
+            "lag_mean": round(self.lag_sum / served, 3) if served else 0.0,
+            "lag_max": self.lag_max,
+        }

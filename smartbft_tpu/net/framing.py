@@ -38,6 +38,17 @@ Frame types:
   which fronts the client — gets the PR 8 admission contract (shed kind,
   retry-after hint, occupancy snapshot) instead of silence.  Advisory:
   the protocol's forward/complain timers keep running either way.
+* ``FT_READ_REQ`` / ``FT_READ_RESP`` — the read/serving plane
+  (ISSUE 19): a keyed read executed at a replica against COMMITTED
+  state only — no pool, no proposer, no verify launch.  The reply is
+  stamped ``(value, height, state_digest, anchor_height)`` so a client
+  can either fan the read to several replicas and accept on ``f+1``
+  bit-identical stamps (quorum read) or accept a single reply under an
+  explicit staleness bound (follower read).  Reads have their own
+  token-bucket gate at the serving replica: a shed reply carries the
+  FT_REJECT contract fields (kind / retry-after / occupancy) inline,
+  correlated by nonce instead of request digest, and NEVER touches the
+  write-path admission gate — a read storm degrades reads, not writes;
 * ``FT_TRACE``      — cluster-tracing SIDECAR (ISSUE 13): a batch of
   compact correlation contexts (request key / (view, seq), origin node,
   monotonic hop counter) describing the data frames of the SAME
@@ -82,10 +93,13 @@ FT_REJECT = 6
 FT_TRACE = 7
 FT_SNAP_REQ = 8
 FT_SNAP_RESP = 9
+FT_READ_REQ = 10
+FT_READ_RESP = 11
 
 _KNOWN_TYPES = frozenset(
     (FT_HELLO, FT_CONSENSUS, FT_REQUEST, FT_SYNC_REQ, FT_SYNC_RESP,
-     FT_REJECT, FT_TRACE, FT_SNAP_REQ, FT_SNAP_RESP)
+     FT_REJECT, FT_TRACE, FT_SNAP_REQ, FT_SNAP_RESP, FT_READ_REQ,
+     FT_READ_RESP)
 )
 
 
@@ -312,6 +326,51 @@ class SnapshotChunk:
     offset: int = 0
     data: bytes = b""
     last: bool = False
+
+
+@wiremsg
+class ReadRequest:
+    """One keyed read against a replica's COMMITTED state
+    (nonce-correlated like :class:`SyncRequest`).  ``key`` names the
+    committed-state entry to read (the test embedders key by client id);
+    ``at_base`` asks the replica to answer from its latest verified
+    snapshot BASE instead of live state — the snapshot-anchored path a
+    client uses when it wants a reply whose digest is pinned by an
+    anchor certificate rather than by the live chain."""
+
+    nonce: int = 0
+    key: str = ""
+    at_base: bool = False
+
+
+@wiremsg
+class ReadResponse:
+    """The read-plane reply.  ``value`` is the committed value for
+    ``key`` (empty when the key has never been written — ``found``
+    disambiguates an empty value from a missing key); ``height`` is the
+    delivered-decision count the value reflects; ``state_digest`` the
+    chained ledger digest at that height (bit-identical across honest
+    replicas, so ``f+1`` matching ``(value, height, state_digest)``
+    stamps prove the value is committed); ``anchor_height`` the height
+    of the newest snapshot anchor certificate at answer time (0 = none
+    yet).  A gated read comes back with ``shed=True`` and the FT_REJECT
+    contract fields (``shed_kind``/``retry_after_ms``/``occupancy``/
+    ``high_water``) instead of a value — the nonce correlates it, so no
+    request digest is needed."""
+
+    nonce: int = 0
+    key: str = ""
+    found: bool = False
+    value: bytes = b""
+    height: int = 0
+    state_digest: bytes = b""
+    anchor_height: int = 0
+    at_base: bool = False
+    shed: bool = False
+    shed_kind: str = ""
+    retry_after_ms: int = 0
+    occupancy: int = 0
+    high_water: int = 0
 
 
 # --------------------------------------------------------------------------

@@ -52,6 +52,12 @@ from ..api import (
 from ..codec import decode, encode, wiremsg
 from ..config import Configuration
 from ..consensus import Consensus
+from ..core.readplane import (
+    ReadStats,
+    TokenBucket,
+    follower_read_accept,
+    quorum_read_decide,
+)
 from ..core.util import compute_quorum
 from ..messages import Proposal, Signature, ViewMetadata
 from ..snapshot import (
@@ -69,7 +75,15 @@ from ..snapshot import (
 from ..types import Decision, Reconfig, RequestInfo, SyncResponse
 from ..utils.logging import StdLogger
 from ..utils.memo import BoundedMemo
-from .framing import FrameDecoder, FrameError, WireDecision, encode_frame, parse_addr
+from .framing import (
+    FrameDecoder,
+    FrameError,
+    ReadRequest,
+    ReadResponse,
+    WireDecision,
+    encode_frame,
+    parse_addr,
+)
 from .transport import MAX_SYNC_DECISIONS, SocketComm
 
 #: ledger-file frame types (framing reserves 1..9 for the socket
@@ -295,14 +309,14 @@ class _SnapshotServer:
         offer = self.replica._snap_offer
         if offer is None or offer[0] != height:
             return 0, b"", False  # gone/superseded: requester restarts
-        total = offer[1]
-        try:
-            with open(self.replica._snap_path, "rb") as fh:
-                fh.seek(offset)
-                data = fh.read(max(0, max_bytes))
-        except OSError:
+        # satellite 2 (ISSUE 19): byte access goes through the store's
+        # single file-open surface, shared with the read-at-base path
+        total, data, last = self.replica.snapshot_store.read_range(
+            height, offset, max_bytes
+        )
+        if total == 0:
             return 0, b"", False
-        return total, data, offset + len(data) >= total
+        return total, data, last
 
 
 class ReplicaApp(Application, Assembler, Comm, Signer, Verifier,
@@ -395,6 +409,20 @@ class ReplicaApp(Application, Assembler, Comm, Signer, Verifier,
         #: tails / snapshots that failed certificate verification)
         self.sync_poisoned: dict[int, int] = {}
         self.transport.snapshot_server = _SnapshotServer(self)
+        # read plane (ISSUE 19): the committed KV view (key = client id,
+        # value = that client's latest committed payload — deterministic
+        # over the committed order, so honest replicas' read stamps match
+        # bit-exactly), its token-bucket gate (reads bypass the write
+        # pool's admission entirely; a read storm drains THIS bucket and
+        # sheds reads, never writes), serving counters, and the bounded
+        # watch registry for committed-stream subscriptions
+        self._kv: dict[str, bytes] = {}
+        self._read_gate = TokenBucket(self.config.read_gate_rate,
+                                      self.config.read_gate_burst)
+        self.read_stats = ReadStats()
+        self.transport.read_server = self._serve_read
+        self._watches: dict[int, dict] = {}
+        self._watch_seq = 0
         # ISSUE 17 disk gauges (promlint-clean: consensus_<sub>_<name>)
         from ..metrics import MetricOpts
 
@@ -423,6 +451,15 @@ class ReplicaApp(Application, Assembler, Comm, Signer, Verifier,
         #: must cost O(new entries), not O(ledger)
         self._barrier_seqs: dict[int, int] = {}
         self._barrier_scan: dict[int, int] = {}
+        #: ISSUE 19 satellite 1: committed_ids / ledger_digest polling
+        #: memos, same discipline as the barrier memo above — each poll
+        #: costs O(new entries), and a base move (compaction or snapshot
+        #: install re-bases the suffix) invalidates the whole memo
+        self._ids_cache: list[str] = []
+        self._ids_scan = 0
+        self._ids_cache_base = -1
+        self._chain_prefix: list[bytes] = []
+        self._chain_prefix_base = -1
 
     # ------------------------------------------------------------ app SPI
 
@@ -432,6 +469,7 @@ class ReplicaApp(Application, Assembler, Comm, Signer, Verifier,
             ids = [str(i) for i in self.requests_from_proposal(proposal)]
         except Exception:  # noqa: BLE001 — foreign payload: no request ids
             ids = []
+        kv_updates = self._kv_updates(proposal)
         with self.lock:
             self.ledger.append(decision)
             self.ledger_file.append(decision)
@@ -440,8 +478,35 @@ class ReplicaApp(Application, Assembler, Comm, Signer, Verifier,
             self._ids_digest = fold_ids(self._ids_digest, ids)
             self._recent_ids.extend(ids)
             self._request_count += len(ids)
+            for client, _rid, payload in kv_updates:
+                self._kv[client] = payload
+            height = self._base_height + len(self.ledger)
+        if self._watches and kv_updates:
+            self._publish_watches(height, kv_updates)
         self._maybe_capture()
         return self._reconfig_in(proposal)
+
+    def _kv_updates(self, proposal: Proposal) -> list[tuple[str, str, bytes]]:
+        """The committed KV view's delta for one decision: one
+        ``(client_id, request_id, payload)`` per well-formed TestRequest
+        in the batch, in batch order.  Foreign payloads contribute
+        nothing (mirrors ``_reconfig_in``'s tolerance)."""
+        from ..testing.app import BatchPayload, TestRequest
+
+        if not proposal.payload:
+            return []
+        try:
+            batch = decode(BatchPayload, proposal.payload)
+        except Exception:  # noqa: BLE001 — foreign payload
+            return []
+        out: list[tuple[str, str, bytes]] = []
+        for raw in batch.requests:
+            try:
+                req = decode(TestRequest, raw)
+            except Exception:  # noqa: BLE001 — foreign request
+                continue
+            out.append((req.client_id, req.request_id, bytes(req.payload)))
+        return out
 
     # ------------------------------------------------------- snapshots (ISSUE 17)
 
@@ -492,6 +557,8 @@ class ReplicaApp(Application, Assembler, Comm, Signer, Verifier,
                     request_count=self._request_count,
                     ids_digest=self._ids_digest,
                     recent_ids=list(self._recent_ids),
+                    kv_keys=list(self._kv.keys()),
+                    kv_values=list(self._kv.values()),
                 )
             blob = encode(state)
             manifest = make_manifest(height, chain_at, blob,
@@ -822,6 +889,7 @@ class ReplicaApp(Application, Assembler, Comm, Signer, Verifier,
             self._request_count = app.request_count
             self._ids_digest = app.ids_digest
             self._recent_ids = deque(app.recent_ids, maxlen=RECENT_IDS_CAP)
+            self._kv = dict(zip(app.kv_keys, app.kv_values))
             self._anchor_decision = anchor
             self.ledger_file.compact(manifest.height, manifest.chain_digest,
                                      [], app_state=state, anchor=anchor_wire)
@@ -872,6 +940,137 @@ class ReplicaApp(Application, Assembler, Comm, Signer, Verifier,
         except Exception:  # noqa: BLE001 — foreign payload: nothing pooled
             return
         remove_delivered_requests(self.consensus.pool, infos, self.logger)
+
+    # ------------------------------------------------------ read plane (ISSUE 19)
+
+    def _serve_read(self, req: ReadRequest) -> ReadResponse:
+        """Serve one keyed read from COMMITTED state — no pool, no
+        proposer, no verify launch (the Castro–Liskov read-only path).
+        The read gate sheds BEFORE any state is touched, with the
+        FT_REJECT contract inline (kind + drain-rate retry-after +
+        occupancy): a read storm degrades reads, never writes."""
+        if not self._read_gate.allow():
+            self.read_stats.sheds += 1
+            spent, burst = self._read_gate.occupancy()
+            return ReadResponse(
+                nonce=req.nonce, key=req.key, shed=True,
+                shed_kind="read_gate",
+                retry_after_ms=int(self._read_gate.retry_after() * 1000),
+                occupancy=spent, high_water=burst,
+            )
+        if req.at_base:
+            return self._read_at_base(req)
+        with self.lock:
+            height = self._base_height + len(self.ledger)
+            digest = self._chain
+            value = self._kv.get(req.key)
+            anchor = self._last_snapshot_height
+        found = value is not None
+        self.read_stats.note_served(at_base=False, found=found)
+        return ReadResponse(
+            nonce=req.nonce, key=req.key, found=found,
+            value=value if found else b"", height=height,
+            state_digest=digest, anchor_height=anchor, at_base=False,
+        )
+
+    def _read_at_base(self, req: ReadRequest) -> ReadResponse:
+        """Snapshot-anchored read: serve from the latest PERSISTED base,
+        stamped with the snapshot's height, its chained ledger digest,
+        and its own height as the anchor certificate.  ``load`` re-runs
+        the store's full integrity verification on every read — a torn
+        or tampered base is refused LOUDLY (counted, per the
+        sync-poisoning precedent), never silently served."""
+        height = self._last_snapshot_height
+        snap = self.snapshot_store.load(height) if height > 0 else None
+        app = None
+        if snap is not None:
+            try:
+                app = decode(AppState, snap.state)
+            except Exception:  # noqa: BLE001 — foreign state blob
+                app = None
+        if app is None:
+            self.read_stats.base_refused += 1
+            self.transport.metrics.read_base_refused += 1
+            self.logger.warnf(
+                "READ-AT-BASE REFUSED: no verifiable snapshot at height %d "
+                "(%d refusals so far)", height, self.read_stats.base_refused)
+            return ReadResponse(nonce=req.nonce, key=req.key, shed=True,
+                                shed_kind="base_refused")
+        kv = dict(zip(app.kv_keys, app.kv_values))
+        value = kv.get(req.key)
+        found = value is not None
+        with self.lock:
+            live = self._base_height + len(self.ledger)
+        self.read_stats.note_served(
+            at_base=True, found=found,
+            lag=max(0, live - snap.manifest.height),
+        )
+        return ReadResponse(
+            nonce=req.nonce, key=req.key, found=found,
+            value=value if found else b"",
+            height=snap.manifest.height,
+            state_digest=snap.manifest.chain_digest,
+            anchor_height=snap.manifest.height, at_base=True,
+        )
+
+    def _read_committed_hook(self, key: str):
+        """The Consensus facade's ``read_hook``: the committed-state
+        answer as ``(value, height, state_digest, anchor_height)``, or
+        None when the key was never written."""
+        with self.lock:
+            value = self._kv.get(key)
+            if value is None:
+                return None
+            height = self._base_height + len(self.ledger)
+            return value, height, self._chain, self._last_snapshot_height
+
+    def add_watch(self, prefix: str) -> Optional[int]:
+        """Register a committed-stream subscription on a key prefix;
+        None once the per-replica watch cap is reached (the registry is
+        bounded like every other per-peer resource)."""
+        from collections import deque
+
+        if len(self._watches) >= self.config.read_max_watches:
+            return None
+        self._watch_seq += 1
+        wid = self._watch_seq
+        self._watches[wid] = {"prefix": prefix, "events": deque(),
+                              "dropped": 0}
+        return wid
+
+    def _publish_watches(self, height: int, updates) -> None:
+        """Fan one decision's KV delta to matching watches, bounded per
+        subscriber: a slow poller drops its OLDEST events and is told
+        how many (the transport outbox's drop-oldest-with-counts
+        discipline) — backpressure never reaches the commit path."""
+        cap = self.config.read_watch_buffer
+        for w in self._watches.values():
+            prefix = w["prefix"]
+            events = w["events"]
+            for client, rid, _payload in updates:
+                if not client.startswith(prefix):
+                    continue
+                if len(events) >= cap:
+                    events.popleft()
+                    w["dropped"] += 1
+                    self.read_stats.watch_dropped += 1
+                events.append({"key": client, "rid": rid, "height": height})
+                self.read_stats.watch_notifications += 1
+
+    def poll_watch(self, wid: int):
+        """Drain a watch's buffered events: ``(events, dropped)`` since
+        the previous poll, or None for an unknown watch id."""
+        w = self._watches.get(wid)
+        if w is None:
+            return None
+        events = list(w["events"])
+        w["events"].clear()
+        dropped = w["dropped"]
+        w["dropped"] = 0
+        return events, dropped
+
+    def remove_watch(self, wid: int) -> bool:
+        return self._watches.pop(wid, None) is not None
 
     # ------------------------------------------------------------ lifecycle
 
@@ -944,6 +1143,7 @@ class ReplicaApp(Application, Assembler, Comm, Signer, Verifier,
         self._ids_digest = app.ids_digest or CHAIN_SEED
         self._recent_ids = deque(app.recent_ids or [],
                                  maxlen=RECENT_IDS_CAP)
+        self._kv = dict(zip(app.kv_keys or [], app.kv_values or []))
         fold_from = (seed_height - base) if seed_height is not None else 0
         for d in suffix[fold_from:]:
             try:
@@ -954,6 +1154,8 @@ class ReplicaApp(Application, Assembler, Comm, Signer, Verifier,
             self._ids_digest = fold_ids(self._ids_digest, ids)
             self._recent_ids.extend(ids)
             self._request_count += len(ids)
+            for client, _rid, payload in self._kv_updates(d.proposal):
+                self._kv[client] = payload
         chain = self._base_chain
         for d in suffix:
             chain = chain_update(chain, d.proposal.payload,
@@ -1035,15 +1237,24 @@ class ReplicaApp(Application, Assembler, Comm, Signer, Verifier,
             heartbeat_tick_interval=0.1,
             recorder=self.recorder,
         )
+        # the read plane's committed-state hook: embedder-owned state,
+        # exposed through the facade so in-process callers read the same
+        # (value, height, digest, anchor) stamps the wire plane serves
+        self.consensus.read_hook = self._read_committed_hook
         self.transport.attach(self.consensus)
         await self.transport.start()
         await self.consensus.start()
         # health sources wire AFTER start: the pool and WAL exist now
         self.health.watch_consensus(self.consensus)
-        from ..obs.health import snapshot_signal_source, wal_signal_source
+        from ..obs.health import (
+            read_signal_source,
+            snapshot_signal_source,
+            wal_signal_source,
+        )
 
         self.health.add_source(wal_signal_source(self._wal))
         self.health.add_source(snapshot_signal_source(self.disk_snapshot))
+        self.health.add_source(read_signal_source(self.read_stats.snapshot))
         from ..utils.tasks import create_logged_task
 
         self._health_task = create_logged_task(
@@ -1097,14 +1308,24 @@ class ReplicaApp(Application, Assembler, Comm, Signer, Verifier,
         killed replica's pool and must be resubmitted, like any BFT
         client would).  Covers the SUFFIX after the compaction horizon:
         with snapshots enabled the full-history oracle is ids_digest
-        (chained, O(1) per replica) — the harness picks per scenario."""
+        (chained, O(1) per replica) — the harness picks per scenario.
+
+        Memoized with the ``barrier_seq`` discipline (ISSUE 19 satellite
+        1): the harness polls this every settle tick, so each poll
+        decodes only the NEW suffix entries; a base move (compaction /
+        snapshot install) rebuilds from the new suffix."""
         with self.lock:
+            base = self._base_height
             ledger = list(self.ledger)
-        return [
-            str(info)
-            for d in ledger
-            for info in self.requests_from_proposal(d.proposal)
-        ]
+        if base != self._ids_cache_base:
+            self._ids_cache = []
+            self._ids_scan = 0
+            self._ids_cache_base = base
+        for idx in range(self._ids_scan, len(ledger)):
+            infos = self.requests_from_proposal(ledger[idx].proposal)
+            self._ids_cache.extend(str(i) for i in infos)
+            self._ids_scan = idx + 1
+        return list(self._ids_cache)
 
     def ids_digest(self) -> str:
         """Chained digest over every delivered request id — the
@@ -1120,19 +1341,31 @@ class ReplicaApp(Application, Assembler, Comm, Signer, Verifier,
         behind the compaction horizon the BASE digest answers — the
         caller (check_fork_free) reads ``base`` off the same control
         response and compares only heights both replicas can still
-        compute."""
+        compute.
+
+        Mid-height answers memoize the running prefix digests (ISSUE 19
+        satellite 1): ``_chain_prefix[k]`` is the digest after ``k``
+        suffix decisions, extended lazily to the requested height, so
+        the fork checker's repeated common-height probes cost O(new
+        entries) instead of re-hashing the prefix every call."""
         with self.lock:
             base = self._base_height
             if upto == 0 or upto >= base + len(self.ledger):
                 return self._chain.hex()
             if upto <= base:
                 return self._base_chain.hex()
-            digest = self._base_chain
-            prefix = self.ledger[:upto - base]
-        for d in prefix:
-            digest = chain_update(digest, d.proposal.payload,
-                                  d.proposal.metadata)
-        return digest.hex()
+            base_chain = self._base_chain
+            ledger = list(self.ledger)
+        if base != self._chain_prefix_base:
+            self._chain_prefix = [base_chain]
+            self._chain_prefix_base = base
+        k = upto - base
+        while len(self._chain_prefix) <= k:
+            d = ledger[len(self._chain_prefix) - 1]
+            self._chain_prefix.append(chain_update(
+                self._chain_prefix[-1], d.proposal.payload,
+                d.proposal.metadata))
+        return self._chain_prefix[k].hex()
 
     def barrier_seq(self, epoch: int) -> int:
         """Ledger position (1-based) of epoch ``epoch``'s committed
@@ -1175,6 +1408,24 @@ def _config_from_spec(spec: dict) -> Configuration:
 # --------------------------------------------------------------------------
 # control channel (line JSON; parent-facing, never part of consensus)
 # --------------------------------------------------------------------------
+
+
+def _reply_dict(reply: ReadResponse) -> dict:
+    """A read reply's JSON shape on the control channel — the full stamp
+    always, the shed contract only when the gate fired."""
+    d = {
+        "found": reply.found,
+        "value": reply.value.hex(),
+        "height": reply.height,
+        "state_digest": reply.state_digest.hex(),
+        "anchor_height": reply.anchor_height,
+        "at_base": reply.at_base,
+    }
+    if reply.shed:
+        d.update(shed=True, shed_kind=reply.shed_kind,
+                 retry_after_ms=reply.retry_after_ms,
+                 occupancy=reply.occupancy, high_water=reply.high_water)
+    return d
 
 
 class ControlServer:
@@ -1321,10 +1572,32 @@ class ControlServer:
             # bounded-disk oracle read off every replica
             return {"ok": True, "node": f"n{r.id}", **r.disk_snapshot()}
         if cmd == "stats":
+            frontier = (r.consensus.delivery_frontier()
+                        if r.consensus is not None else {})
             return {"ok": True, "transport": r.transport.transport_snapshot(),
                     "height": r.height(),
                     "committed": r.committed_requests(),
-                    "disk": r.disk_snapshot()}
+                    "disk": r.disk_snapshot(),
+                    "read": r.read_stats.snapshot(),
+                    "frontier": frontier}
+        if cmd == "read":
+            return await self._read(req)
+        if cmd == "watch":
+            # committed-stream subscription on a key prefix: bounded
+            # buffer per watch, drained by cmd=watch_poll
+            wid = r.add_watch(str(req.get("prefix", "")))
+            if wid is None:
+                return {"ok": False, "error": "watch cap reached",
+                        "max_watches": r.config.read_max_watches}
+            return {"ok": True, "watch_id": wid}
+        if cmd == "watch_poll":
+            polled = r.poll_watch(int(req["watch_id"]))
+            if polled is None:
+                return {"ok": False, "error": "unknown watch"}
+            events, dropped = polled
+            return {"ok": True, "events": events, "dropped": dropped}
+        if cmd == "unwatch":
+            return {"ok": r.remove_watch(int(req["watch_id"]))}
         if cmd == "health":
             # live SLO verdict (ISSUE 14): tick once on demand so the
             # answer reflects NOW even between periodic samples, then
@@ -1374,6 +1647,64 @@ class ControlServer:
             self.stop_evt.set()
             return {"ok": True}
         return {"ok": False, "error": f"unknown cmd {cmd!r}"}
+
+    async def _read(self, req: dict) -> dict:
+        """cmd=read — the serving plane's client edge, three modes:
+
+        * ``local``: this replica's committed state as-is (optionally
+          ``at_base``: anchored to the latest persisted snapshot);
+        * ``follower``: local serve plus the client-side staleness
+          judgement — ``accepted`` is the :func:`follower_read_accept`
+          verdict against ``frontier`` (default: this replica's own
+          height) and ``max_lag`` decisions;
+        * ``quorum``: fan the read to every peer and apply the ``f+1``
+          match rule — the reply is committed-proof without touching
+          consensus."""
+        r = self.replica
+        key = str(req.get("key", ""))
+        mode = req.get("mode", "local")
+        max_lag = int(req.get("max_lag", 0))
+        if mode == "quorum":
+            return await self._quorum_read(key, max_lag)
+        at_base = bool(req.get("at_base", False))
+        reply = r._serve_read(ReadRequest(nonce=0, key=key, at_base=at_base))
+        out = _reply_dict(reply)
+        out["ok"] = True
+        if mode == "follower":
+            frontier = int(req.get("frontier", r.height()))
+            out["accepted"] = follower_read_accept(reply, frontier, max_lag)
+            out["frontier"] = frontier
+            out["max_lag"] = max_lag
+        return out
+
+    async def _quorum_read(self, key: str, max_lag: int) -> dict:
+        """Fan a keyed read to every peer (plus our own answer) and
+        accept on ``f+1`` bit-identical stamps.  Contradicting donors
+        are attributed to the MisbehaviorTable as OBSERVED-only
+        ``stale_read`` evidence — read replies are unsigned, so they
+        count for the operator but never feed the shun score."""
+        r = self.replica
+        members = [r.id, *r.peers]
+        _quorum, f = compute_quorum(len(members))
+        need = f + 1
+        local = r._serve_read(ReadRequest(nonce=0, key=key, at_base=False))
+        peer_ids = list(r.peers)
+        results = await asyncio.gather(*[
+            r.transport.request_read(p, key, timeout=1.0)
+            for p in peer_ids
+        ])
+        replies = [(r.id, local), *zip(peer_ids, results)]
+        decision = quorum_read_decide(replies, need,
+                                      max_lag_decisions=max_lag)
+        if r.consensus is not None:
+            for sender, _reason in decision.outliers:
+                r.consensus.misbehavior.note(sender, "stale_read")
+        out = {"ok": True, "need": need, "matches": decision.matches,
+               "outliers": [[s, why] for s, why in decision.outliers],
+               "quorum": decision.winner is not None}
+        if decision.winner is not None:
+            out.update(_reply_dict(decision.winner))
+        return out
 
     def _fault(self, req: dict) -> dict:
         """Socket-level chaos: the same fault vocabulary the in-process

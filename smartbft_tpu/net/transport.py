@@ -70,6 +70,8 @@ from .framing import (
     DEFAULT_MAX_FRAME_BYTES,
     FT_CONSENSUS,
     FT_HELLO,
+    FT_READ_REQ,
+    FT_READ_RESP,
     FT_REJECT,
     FT_REQUEST,
     FT_SNAP_REQ,
@@ -80,6 +82,8 @@ from .framing import (
     FrameDecoder,
     FrameError,
     Hello,
+    ReadRequest,
+    ReadResponse,
     RejectFrame,
     SnapshotChunk,
     SnapshotFetchRequest,
@@ -149,6 +153,16 @@ class TransportMetrics:
         "sync_batches", "sync_bytes", "snap_requests", "snap_chunks_sent",
         "snap_chunks_received", "snap_bytes_sent", "snap_bytes_received",
         "sync_poisoned",
+        # ISSUE 19: the read/serving plane.  read_requests counts inbound
+        # FT_READ_REQ served by this node, read_responses the replies that
+        # resolved a local waiter; read_sheds_sent counts reads the LOCAL
+        # token-bucket gate refused (the serving side of "a read storm
+        # degrades reads, never writes"), read_sheds_received the shed
+        # replies this node's clients got back; read_base_refused counts
+        # read-at-base requests refused because the snapshot base was
+        # torn/tampered/absent (the loud-refusal satellite).
+        "read_requests", "read_responses", "read_sheds_sent",
+        "read_sheds_received", "read_base_refused",
     )
 
     def __init__(self) -> None:
@@ -253,6 +267,13 @@ class SocketComm(Comm):
         #:     snapshot is gone (superseded mid-transfer) and the
         #:     requester must restart against the current offer.
         self.snapshot_server = None
+        #: read-plane server hook (ISSUE 19), duck-typed like sync_server:
+        #: (framing.ReadRequest) -> framing.ReadResponse, answered from
+        #: COMMITTED state only.  The embedder owns the token-bucket gate
+        #: and returns a shed-shaped response when it refuses; the
+        #: transport just counts and carries.  None = reads unserved
+        #: (requester times out, same as a down peer).
+        self.read_server: Optional[Callable[[ReadRequest], ReadResponse]] = None
         #: optional embedder hook: (sender_id, framing.RejectFrame) called
         #: on every received FT_REJECT (the peer shed a request this node
         #: forwarded); the last few frames are kept in `rejects` either way
@@ -271,6 +292,7 @@ class SocketComm(Comm):
         self._inbound_writers: set[asyncio.StreamWriter] = set()
         self._sync_waiters: dict[int, asyncio.Future] = {}
         self._snap_waiters: dict[int, asyncio.Future] = {}
+        self._read_waiters: dict[int, asyncio.Future] = {}
         self._sync_nonce = 0
         self._started = False
         self._closing = False
@@ -385,6 +407,10 @@ class SocketComm(Comm):
             if not fut.done():
                 fut.cancel()
         self._snap_waiters.clear()
+        for fut in self._read_waiters.values():
+            if not fut.done():
+                fut.cancel()
+        self._read_waiters.clear()
         scheme, hostpath, _ = parse_addr(self.listen)
         if scheme == "uds":
             import os
@@ -794,6 +820,12 @@ class SocketComm(Comm):
                 elif ftype == FT_SNAP_RESP:
                     await self._flush_consensus(run)
                     self._resolve_snapshot(payload)
+                elif ftype == FT_READ_REQ:
+                    await self._flush_consensus(run)
+                    self._serve_read(sender, payload)
+                elif ftype == FT_READ_RESP:
+                    await self._flush_consensus(run)
+                    self._resolve_read(payload)
                 else:  # FT_HELLO after handshake: tolerated no-op
                     continue
             await self._flush_consensus(run)
@@ -1027,6 +1059,52 @@ class SocketComm(Comm):
             buf += chunk.data
             if chunk.last or len(buf) >= chunk.total_bytes:
                 return bytes(buf)
+
+    # ------------------------------------------------------------ read RPC
+
+    def _serve_read(self, sender: int, payload: bytes) -> None:
+        req = decode(ReadRequest, payload)  # CodecError -> drop conn
+        self.metrics.read_requests += 1
+        server = self.read_server
+        if server is None:
+            return  # unserved: the requester times out, same as a down peer
+        resp = server(req)
+        if resp is None:
+            return
+        if resp.shed:
+            self.metrics.read_sheds_sent += 1
+        self._enqueue(sender, encode_frame(FT_READ_RESP, encode(resp)))
+
+    def _resolve_read(self, payload: bytes) -> None:
+        resp = decode(ReadResponse, payload)  # CodecError -> drop conn
+        self.metrics.read_responses += 1
+        if resp.shed:
+            self.metrics.read_sheds_received += 1
+        fut = self._read_waiters.pop(resp.nonce, None)
+        if fut is not None and not fut.done():
+            fut.set_result(resp)
+
+    async def request_read(self, target: int, key: str, *,
+                           at_base: bool = False,
+                           timeout: float = 2.0) -> Optional[ReadResponse]:
+        """One keyed read round trip to ``target``; None on timeout / peer
+        down.  A shed reply IS returned (``resp.shed``) — the caller owns
+        retry-after handling, exactly like the FT_REJECT contract."""
+        self._sync_nonce += 1
+        nonce = self._sync_nonce
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._read_waiters[nonce] = fut
+        req = ReadRequest(nonce=nonce, key=key, at_base=at_base)
+        t0 = perf_counter()
+        self._enqueue(target, encode_frame(FT_READ_REQ, encode(req)))
+        try:
+            resp = await asyncio.wait_for(fut, timeout)
+            self._note_rtt(target, perf_counter() - t0)
+            return resp
+        except (asyncio.TimeoutError, asyncio.CancelledError):
+            return None
+        finally:
+            self._read_waiters.pop(nonce, None)
 
     # ------------------------------------------------------------ RTT
 

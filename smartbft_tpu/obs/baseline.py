@@ -73,6 +73,12 @@ FAMILY_THRESHOLD_PCT = {
     # pinned at the ideal 1.0: fail only when deep-history snapshot
     # rejoin exceeds 2x the shallow one (the ISSUE 17 acceptance bound)
     "rejoin_flatness_vs_depth": 100.0,
+    # single-digit wall ms over connect-per-call sockets: run-to-run
+    # weather on the contended 1-core rig dwarfs the 35% default
+    "read_p99_ms": 100.0,
+    # the ISSUE 19 acceptance is scaling strictly above 1.0; pinned at
+    # the measured ~2.17x for n=8/n=4, 45% still fails below ~1.2x
+    "read_scaling_vs_n": 45.0,
 }
 
 

@@ -27,6 +27,7 @@ from __future__ import annotations
 from typing import Optional
 
 __all__ = ["SCHEMA_VERSION", "ROW_SCHEMAS", "assemble_rejoin_row",
+           "assemble_read_row", "assemble_read_scaling_row",
            "identify_row", "validate_row", "validate_rows"]
 
 #: bump when a row family's required shape changes incompatibly
@@ -246,6 +247,36 @@ ROW_SCHEMAS: dict = {
                      "spike_acked": _NUM, "healthy_spike_acked": _NUM,
                      "latency": _LATENCY_BLOCK, "healthy_latency": _DICT},
     },
+    # assemble_read_row (ISSUE 19) — mixed 95/5 read/write sweep against
+    # the socket cluster: wall-clock quorum-read p99 next to the SAME
+    # run's write (submit->committed) p99.  The read plane never touches
+    # consensus, so the pinned contrast is reads staying far under the
+    # write path; the storm block records that an over-gate read flood
+    # shed READS while the concurrent writes kept committing.
+    "read_p99_ms": {
+        "required": {"metric": _STR, "value": _NUM, "unit": _STR,
+                     "write_p99_ms": _NUM, "nodes": _NUM, "reads": _NUM},
+        "optional": {"writes": _NUM, "vs_write": _NUM, "mode": _STR,
+                     "local_p99_ms": _NUM, "follower_p99_ms": _NUM,
+                     "read_sheds": _NUM, "storm": _DICT, "read": _DICT},
+    },
+    # assemble_read_scaling_row (ISSUE 19) — aggregate read capacity at
+    # n=8 over n=4 at fixed S.  Local reads touch ONLY their serving
+    # replica (no fan-out, no consensus work), so cluster read capacity
+    # is n x the measured per-replica service rate; the row carries both
+    # per-replica rates so a flat-with-n service rate (the isolation
+    # invariant) is what the guard actually pins.  On a multi-core host
+    # the aggregate is realized parallelism; on a 1-core rig it is
+    # capacity aggregation under that measured invariant.
+    "read_scaling_vs_n": {
+        "required": {"metric": _STR, "value": _NUM, "unit": _STR,
+                     "nodes_small": _NUM, "nodes_large": _NUM},
+        "optional": {"reads_per_sec_small": _NUM,
+                     "reads_per_sec_large": _NUM,
+                     "per_replica_rate_small": _NUM,
+                     "per_replica_rate_large": _NUM,
+                     "rate_flatness": _NUM, "ideal": _NUM},
+    },
     # obs.baseline.tiny_logical_row — the tier-1 regression-gate row
     # (value = mean logical commit latency; percentiles ride in "latency")
     "tiny_logical_commit_ms": {
@@ -292,6 +323,82 @@ def assemble_rejoin_row(*, history: int, mode: str, rejoin_s: float,
     if vs_small_history is not None:
         row["vs_small_history"] = round(float(vs_small_history), 4)
     return row
+
+
+def assemble_read_row(*, read_p99_ms: float, write_p99_ms: float,
+                      nodes: int, reads: int, writes: Optional[int] = None,
+                      mode: str = "quorum",
+                      local_p99_ms: Optional[float] = None,
+                      follower_p99_ms: Optional[float] = None,
+                      read_sheds: Optional[int] = None,
+                      storm: Optional[dict] = None,
+                      read_stats: Optional[dict] = None) -> dict:
+    """The ``read_p99_ms`` bench row (ISSUE 19), as a PURE function so
+    the tier-1 schema gate can validate synthetic rows without running
+    the bench.  ``read_p99_ms`` is the wall-clock p99 of ``mode`` reads
+    during the mixed 95/5 phase; ``write_p99_ms`` the SAME phase's
+    submit->committed p99 — the pinned contrast is the read plane never
+    paying consensus latency."""
+    if mode not in ("local", "follower", "quorum"):
+        raise ValueError(f"mode must be local/follower/quorum, got {mode!r}")
+    row = {
+        "metric": "read_p99_ms",
+        "value": round(float(read_p99_ms), 3),
+        "unit": "ms",
+        "write_p99_ms": round(float(write_p99_ms), 3),
+        "nodes": int(nodes),
+        "reads": int(reads),
+        "mode": mode,
+    }
+    if write_p99_ms:
+        row["vs_write"] = round(float(read_p99_ms) / float(write_p99_ms), 4)
+    if writes is not None:
+        row["writes"] = int(writes)
+    if local_p99_ms is not None:
+        row["local_p99_ms"] = round(float(local_p99_ms), 3)
+    if follower_p99_ms is not None:
+        row["follower_p99_ms"] = round(float(follower_p99_ms), 3)
+    if read_sheds is not None:
+        row["read_sheds"] = int(read_sheds)
+    if storm is not None:
+        row["storm"] = dict(storm)
+    if read_stats is not None:
+        row["read"] = dict(read_stats)
+    return row
+
+
+def assemble_read_scaling_row(*, per_replica_rate_small: float,
+                              per_replica_rate_large: float,
+                              nodes_small: int, nodes_large: int) -> dict:
+    """The ``read_scaling_vs_n`` bench row (ISSUE 19): aggregate read
+    capacity (n x measured per-replica local-read service rate) at
+    ``nodes_large`` over ``nodes_small``.  ``rate_flatness`` is the
+    per-replica rate ratio large/small — the isolation invariant (a
+    local read costs the same no matter the cluster size) that makes
+    the aggregate claim honest on any core count."""
+    if nodes_small <= 0 or nodes_large <= nodes_small:
+        raise ValueError(
+            f"need 0 < nodes_small < nodes_large, got "
+            f"{nodes_small}/{nodes_large}"
+        )
+    if per_replica_rate_small <= 0 or per_replica_rate_large <= 0:
+        raise ValueError("per-replica rates must be positive")
+    agg_small = per_replica_rate_small * nodes_small
+    agg_large = per_replica_rate_large * nodes_large
+    return {
+        "metric": "read_scaling_vs_n",
+        "value": round(agg_large / agg_small, 4),
+        "unit": "ratio",
+        "nodes_small": int(nodes_small),
+        "nodes_large": int(nodes_large),
+        "reads_per_sec_small": round(agg_small, 1),
+        "reads_per_sec_large": round(agg_large, 1),
+        "per_replica_rate_small": round(float(per_replica_rate_small), 1),
+        "per_replica_rate_large": round(float(per_replica_rate_large), 1),
+        "rate_flatness": round(
+            per_replica_rate_large / per_replica_rate_small, 4),
+        "ideal": round(nodes_large / nodes_small, 4),
+    }
 
 
 def identify_row(row: dict) -> Optional[str]:

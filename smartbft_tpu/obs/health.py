@@ -218,6 +218,52 @@ def snapshot_signal_source(disk_fn: Callable[[], dict]) -> Callable:
     return signals
 
 
+def read_signal_source(stats_fn: Callable[[], dict], *, clock=None,
+                       latch_s: float = 5.0) -> Callable:
+    """Read-plane signals (ISSUE 19) from a ``ReadStats.snapshot`` dict:
+
+    - ``read.shed_recent`` — 1.0 while the read gate shed within the
+      latch window (a read storm being absorbed: degraded by design,
+      and proof the storm is NOT reaching the write path);
+    - ``read.base_refused_recent`` — 1.0 while a read-at-base was
+      refused over a torn/tampered snapshot within the window (an
+      integrity event, not load);
+    - ``read.staleness_decisions`` — the worst anchor lag served,
+      latched while snapshot-anchored reads are actively landing.
+
+    An idle read plane emits nothing — absent signals never breach,
+    matching the snapshot source's opt-in contract."""
+    import time
+
+    clk = clock if clock is not None else time.monotonic
+    shed = EventLatch(latch_s)
+    refused = EventLatch(latch_s)
+    staleness = EventLatch(latch_s)
+
+    def signals() -> dict:
+        try:
+            stats = stats_fn() or {}
+        except Exception:  # noqa: BLE001 — telemetry only
+            return {}
+        now = clk()
+        shed_live = shed.update(float(stats.get("sheds", 0)), 1.0, now)
+        refused_live = refused.update(
+            float(stats.get("base_refused", 0)), 1.0, now)
+        stale_live = staleness.update(
+            float(stats.get("served_base", 0)),
+            float(stats.get("lag_max", 0)), now)
+        if not (stats.get("served", 0) or stats.get("sheds", 0)
+                or stats.get("base_refused", 0)):
+            return {}
+        out = {"read.shed_recent": shed_live,
+               "read.base_refused_recent": refused_live}
+        if stats.get("served_base", 0):
+            out["read.staleness_decisions"] = stale_live
+        return out
+
+    return signals
+
+
 def latency_signal_source(tracker) -> Callable:
     """``latency.commit_p99_ms`` from a CommitLatencyTracker aggregate."""
 

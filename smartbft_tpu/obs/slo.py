@@ -220,6 +220,33 @@ def default_slo_spec(*, fast_window_s: float = 5.0,
                         "emitted when snapshots are enabled, so replicas "
                         "running without compaction never breach it)",
         ),
+        SLORule(
+            name="read.shed_recent",
+            signal="read.shed_recent",
+            bound=0.0, kind="ceiling", budget=0.2, **w,
+            description="1.0 while the read gate shed reads within the "
+                        "recent window (ISSUE 19: a read storm being "
+                        "absorbed — degraded for readers, and proof the "
+                        "storm never reached the write path)",
+        ),
+        SLORule(
+            name="read.base_refused_recent",
+            signal="read.base_refused_recent",
+            bound=0.0, critical_bound=0.5, kind="ceiling", budget=0.2, **w,
+            description="1.0 while a snapshot-anchored read was refused "
+                        "over a torn/tampered base within the window — an "
+                        "integrity event, critical on repetition",
+        ),
+        SLORule(
+            name="read.staleness_decisions",
+            signal="read.staleness_decisions",
+            bound=1024.0, critical_bound=8192.0, kind="ceiling",
+            budget=0.2, **w,
+            description="worst anchor lag (decisions behind the live "
+                        "frontier) served by snapshot-anchored reads while "
+                        "they are actively landing — bounded by the capture "
+                        "cadence on a healthy replica",
+        ),
     ))
 
 

@@ -146,6 +146,26 @@ class ShardHandle(abc.ABC):
         occ = self.pool_occupancy() or {}
         return int(occ.get("waiters", 0))
 
+    # -- read plane surface (optional; ISSUE 19) ---------------------------
+
+    def read_replies(self, key: str) -> Optional[list]:
+        """Stamped committed-state read replies for ``key`` from this
+        shard's replicas, as ``(sender, reply)`` pairs — the quorum
+        fan-out's input (each reply exposes the ``core.readplane`` stamp
+        fields).  None = this handle cannot serve reads."""
+        return None
+
+    def read_quorum_need(self) -> int:
+        """Matching stamps that prove commitment for this shard's
+        membership (``f+1``)."""
+        return 1
+
+    def note_read_outliers(self, outliers: list) -> None:
+        """Attribute quorum-read outliers (``(sender, why)`` pairs that
+        contradicted an accepted f+1 stamp) to the shard's misbehavior
+        accounting — OBSERVED-only evidence, never a shun input (read
+        replies are unsigned).  Default: unsupported, drop."""
+
     # -- snapshot handoff surface (optional; ISSUE 17) ---------------------
 
     def capture_snapshot(self) -> Optional[dict]:
@@ -259,6 +279,12 @@ class ShardSet:
         self._transition: Optional[_Transition] = None
         self.reshard_stats: dict = {"transitions": 0, "aborts": 0,
                                     "last": None}
+        #: front-door read accounting (ISSUE 19): quorum reads routed
+        #: through :meth:`read` — served/no-quorum/outlier counts for the
+        #: ``read`` stats block (per-replica serving counters live on the
+        #: handles' replicas)
+        self.read_stats: dict = {"reads": 0, "served": 0, "no_quorum": 0,
+                                 "unsupported": 0, "outliers": 0}
         self._recovered: Optional[dict] = None
         if journal is not None:
             self._recover(journal)
@@ -568,6 +594,56 @@ class ShardSet:
             # in an earlier call)
             self.mux.prune(min(start, max(0, self.mux.total()
                                           - self.retention)))
+        return out
+
+    def read(self, client_id, *, max_lag_decisions: int = 0) -> dict:
+        """Route a committed-state READ to ``client_id``'s owning shard
+        and decide it with the ``f+1`` match rule (ISSUE 19) — no pool,
+        no proposer, no verify launch, and never a consensus round.
+
+        The owning shard fans the read across its replicas
+        (``read_replies``), and :func:`~smartbft_tpu.core.readplane.
+        quorum_read_decide` accepts when ``f+1`` bit-identical
+        ``(found, value, height, digest)`` stamps agree.  Returns the
+        decided stamp plus the fan-out accounting; ``ok`` False when no
+        stamp reached quorum (partition/churn — retry) or the shard
+        cannot serve reads."""
+        from ..core.readplane import quorum_read_decide
+
+        self.read_stats["reads"] += 1
+        sid = self.router.route(client_id, epoch=self._epoch)
+        shard = self.shards.get(sid)
+        replies = (shard.read_replies(str(client_id))
+                   if shard is not None else None)
+        if replies is None:
+            self.read_stats["unsupported"] += 1
+            return {"ok": False, "shard": sid,
+                    "error": "shard cannot serve reads"}
+        need = shard.read_quorum_need()
+        decision = quorum_read_decide(
+            replies, need, max_lag_decisions=max_lag_decisions
+        )
+        self.read_stats["outliers"] += len(decision.outliers)
+        if decision.outliers:
+            # same attribution the socket plane's quorum edge performs:
+            # observed-only `stale_read` evidence against the outlier
+            shard.note_read_outliers(list(decision.outliers))
+        out = {
+            "ok": decision.winner is not None,
+            "shard": sid,
+            "need": need,
+            "matches": decision.matches,
+            "outliers": [(s, why) for s, why in decision.outliers],
+        }
+        w = decision.winner
+        if w is None:
+            self.read_stats["no_quorum"] += 1
+            return out
+        self.read_stats["served"] += 1
+        out.update(
+            found=bool(w.found), value=bytes(w.value),
+            height=int(w.height), state_digest=bytes(w.state_digest),
+        )
         return out
 
     def committed_requests(self, shard_id: Optional[int] = None) -> int:
@@ -899,4 +975,5 @@ class ShardSet:
         reshard["in_progress"] = self.reshard_phase
         reshard["watermarks"] = self.mux.snapshot()["watermarks"]
         return {"per_shard": per_shard, "aggregate": agg, "reshard": reshard,
-                "latency": self.latency.snapshot()}
+                "latency": self.latency.snapshot(),
+                "read": dict(self.read_stats)}

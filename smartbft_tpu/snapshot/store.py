@@ -90,10 +90,21 @@ class AppState:
     request_count: int = 0
     ids_digest: bytes = b""
     recent_ids: list[str] = None  # type: ignore[assignment]
+    #: the committed KV view the read plane serves (ISSUE 19): key ->
+    #: latest committed payload, as parallel lists (the codec's untagged
+    #: encoding has no dict shape).  Must ride the snapshot or a
+    #: compaction would silently forget every key behind the horizon —
+    #: O(distinct keys), which the test embedders bound by client count.
+    kv_keys: list[str] = None  # type: ignore[assignment]
+    kv_values: list[bytes] = None  # type: ignore[assignment]
 
     def __post_init__(self):
         if self.recent_ids is None:
             object.__setattr__(self, "recent_ids", [])
+        if self.kv_keys is None:
+            object.__setattr__(self, "kv_keys", [])
+        if self.kv_values is None:
+            object.__setattr__(self, "kv_values", [])
 
 
 @wiremsg
@@ -367,6 +378,27 @@ class SnapshotStore:
 
     def load(self, height: int) -> Optional[Snapshot]:
         return self._read(os.path.join(self.dir, _snap_name(height)))
+
+    def read_range(self, height: int, offset: int,
+                   max_bytes: int) -> tuple[int, bytes, bool]:
+        """One bounded byte slice of the snapshot FILE at ``height`` —
+        ``(total_bytes, data, last)`` with ``total_bytes == 0`` when the
+        file is gone (superseded/pruned: the chunked-transfer requester
+        restarts against the current offer).  This is the single
+        file-open surface both the FT_SNAP chunk server and the
+        read-plane's read-at-base path go through; integrity of the
+        WHOLE file is the caller's side of the contract (`load` for the
+        verified-object path, the transfer receiver's parse for the
+        chunked path)."""
+        path = os.path.join(self.dir, _snap_name(height))
+        try:
+            total = os.path.getsize(path)
+            with open(path, "rb") as fh:
+                fh.seek(offset)
+                data = fh.read(max(0, max_bytes))
+        except OSError:
+            return 0, b"", False
+        return total, data, offset + len(data) >= total
 
     def latest(self) -> Optional[Snapshot]:
         """The newest snapshot that passes blob verification, or None."""

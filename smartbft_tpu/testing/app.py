@@ -214,6 +214,25 @@ class App(Application, Assembler, Comm, Signer, Verifier, RequestInspector,
         self.base_ids_digest = ""
         self.base_request_count = 0
         self.base_recent_ids: list[str] = []
+        self.base_kv: dict[str, bytes] = {}
+        # read plane (ISSUE 19): the committed KV view (key = client id,
+        # value = latest committed payload), folded LAZILY from the
+        # shared ledger on each read — O(new decisions) per read via the
+        # scan cursor, so the view needs no hook in deliver.  The chain
+        # digest is folded alongside so read stamps carry it without an
+        # O(ledger) capture per read.  Reads get their own token-bucket
+        # gate (off by default) and stats block, same as the socket
+        # embedder.
+        from ..core.readplane import ReadStats, TokenBucket
+
+        self._kv: dict[str, bytes] = {}
+        self._read_scan = 0
+        self._read_chain: Optional[bytes] = None
+        self._read_gate = TokenBucket(self.config.read_gate_rate,
+                                      self.config.read_gate_burst,
+                                      clock=scheduler.now if scheduler
+                                      is not None else None)
+        self.read_stats = ReadStats()
         self.consensus: Optional[Consensus] = None
         self._wal = None
         # transport seam: either the in-process Network (default) or a real
@@ -509,6 +528,8 @@ class App(Application, Assembler, Comm, Signer, Verifier, RequestInspector,
             heartbeat_tick_interval=0.2,
             recorder=self.recorder,
         )
+        # read plane (ISSUE 19): committed-state reads through the facade
+        self.consensus.read_hook = self.read_committed
         if self.comm is not None:
             # real transport: point ingest at the fresh Consensus and open
             # the sockets; frames enqueued by consensus.start() (heartbeats,
@@ -579,6 +600,77 @@ class App(Application, Assembler, Comm, Signer, Verifier, RequestInspector,
     def height(self) -> int:
         return self.shared.height(self.id)
 
+    # -- read plane (ISSUE 19) ---------------------------------------------
+
+    def _read_view(self, key: str) -> tuple[int, bytes, Optional[bytes]]:
+        """Fold the shared ledger's NEW decisions into the committed KV
+        view and running chain digest, then answer ``key`` — all under
+        one lock hold so the ``(value, height, digest)`` stamp is a
+        consistent cut (never a value newer than its stamped height)."""
+        from ..snapshot import CHAIN_SEED, chain_update
+
+        ledger = self.shared.get(self.id)
+        with self.lock:
+            if self._read_scan > len(ledger) or self._read_chain is None:
+                # first read, or a fresh shared view: (re)build from the
+                # installed base
+                self._read_scan = 0
+                self._kv = dict(self.base_kv)
+                self._read_chain = (bytes.fromhex(self.base_digest)
+                                    if self.base_digest else CHAIN_SEED)
+            for d in ledger[self._read_scan:]:
+                self._read_chain = chain_update(self._read_chain,
+                                                d.proposal.payload,
+                                                d.proposal.metadata)
+                if not d.proposal.payload:
+                    continue
+                try:
+                    batch = decode(BatchPayload, d.proposal.payload)
+                except Exception:  # noqa: BLE001 — foreign payload
+                    continue
+                for raw in batch.requests:
+                    try:
+                        req = decode(TestRequest, raw)
+                    except Exception:  # noqa: BLE001 — foreign request
+                        continue
+                    self._kv[req.client_id] = bytes(req.payload)
+            self._read_scan = len(ledger)
+            return (self.base_height + len(ledger), self._read_chain,
+                    self._kv.get(key))
+
+    def serve_read(self, key: str):
+        """One keyed read from committed state, stamped — the in-process
+        twin of ``ReplicaApp._serve_read`` (same gate, same reply shape),
+        which is what lets the shard front door, the chaos oracle, and
+        the bench apply the client-side rules of ``core.readplane``
+        unchanged across both embedders."""
+        from ..net.framing import ReadResponse
+
+        if not self._read_gate.allow():
+            self.read_stats.sheds += 1
+            spent, burst = self._read_gate.occupancy()
+            return ReadResponse(
+                key=key, shed=True, shed_kind="read_gate",
+                retry_after_ms=int(self._read_gate.retry_after() * 1000),
+                occupancy=spent, high_water=burst,
+            )
+        height, chain, value = self._read_view(key)
+        found = value is not None
+        self.read_stats.note_served(at_base=False, found=found)
+        return ReadResponse(
+            key=key, found=found, value=value if found else b"",
+            height=height, state_digest=chain,
+            anchor_height=self.base_height, at_base=False,
+        )
+
+    def read_committed(self, key: str):
+        """The facade ``read_hook`` shape: ``(value, height,
+        state_digest, anchor_height)`` or None when never written."""
+        height, chain, value = self._read_view(key)
+        if value is None:
+            return None
+        return value, height, chain, self.base_height
+
     # -- snapshot handoff (ISSUE 17) ---------------------------------------
 
     def capture_snapshot(self) -> dict:
@@ -601,6 +693,7 @@ class App(Application, Assembler, Comm, Signer, Verifier, RequestInspector,
                       if self.base_ids_digest else CHAIN_SEED)
         count = self.base_request_count
         recent = list(self.base_recent_ids)
+        kv = dict(self.base_kv)
         ledger = self.ledger()
         for d in ledger:
             chain = chain_update(chain, d.proposal.payload,
@@ -613,12 +706,29 @@ class App(Application, Assembler, Comm, Signer, Verifier, RequestInspector,
             ids_digest = fold_ids(ids_digest, ids)
             count += len(ids)
             recent.extend(ids)
+            if not d.proposal.payload:
+                continue
+            try:
+                batch = decode(BatchPayload, d.proposal.payload)
+            except Exception:  # noqa: BLE001 — foreign payload
+                continue
+            for raw in batch.requests:
+                try:
+                    req = decode(TestRequest, raw)
+                except Exception:  # noqa: BLE001 — foreign request
+                    continue
+                kv[req.client_id] = bytes(req.payload)
         return {
             "height": self.base_height + len(ledger),
             "chain_digest": chain.hex(),
             "ids_digest": ids_digest.hex(),
             "request_count": count,
             "recent_ids": recent[-RECENT_IDS_CAP:],
+            # the committed KV view rides the handoff so a seeded node's
+            # read stamps match a full-history node's bit-for-bit (ISSUE
+            # 19: keys whose last write predates the base must not
+            # vanish from quorum reads after a scale-out)
+            "kv": {k: v.hex() for k, v in kv.items()},
         }
 
     def install_base_state(self, snapshot: dict) -> None:
@@ -637,6 +747,8 @@ class App(Application, Assembler, Comm, Signer, Verifier, RequestInspector,
         self.base_request_count = int(snapshot.get("request_count", 0))
         self.base_recent_ids = [str(r) for r in
                                 snapshot.get("recent_ids", [])]
+        self.base_kv = {str(k): bytes.fromhex(v) for k, v in
+                        (snapshot.get("kv") or {}).items()}
 
     def _seed_pool_dedup(self) -> None:
         pool = getattr(self.consensus, "pool", None)

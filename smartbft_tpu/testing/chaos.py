@@ -807,6 +807,7 @@ class ChaosCluster:
         submit_every: float = 0.3,
         settle_timeout: float = 300.0,
         step: float = 0.05,
+        on_tick: Optional[Callable[[float], None]] = None,
     ) -> ChaosReport:
         """Execute the schedule under load and drain to quiescence.
 
@@ -917,6 +918,10 @@ class ChaosCluster:
             report.submitted = submitted
             # 2b. open-loop spike arrivals (when a load_spike is active)
             pump_spike()
+            # 2c. caller-driven side traffic (ISSUE 19: read probes that
+            # must land DURING faults, not after the drain)
+            if on_tick is not None:
+                on_tick(now)
             # 3. bookkeeping (latency/occupancy scans only when an
             # overload measurement is live — schedules without a spike
             # must not pay per-tick ledger decoding for an empty tracker)
@@ -1010,6 +1015,72 @@ class Invariants:
             assert seqs == list(range(1, len(seqs) + 1)), (
                 f"node {a.id} has a sequence gap: {seqs}"
             )
+
+    @staticmethod
+    def reads_linearizable(cluster: ChaosCluster, observations: list) -> int:
+        """Every stamped read matches the committed state AT ITS HEIGHT.
+
+        ``observations`` are ``(key, found, value, height)`` stamps a
+        client collected during the run (any mode — local, follower, or
+        the f+1 winner).  The oracle replays a live replica's committed
+        prefix into an independent per-height KV timeline (the same
+        last-write-per-client fold the serving plane uses, rebuilt from
+        scratch here) and asserts each stamp against the state at its
+        height — a read that returned a value its stamped height had not
+        committed, or missed one it had, is a linearizability violation
+        no matter what the cluster was doing when it was served.
+
+        Returns the number of stamps checked.  Stamps below the
+        replayer's snapshot base are uncheckable (their prefix was
+        compacted away) and skipped."""
+        from .app import BatchPayload, TestRequest
+
+        apps = cluster.live_apps()
+        assert apps, "no live replica to replay against"
+        app = min(apps, key=lambda a: a.base_height)
+        kv = dict(app.base_kv)
+        timeline = [dict(kv)]  # timeline[i] = state at base_height + i
+        for d in app.ledger():
+            if d.proposal.payload:
+                try:
+                    batch = decode(BatchPayload, d.proposal.payload)
+                except Exception:  # noqa: BLE001 — foreign payload
+                    batch = None
+                if batch is not None:
+                    for raw in batch.requests:
+                        try:
+                            req = decode(TestRequest, raw)
+                        except Exception:  # noqa: BLE001
+                            continue
+                        kv[req.client_id] = bytes(req.payload)
+            timeline.append(dict(kv))
+        base = app.base_height
+        checked = 0
+        for key, found, value, height in observations:
+            idx = int(height) - base
+            if idx < 0:
+                continue  # pre-base stamp: prefix compacted, uncheckable
+            assert idx < len(timeline), (
+                f"read of {key!r} stamped height {height} beyond the "
+                f"committed frontier {base + len(timeline) - 1}"
+            )
+            expect = timeline[idx].get(str(key))
+            if found:
+                assert expect is not None, (
+                    f"read of {key!r} at height {height} returned a value "
+                    f"but nothing was committed for it by then"
+                )
+                assert bytes(value) == expect, (
+                    f"read of {key!r} at height {height} returned "
+                    f"{bytes(value)!r}, committed state says {expect!r}"
+                )
+            else:
+                assert expect is None, (
+                    f"read of {key!r} at height {height} found nothing, "
+                    f"but {expect!r} was committed by then"
+                )
+            checked += 1
+        return checked
 
     @staticmethod
     def ever_blacklisted(cluster: ChaosCluster, node_id: int) -> None:

@@ -220,6 +220,49 @@ class AppShard(ShardHandle):
                 total += int(a.consensus.pool_occupancy().get("waiters", 0))
         return total
 
+    # -- read plane surface (ISSUE 19) -------------------------------------
+
+    def read_replies(self, key: str) -> list:
+        """Fan a committed-state read across this shard's LIVE replicas;
+        each reply is stamped by :meth:`testing.app.App.serve_read`, so
+        the ShardSet's ``f+1`` match rule applies unchanged."""
+        return [(a.id, a.serve_read(key)) for a in self.live_apps()]
+
+    def read_quorum_need(self) -> int:
+        from ..core.util import compute_quorum
+
+        _q, f = compute_quorum(len(self.apps))
+        return f + 1
+
+    def note_read_outliers(self, outliers: list) -> None:
+        """Mirror the socket plane's quorum-read attribution: every
+        live replica records the outlier as OBSERVED-only ``stale_read``
+        evidence (counted for the operator, never fed to the shun
+        score — read replies are unsigned)."""
+        for a in self.live_apps():
+            if a.consensus is None:
+                continue
+            for sender, _why in outliers:
+                a.consensus.misbehavior.note(int(sender), "stale_read")
+
+    def read_stats_block(self) -> dict:
+        """Serving-side read counters over this shard's replicas —
+        counters sum, the lag gauges keep their worst/weighted shape."""
+        totals: dict = {}
+        for a in self.apps:
+            snap = a.read_stats.snapshot()
+            for k, v in snap.items():
+                if k == "lag_max":
+                    totals[k] = max(totals.get(k, 0), v)
+                elif k == "lag_mean":
+                    continue  # recomputed below from the sums
+                else:
+                    totals[k] = totals.get(k, 0) + v
+        lag_sum = sum(a.read_stats.lag_sum for a in self.apps)
+        served = totals.get("served", 0)
+        totals["lag_mean"] = round(lag_sum / served, 3) if served else 0.0
+        return totals
+
     def stats_block(self) -> dict:
         return {
             "height": self.height(),
@@ -227,6 +270,7 @@ class AppShard(ShardHandle):
             "plane": ProtocolPlaneTimers.delta(
                 self._plane_base, self.plane.snapshot()
             ),
+            "read": self.read_stats_block(),
         }
 
     # -- queries -----------------------------------------------------------

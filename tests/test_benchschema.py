@@ -252,3 +252,48 @@ def test_byzantine_row_validates_and_guards_missing_p99():
     assert row["shun_events"] == 3 and row["shed_votes"] == 200
     with pytest.raises(RuntimeError, match="no spike request"):
         bench.assemble_byzantine_row(probe(90.0), {"latency": {}})
+
+
+def test_read_rows_validate_and_guard_bad_inputs():
+    """The ISSUE 19 read-plane pins: synthetic rows through the SAME
+    pure assemble fns ``bench.py --mixed-read`` (benchmarks/readplane.py)
+    calls must validate, and nonsense inputs fail loudly instead of
+    emitting drifting rows."""
+    import pytest
+
+    from smartbft_tpu.obs.benchschema import (
+        assemble_read_row,
+        assemble_read_scaling_row,
+    )
+
+    row = assemble_read_row(
+        read_p99_ms=6.3, write_p99_ms=42.8, nodes=4, reads=190, writes=10,
+        mode="quorum", local_p99_ms=2.6, follower_p99_ms=1.4, read_sheds=0,
+        storm={"offered": 600, "sheds": 437, "writes_committed": 5},
+        read_stats={"served": 377, "sheds": 437},
+    )
+    assert identify_row(row) == "read_p99_ms"
+    assert validate_row(row) == [], validate_row(row)
+    assert row["vs_write"] == round(6.3 / 42.8, 4)
+    assert row["storm"]["sheds"] == 437
+    with pytest.raises(ValueError, match="mode"):
+        assemble_read_row(read_p99_ms=1.0, write_p99_ms=2.0, nodes=4,
+                          reads=10, mode="psychic")
+
+    scaling = assemble_read_scaling_row(
+        per_replica_rate_small=2500.0, per_replica_rate_large=2700.0,
+        nodes_small=4, nodes_large=8,
+    )
+    assert identify_row(scaling) == "read_scaling_vs_n"
+    assert validate_row(scaling) == [], validate_row(scaling)
+    assert scaling["value"] == round((2700.0 * 8) / (2500.0 * 4), 4)
+    assert scaling["rate_flatness"] == round(2700.0 / 2500.0, 4)
+    assert scaling["ideal"] == 2.0
+    with pytest.raises(ValueError, match="nodes"):
+        assemble_read_scaling_row(per_replica_rate_small=1.0,
+                                  per_replica_rate_large=1.0,
+                                  nodes_small=4, nodes_large=4)
+    with pytest.raises(ValueError, match="positive"):
+        assemble_read_scaling_row(per_replica_rate_small=0.0,
+                                  per_replica_rate_large=1.0,
+                                  nodes_small=4, nodes_large=8)

@@ -84,6 +84,34 @@ def test_uds_multiprocess_smoke_gate(tmp_path):
         problems = lint_prometheus_text(cluster.metrics_text(leader))
         assert problems == [], problems
 
+        # -- ISSUE 19: the read plane over the same live cluster.  A
+        # committed key reads back in all three modes without a single
+        # extra consensus decision, and a watch sees the next commit.
+        ctl = cluster.control(leader)
+        height_before = cluster.heights()[leader]
+        local = ctl.call(cmd="read", key="smoke")
+        assert local["found"] and local["height"] >= total
+        fol = ctl.call(cmd="read", key="fwd", mode="follower",
+                       frontier=height_before, max_lag=0)
+        assert fol["found"] and fol["accepted"] is True
+        q = ctl.call(cmd="read", key="smoke", mode="quorum", max_lag=8)
+        assert q["quorum"] and q["matches"] >= q["need"] >= 2 and q["found"]
+        miss = ctl.call(cmd="read", key="never-written", mode="quorum",
+                        max_lag=8)
+        assert miss["quorum"] and miss["found"] is False
+        assert cluster.heights()[leader] == height_before, (
+            "a read must never produce a consensus decision"
+        )
+        w = ctl.call(cmd="watch", prefix="smoke")
+        cluster.submit(leader, "smoke", "req-watched")
+        cluster.wait_committed(total + 2, timeout=30.0, nodes=[leader])
+        polled = ctl.call(cmd="watch_poll", watch_id=w["watch_id"])
+        assert polled["dropped"] == 0
+        assert any(e["key"] == "smoke" for e in polled["events"])
+        assert ctl.call(cmd="unwatch", watch_id=w["watch_id"])["ok"]
+        served = ctl.call(cmd="stats")["read"]
+        assert served["served"] >= 4 and served["sheds"] == 0
+
 
 @pytest.mark.slow
 def test_tcp_multiprocess_commits(tmp_path):

@@ -144,9 +144,11 @@ def test_verify_snapshot_accepts_clean_and_names_each_failure():
     hist = _History(8)
     manifest, blob = hist.manifest(8)
     assert verify_snapshot(manifest, blob, QUORUM, MEMBERS) is None
-    # tampered state blob
+    # tampered state blob (bit-FLIP the last byte: the AppState tail is
+    # empty-list zero bytes since the kv fields landed, so writing a
+    # constant could be a no-op)
     assert "digest mismatch" in verify_snapshot(
-        manifest, blob[:-1] + b"\x00", QUORUM, MEMBERS)
+        manifest, blob[:-1] + bytes([blob[-1] ^ 0xFF]), QUORUM, MEMBERS)
     # truncated state blob (size check fires first)
     assert "size mismatch" in verify_snapshot(
         manifest, blob[:-1], QUORUM, MEMBERS)
@@ -251,11 +253,15 @@ def test_snapshot_store_atomic_save_gc_and_torn_file_skip(tmp_path):
         fh.truncate(os.path.getsize(path16) // 2)
     assert store.latest() is None
     assert store.rejected_files >= 1
-    # tampered bytes are equally rejected
+    # tampered bytes are equally rejected (bit-FLIP the last byte — the
+    # AppState tail is empty-list zero bytes since the kv fields landed,
+    # so writing a constant could be a no-op)
     store.save(m16, b16)
     with open(path16, "r+b") as fh:
         fh.seek(-1, os.SEEK_END)
-        fh.write(b"\x00")
+        last = fh.read(1)
+        fh.seek(-1, os.SEEK_END)
+        fh.write(bytes([last[0] ^ 0xFF]))
     assert store.latest() is None
     # refusing to WRITE an inconsistent snapshot in the first place
     with pytest.raises(SnapshotError):
