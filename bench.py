@@ -917,6 +917,25 @@ def byzantine_bench() -> None:
     _emit(assemble_byzantine_row(healthy, degraded))
 
 
+def selfdrive_bench() -> None:
+    """Run the self-driving control-plane storm round (ISSUE 20): one
+    ``remediation_storm_round`` — load spike, verify-engine hang, muted
+    leader — with the verdict→action controller live, emitting the
+    ``selfdrive_actions_per_fault`` and ``selfdrive_oscillation_reversals``
+    guard rows.  The baseline pins actions-per-fault at the measured 1.0
+    (trips past 2, the anti-thrash bound) and reversals at zero (any
+    A→B→A flip inside one hysteresis window regresses)."""
+    import asyncio
+
+    from smartbft_tpu.obs.benchschema import assemble_selfdrive_rows
+    from smartbft_tpu.testing.chaos import remediation_storm_round
+
+    seed = int(os.environ.get("SMARTBFT_BENCH_SELFDRIVE_SEED", "1"))
+    stats = asyncio.run(remediation_storm_round(seed=seed, verbose=False))
+    for row in assemble_selfdrive_rows(stats):
+        _emit(row)
+
+
 def mixed_read_bench() -> None:
     """Run benchmarks/readplane.py (ISSUE 19): the mixed 95/5 read/write
     sweep against the live socket cluster (quorum-read p99 next to the
@@ -999,6 +1018,15 @@ def main() -> None:
              "byzantine_forge_p99_ms row the baseline bounds",
     )
     ap.add_argument(
+        "--selfdrive", action="store_true",
+        default=os.environ.get("SMARTBFT_BENCH_SELFDRIVE", "") == "1",
+        help="additionally run the self-driving control-plane storm "
+             "round (testing.chaos.remediation_storm_round): spike + "
+             "engine hang + muted leader with the verdict→action "
+             "controller live, emitting the selfdrive_actions_per_fault "
+             "and selfdrive_oscillation_reversals guard rows",
+    )
+    ap.add_argument(
         "--mixed-read", action="store_true",
         default=os.environ.get("SMARTBFT_BENCH_MIXED_READ", "") == "1",
         help="additionally run the read-plane bench (benchmarks/"
@@ -1065,6 +1093,12 @@ def main() -> None:
             byzantine_bench()
         except Exception as exc:  # noqa: BLE001 — byzantine row is additive
             _log(f"bench: byzantine probe failed ({type(exc).__name__}: {exc})")
+
+    if args.selfdrive:
+        try:
+            selfdrive_bench()
+        except Exception as exc:  # noqa: BLE001 — selfdrive rows are additive
+            _log(f"bench: selfdrive storm failed ({type(exc).__name__}: {exc})")
 
     if args.mixed_read:
         try:

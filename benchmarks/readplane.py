@@ -288,6 +288,16 @@ def main() -> int:
             raise RuntimeError(f"storm starved writes: {storm}")
         stats = cluster.control(cluster.live_ids()[0]).call(cmd="stats")
         read_block = stats.get("read") or {}
+        # pooled control-channel economics (ISSUE 20): every probe above
+        # rode the persistent per-replica connection — reuse_fraction
+        # near 1.0 is the pin that the bench itself is not paying a
+        # connect per call
+        chan = cluster.control_stats()
+        read_block["control_channel"] = chan
+        _log(f"readplane: control channel {chan['calls']} calls over "
+             f"{chan['connects']} connects "
+             f"(reuse {chan['reuse_fraction']:.3f}, "
+             f"{chan['reconnects']} reconnects)")
     finally:
         cluster.stop()
         shutil.rmtree(root, ignore_errors=True)

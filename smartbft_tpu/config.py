@@ -385,6 +385,42 @@ class Configuration:
     read_watch_buffer: int = 256
     read_max_watches: int = 64
 
+    # The self-driving control plane (smartbft_tpu/control/ — ISSUE 20,
+    # the verdict→action reflex arc).  Consumed by ControlPolicy /
+    # ControlLoop; round-tripped by ConfigMirror so a reconfiguration
+    # retunes the controller itself along with everything else.
+    # - control_interval: seconds between controller ticks.
+    # - control_cooldown: per-ACTION cooldown (scale_out, scale_in and
+    #   retune each have their own clock); re-armed on failure too.
+    # - control_hysteresis: window within which an action that UNDOES a
+    #   recent one (scale-in after scale-out, a knob flipped back to its
+    #   previous value) is vetoed — the anti-oscillation guard.
+    # - control_idle_hold: sustained-idle seconds before scale-in fires.
+    # - control_budget_actions / control_budget_window: global anti-thrash
+    #   budget — at most N actions of ANY kind per window.
+    # - control_knob_deadband: relative change a derived knob must exceed
+    #   before a retune commits it (EWMA jitter must not reconfigure the
+    #   cluster).
+    # - control_forward_rtt_multiplier: derived request_forward_timeout =
+    #   multiplier x measured transport RTT EWMA (clamped to the
+    #   boot-time value; PR 15's request_forward_rtt_multiplier pattern,
+    #   but COMMITTED through reconfig rather than applied locally).
+    # - control_hold_commit_multiplier: derived verify_flush_hold =
+    #   multiplier x commit inter-arrival EWMA.
+    # - control_outbox_drain_window: derived transport_outbox_cap =
+    #   measured pool drain rate x this window (seconds of backlog the
+    #   outbox may hold).
+    control_interval: float = 1.0
+    control_cooldown: float = 30.0
+    control_hysteresis: float = 120.0
+    control_idle_hold: float = 60.0
+    control_budget_actions: int = 4
+    control_budget_window: float = 300.0
+    control_knob_deadband: float = 0.25
+    control_forward_rtt_multiplier: float = 8.0
+    control_hold_commit_multiplier: float = 0.5
+    control_outbox_drain_window: float = 2.0
+
     def validate(self) -> None:
         def positive(name: str) -> None:
             v = getattr(self, name)
@@ -420,8 +456,30 @@ class Configuration:
             "reshard_drain_deadline",
             "autoscale_cooldown",
             "request_batch_fill_slack",
+            "control_interval",
+            "control_cooldown",
+            "control_hysteresis",
+            "control_budget_window",
+            "control_outbox_drain_window",
         ):
             positive(field)
+        if self.control_idle_hold < 0:
+            raise ConfigError("control_idle_hold should not be negative")
+        if self.control_budget_actions < 1:
+            raise ConfigError("control_budget_actions should be at least 1")
+        if not (0.0 <= self.control_knob_deadband < 1.0):
+            raise ConfigError(
+                "control_knob_deadband should be in [0, 1), got "
+                f"{self.control_knob_deadband}"
+            )
+        if self.control_forward_rtt_multiplier < 0:
+            raise ConfigError(
+                "control_forward_rtt_multiplier should not be negative"
+            )
+        if self.control_hold_commit_multiplier < 0:
+            raise ConfigError(
+                "control_hold_commit_multiplier should not be negative"
+            )
         if not (0.0 < self.autoscale_low_occupancy
                 < self.autoscale_high_occupancy <= 1.0):
             raise ConfigError(

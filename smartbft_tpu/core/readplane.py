@@ -123,6 +123,27 @@ def follower_read_accept(reply, frontier_seq: int,
     return frontier_seq - anchor <= max_lag_decisions
 
 
+def session_retry_after_ms(height: int, min_height: int,
+                           commit_gap_s: Optional[float],
+                           *, floor_ms: int = 10,
+                           cap_ms: int = 5000) -> int:
+    """Retry-after hint for a read-your-write miss (ISSUE 20 satellite).
+
+    A follower asked to serve at ``min_height`` (the session token a
+    write ack carried) while still at ``height`` estimates when it will
+    have caught up: the decision gap times the replica's measured commit
+    inter-arrival EWMA (``commit_gap_s``; None/0 when idle — then the
+    floor applies, since catch-up may be one wire-sync away).  Clamped
+    to ``[floor_ms, cap_ms]`` so a huge gap never tells a client to go
+    away for minutes.  Pure — the shed-reply retry-after discipline
+    (Pool drain rate, TokenBucket) applied to session reads."""
+    gap = max(0, int(min_height) - int(height))
+    if gap == 0:
+        return 0
+    est_s = gap * (commit_gap_s or 0.0)
+    return max(floor_ms, min(cap_ms, int(est_s * 1000)))
+
+
 class TokenBucket:
     """The per-replica read gate: ``rate`` tokens/second refill up to
     ``burst``.  ``allow()`` spends one token or refuses; ``retry_after``

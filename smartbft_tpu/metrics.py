@@ -843,6 +843,29 @@ class LogScaleHistogram:
                 return min(max(mid, self.min_seen), self.max_seen)
         return self.max_seen
 
+    def delta_quantile(self, q: float, baseline: list) -> float:
+        """The q-quantile of the observations recorded SINCE ``baseline``
+        (a prior copy of ``buckets``) — the recency window a cumulative
+        histogram cannot otherwise express.  Same-geometry buckets
+        subtract element-wise exactly, so this is the true distribution
+        of the delta; the [min, max] clamp uses the lifetime envelope
+        (per-window extremes are not tracked — ≤ one bucket of extra
+        slack at the edges).  0.0 when nothing landed since the
+        baseline.  A health plane needs this: a verdict judged on the
+        lifetime p99 can never clear after one bad spell."""
+        counts = [n - b for n, b in zip(self.buckets, baseline)]
+        total = sum(counts)
+        if total <= 0:
+            return 0.0
+        rank = max(1, int(q * total + 0.999999))
+        seen = 0
+        for i, n in enumerate(counts):
+            seen += n
+            if seen >= rank:
+                mid = self.low * (self.growth ** (i + 0.5))
+                return min(max(mid, self.min_seen), self.max_seen)
+        return self.max_seen
+
     def snapshot(self) -> dict:
         """JSON-able percentile block (milliseconds, the service unit)."""
         ms = 1e3

@@ -57,6 +57,7 @@ from ..core.readplane import (
     TokenBucket,
     follower_read_accept,
     quorum_read_decide,
+    session_retry_after_ms,
 )
 from ..core.util import compute_quorum
 from ..messages import Proposal, Signature, ViewMetadata
@@ -1523,7 +1524,23 @@ class ControlServer:
                     "occupancy": r.consensus.pool_occupancy(),
                     "error": f"SubmitTimeoutError: {e}",
                 }
-            return {"ok": True}
+            # Read-your-write session token (ISSUE 20 satellite): the ack
+            # carries a height the client can hand to cmd=read
+            # mode=follower as min_height.  The pooled height is only a
+            # lower bound (the request is admitted, not yet ordered);
+            # wait_committed_s > 0 parks until THIS request is committed
+            # locally and returns the height that provably covers it.
+            wait_s = float(req.get("wait_committed_s", 0.0))
+            committed = False
+            if wait_s > 0:
+                rid = f"{req['client']}:{req['rid']}"
+                deadline = asyncio.get_event_loop().time() + wait_s
+                while asyncio.get_event_loop().time() < deadline:
+                    if rid in r.committed_ids():
+                        committed = True
+                        break
+                    await asyncio.sleep(0.01)
+            return {"ok": True, "height": r.height(), "committed": committed}
         if cmd == "height":
             pool = r.consensus.pool_occupancy() if r.consensus else {}
             return {"ok": True, "height": r.height(),
@@ -1667,11 +1684,36 @@ class ControlServer:
         if mode == "quorum":
             return await self._quorum_read(key, max_lag)
         at_base = bool(req.get("at_base", False))
+        min_height = int(req.get("min_height", 0))
+        if mode == "follower" and min_height > 0:
+            # Read-your-write session frontier (ISSUE 20 satellite): the
+            # client hands back the height token its write ack carried.
+            # A replica still behind it PARKS briefly (park_s, bounded)
+            # for the commit to arrive; if it is still behind on wake it
+            # answers a structured "stale" with a commit-gap-derived
+            # retry-after hint — never a silently stale value.
+            park_s = min(float(req.get("park_s", 0.25)), 5.0)
+            deadline = asyncio.get_event_loop().time() + park_s
+            while (r.height() + max_lag < min_height
+                   and asyncio.get_event_loop().time() < deadline):
+                await asyncio.sleep(0.01)
+            height = r.height()
+            if height + max_lag < min_height:
+                frontier = (r.consensus.delivery_frontier()
+                            if r.consensus is not None else {})
+                return {
+                    "ok": True, "accepted": False, "stale": True,
+                    "height": height, "min_height": min_height,
+                    "max_lag": max_lag,
+                    "retry_after_ms": session_retry_after_ms(
+                        height, min_height, frontier.get("commit_gap_s")
+                    ),
+                }
         reply = r._serve_read(ReadRequest(nonce=0, key=key, at_base=at_base))
         out = _reply_dict(reply)
         out["ok"] = True
         if mode == "follower":
-            frontier = int(req.get("frontier", r.height()))
+            frontier = int(req.get("frontier", min_height or r.height()))
             out["accepted"] = follower_read_accept(reply, frontier, max_lag)
             out["frontier"] = frontier
             out["max_lag"] = max_lag

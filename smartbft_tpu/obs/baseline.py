@@ -55,8 +55,11 @@ __all__ = [
 #: regression the commit-path guard rows exist to catch)
 #: ("x" is the ratio unit of the rejoin flatness guard — deep-history
 #: rejoin wall over shallow, where growing IS the regression)
+#: ("actions/fault" and "count" are the self-driving controller's guard
+#: units — more remediations per fault, or any oscillation reversal,
+#: means the control plane got twitchier)
 LOWER_IS_BETTER_UNITS = {"ms", "us", "us/sig", "logical_ms", "s", "share",
-                         "x"}
+                         "x", "actions/fault", "count"}
 
 #: host-weather fields carried into the baseline verbatim — the context a
 #: future reader needs to judge whether two rounds are comparable at all
@@ -79,6 +82,14 @@ FAMILY_THRESHOLD_PCT = {
     # the ISSUE 19 acceptance is scaling strictly above 1.0; pinned at
     # the measured ~2.17x for n=8/n=4, 45% still fails below ~1.2x
     "read_scaling_vs_n": 45.0,
+    # ISSUE 20: pinned at the measured 1.0 action/fault; 100% allowance
+    # means the guard trips only past 2 actions per injected fault (the
+    # anti-thrash acceptance bound)
+    "selfdrive_*": 100.0,
+    # baseline 0 makes ANY reversal a flat 100% delta; the threshold
+    # must sit strictly BELOW 100 (check is delta > threshold) so one
+    # flip-flop fails.  Exact family, wins over the wildcard.
+    "selfdrive_oscillation_reversals": 50.0,
 }
 
 

@@ -28,6 +28,7 @@ from typing import Optional
 
 __all__ = ["SCHEMA_VERSION", "ROW_SCHEMAS", "assemble_rejoin_row",
            "assemble_read_row", "assemble_read_scaling_row",
+           "assemble_selfdrive_rows",
            "identify_row", "validate_row", "validate_rows"]
 
 #: bump when a row family's required shape changes incompatibly
@@ -104,6 +105,18 @@ _PROTOCOL_PLANE = {
     "optional": {"broadcasts": _NUM, "sends": _NUM, "encodes": _NUM,
                  "decodes": _NUM, "batch_ingests": _NUM,
                  "msgs_ingested": _NUM},
+}
+
+#: shared shape of the ISSUE 20 self-driving controller guard rows
+_SELFDRIVE_ROW = {
+    "required": {"metric": _STR, "value": _NUM, "unit": _STR,
+                 "faults": _NUM, "actions": _NUM},
+    "optional": {"actions_ok": _NUM, "scale_out": _NUM,
+                 "scale_in": _NUM, "retune": _NUM, "vetoes": _DICT,
+                 "final_status": _STR, "fill_at_scale_out": _NUM,
+                 "peak_fill": _NUM, "ctl_spans": _NUM,
+                 "clear_spans": _NUM, "seed": _NUM,
+                 "verdict_samples": _NUM},
 }
 
 ROW_SCHEMAS: dict = {
@@ -277,6 +290,16 @@ ROW_SCHEMAS: dict = {
                      "per_replica_rate_large": _NUM,
                      "rate_flatness": _NUM, "ideal": _NUM},
     },
+    # assemble_selfdrive_rows (ISSUE 20) — the controller's behavior
+    # under the remediation_storm chaos round: actions taken per injected
+    # fault (unit "actions/fault", lower is better — a thrashing
+    # controller fails this long before it breaks safety) and A→B→A
+    # oscillation reversals inside one hysteresis window (unit "count",
+    # pinned at 0 so ANY flip-flop regresses the baseline).  The
+    # oscillation row is listed as an EXACT family so it wins over the
+    # wildcard and can carry its own (tighter) baseline threshold.
+    "selfdrive_*": _SELFDRIVE_ROW,
+    "selfdrive_oscillation_reversals": _SELFDRIVE_ROW,
     # obs.baseline.tiny_logical_row — the tier-1 regression-gate row
     # (value = mean logical commit latency; percentiles ride in "latency")
     "tiny_logical_commit_ms": {
@@ -399,6 +422,57 @@ def assemble_read_scaling_row(*, per_replica_rate_small: float,
             per_replica_rate_large / per_replica_rate_small, 4),
         "ideal": round(nodes_large / nodes_small, 4),
     }
+
+
+def assemble_selfdrive_rows(stats: dict) -> list:
+    """The ``selfdrive_*`` bench rows (ISSUE 20), as a PURE function over
+    the stats dict :func:`remediation_storm_round` returns, so the tier-1
+    schema gate can validate synthetic rows without running the ~20s
+    chaos round.  Two rows: ``selfdrive_actions_per_fault`` (how many
+    remediations the controller spent per injected fault — the
+    anti-thrash pin) and ``selfdrive_oscillation_reversals`` (A→B→A
+    flips inside one hysteresis window — pinned at zero)."""
+    faults = int(stats.get("faults", 0))
+    actions = int(stats.get("actions", 0))
+    if faults <= 0:
+        raise ValueError(f"faults must be positive, got {faults}")
+    if actions < 0:
+        raise ValueError(f"actions must be >= 0, got {actions}")
+    reversals = int(stats.get("reversals", 0))
+    common = {
+        "faults": faults,
+        "actions": actions,
+        "actions_ok": int(stats.get("actions_ok", actions)),
+        "scale_out": int(stats.get("scale_out", 0)),
+        "scale_in": int(stats.get("scale_in", 0)),
+        "retune": int(stats.get("retune", 0)),
+    }
+    apf_row = {
+        "metric": "selfdrive_actions_per_fault",
+        "value": round(actions / faults, 4),
+        "unit": "actions/fault",
+        **common,
+    }
+    rev_row = {
+        "metric": "selfdrive_oscillation_reversals",
+        "value": float(reversals),
+        "unit": "count",
+        **common,
+    }
+    for key in ("vetoes", "final_status", "fill_at_scale_out", "peak_fill",
+                "ctl_spans", "clear_spans", "seed", "verdict_samples"):
+        val = stats.get(key)
+        if val is None:
+            continue
+        if key == "vetoes":
+            apf_row[key] = dict(val)
+        elif key == "final_status":
+            apf_row[key] = str(val)
+        elif key in ("fill_at_scale_out", "peak_fill"):
+            apf_row[key] = round(float(val), 4)
+        else:
+            apf_row[key] = int(val)
+    return [apf_row, rev_row]
 
 
 def identify_row(row: dict) -> Optional[str]:

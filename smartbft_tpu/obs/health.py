@@ -265,13 +265,28 @@ def read_signal_source(stats_fn: Callable[[], dict], *, clock=None,
 
 
 def latency_signal_source(tracker) -> Callable:
-    """``latency.commit_p99_ms`` from a CommitLatencyTracker aggregate."""
+    """``latency.commit_p99_ms`` from a CommitLatencyTracker — the p99 of
+    commits landed SINCE THE LAST TICK (ISSUE 20).  The lifetime
+    aggregate is the wrong verdict input: one bad spell dominates its
+    p99 forever, so a breach could never clear and the control plane
+    would remediate history.  Per-tick deltas give the SLO evaluator
+    fresh samples; its own fast/slow windows provide the smoothing.  A
+    tick with no new commits emits nothing (no signal ≠ zero latency)."""
+    state = {"buckets": None}
 
     def signals() -> dict:
         hist = tracker.aggregate
         if not hist.count:
             return {}
-        return {"latency.commit_p99_ms": hist.quantile(0.99) * 1e3}
+        if state["buckets"] is None:
+            # first sight: lifetime p99 seeds the window (no baseline yet)
+            state["buckets"] = list(hist.buckets)
+            return {"latency.commit_p99_ms": hist.quantile(0.99) * 1e3}
+        p99 = hist.delta_quantile(0.99, state["buckets"])
+        if p99 <= 0.0:
+            return {}
+        state["buckets"] = list(hist.buckets)
+        return {"latency.commit_p99_ms": p99 * 1e3}
 
     return signals
 

@@ -145,42 +145,59 @@ async def run_autoscaler(shard_set, autoscaler: OccupancyAutoscaler, *,
                          interval: float = 1.0,
                          stop: Optional[asyncio.Event] = None,
                          on_reshard: Optional[Callable] = None,
+                         arbiter=None,
                          logger=None) -> int:
     """The autoscaler loop: poll occupancy, execute decisions, never die.
 
     ``make_shard(shard_id, epoch)`` builds new groups on scale-out (the
     embedder's factory, same as ``ShardSet.reshard``).  ``on_reshard``
     (optional, sync) observes each completed transition summary — the
-    harness uses it to refresh its shard list.  Runs until ``stop`` is
-    set (required for bounded runs; pass ``asyncio.Event()``), returning
-    the number of reshards executed."""
+    harness uses it to refresh its shard list.  ``arbiter`` (a
+    :class:`~smartbft_tpu.control.TransitionArbiter`, shared with any
+    :class:`~smartbft_tpu.control.ControlLoop` on the same set) makes the
+    two transition initiators mutually exclusive: the old
+    check-``reshard_in_progress``-then-reshard sequence was a TOCTOU —
+    the controller could start a reshard between this loop's check and
+    its own ``reshard`` call, double-transitioning the epoch.  The
+    arbiter is acquired BEFORE evaluate and released after the
+    transition completes (or fails), closing that window.  Runs until
+    ``stop`` is set (required for bounded runs; pass ``asyncio.Event()``),
+    returning the number of reshards executed."""
     stop = stop or asyncio.Event()
     executed = 0
     while not stop.is_set():
-        if not shard_set.reshard_in_progress:
-            target = autoscaler.evaluate(
-                shard_set.occupancy(), shard_set.num_shards
-            )
-            if target is not None:
-                autoscaler.note_action()
-                try:
-                    summary = await shard_set.reshard(
-                        target, make_shard=make_shard
+        held = arbiter is None or arbiter.try_acquire("autoscaler")
+        if held:
+            try:
+                if not shard_set.reshard_in_progress:
+                    target = autoscaler.evaluate(
+                        shard_set.occupancy(), shard_set.num_shards
                     )
-                    executed += 1
-                    if on_reshard is not None:
-                        on_reshard(summary)
-                except asyncio.CancelledError:
-                    raise
-                except Exception as e:  # noqa: BLE001 — the loop's contract
-                    # is "execute decisions, never die": a drain abort
-                    # (ShardEpochError), a missing make_shard (ValueError
-                    # on scale-out), or a transient group-start failure
-                    # must not kill future evaluations; the cooldown is
-                    # already re-armed above
-                    if logger is not None:
-                        logger.warnf("autoscale reshard to %d failed: %r",
-                                     target, e)
+                    if target is not None:
+                        autoscaler.note_action()
+                        try:
+                            summary = await shard_set.reshard(
+                                target, make_shard=make_shard
+                            )
+                            executed += 1
+                            if on_reshard is not None:
+                                on_reshard(summary)
+                        except asyncio.CancelledError:
+                            raise
+                        except Exception as e:  # noqa: BLE001 — the loop's
+                            # contract is "execute decisions, never die": a
+                            # drain abort (ShardEpochError), a missing
+                            # make_shard (ValueError on scale-out), or a
+                            # transient group-start failure must not kill
+                            # future evaluations; the cooldown is already
+                            # re-armed above
+                            if logger is not None:
+                                logger.warnf(
+                                    "autoscale reshard to %d failed: %r",
+                                    target, e)
+            finally:
+                if arbiter is not None:
+                    arbiter.release("autoscaler")
         # wake promptly on stop, tick on interval otherwise
         try:
             await asyncio.wait_for(stop.wait(), timeout=interval)

@@ -538,6 +538,7 @@ class ShardSet:
 
         latch = EventLatch(5.0)
         clock = clock or _time.monotonic
+        lat_state = {"buckets": None}
 
         def signals() -> dict:
             sig = self.health_signals()
@@ -545,6 +546,24 @@ class ShardSet:
             sig["pool.shed_recent"] = latch.update(
                 shed_total, 1.0, clock()
             )
+            # recency window over the latency signal (ISSUE 20): the
+            # verdict judges the p99 of commits landed since the LAST
+            # tick, not the lifetime aggregate — a cumulative p99 never
+            # clears after one bad spell, so a controller acting on it
+            # would remediate history (obs.health.latency_signal_source
+            # applies the same rule per replica)
+            hist = self.latency.aggregate
+            sig.pop("latency.commit_p99_ms", None)
+            if hist.count:
+                if lat_state["buckets"] is None:
+                    lat_state["buckets"] = list(hist.buckets)
+                    sig["latency.commit_p99_ms"] = \
+                        hist.quantile(0.99) * 1e3
+                else:
+                    p99 = hist.delta_quantile(0.99, lat_state["buckets"])
+                    if p99 > 0.0:
+                        lat_state["buckets"] = list(hist.buckets)
+                        sig["latency.commit_p99_ms"] = p99 * 1e3
             return sig
 
         return signals

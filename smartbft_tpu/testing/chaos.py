@@ -1269,6 +1269,40 @@ class Invariants:
             f"unbalanced breaker transitions after heal: {snap}"
         )
 
+    @staticmethod
+    def remediation_quiet(
+        decisions, windows, grace: float = 0.0
+    ) -> None:
+        """Self-driving runs (ISSUE 20): every controller ACTION fell
+        inside an injected-fault window (``grace`` extends each window's
+        tail for the recovery it triggered).  ``decisions`` is the
+        policy's acted-only log ``[(t, action, reason)]``; a controller
+        that acts on a healthy, unfaulted cluster is hallucinating
+        work — the steady state must be silence."""
+        stray = [
+            (round(t, 2), action, why)
+            for (t, action, why) in decisions
+            if not any(a <= t <= b + grace for (a, b) in windows)
+        ]
+        assert not stray, (
+            f"controller acted outside every fault window "
+            f"{[(round(a, 1), round(b, 1)) for (a, b) in windows]}: {stray}"
+        )
+
+    @staticmethod
+    def no_flip_flop(decisions, window: float) -> None:
+        """No A→B→A scale oscillation inside the hysteresis window —
+        the Mir-BFT thrash lesson, counted by the SAME pure function the
+        bench row reports so the invariant and the baseline guard cannot
+        drift apart."""
+        from ..control.policy import count_reversals
+
+        flips = count_reversals(list(decisions), window)
+        assert flips == 0, (
+            f"{flips} scale reversal(s) within {window}s hysteresis: "
+            f"{[(round(t, 2), a) for (t, a, _r) in decisions]}"
+        )
+
     @classmethod
     def check_all(
         cls,
@@ -1657,6 +1691,369 @@ async def openloop_soak(
                     f"spike_p99={phases['spike']['p99_ms']}ms "
                     f"recovery_p99={phases['recovery']['p99_ms']}ms — OK"
                 )
+
+
+# ---------------------------------------------------------------------- selfdrive
+
+async def _advance_clock(cluster, seconds: float, step: float = 0.05) -> None:
+    """Advance the logical clock (polling commits) without offering load
+    or ticking the controller."""
+    t_end = cluster.scheduler.now() + seconds
+    while cluster.scheduler.now() < t_end:
+        cluster.scheduler.advance_by(step)
+        await asyncio.sleep(0.001)
+        cluster.poll()
+
+
+async def _drive_segments(
+    cluster, ctl, *, rate: float, duration: float, seg: float = 0.5,
+    seed: int = 0, prefix: str = "sd", samples=None, fills=None,
+) -> None:
+    """Drive open-loop arrivals in SEGMENTS of the logical clock with at
+    most ONE controller step in flight between segments.
+
+    A step that decides to scale must await ``ShardSet.reshard``, whose
+    drain needs the clock to keep advancing — so the step runs as a
+    background task while the next segment advances time, and is drained
+    (errors propagated) before this helper returns.  ``samples`` collects
+    ``(t, verdict_status, decision_status)`` per tick; ``fills`` collects
+    ``(t, combined_pool_fill)`` per segment — the before-the-knee
+    evidence."""
+    from .app import wait_for
+    from .load import run_open_loop
+
+    async def _step():
+        rem = await ctl.step()
+        if samples is not None:
+            samples.append((
+                rem.at, rem.__dict__.get("_verdict_status", ""), rem.status,
+            ))
+
+    step_task = None
+    nseg = max(1, int(round(duration / seg)))
+    for k in range(nseg):
+        await run_open_loop(
+            cluster, rate=rate, duration=seg, seed=seed * 4096 + k,
+            request_prefix=f"{prefix}{k}",
+        )
+        if fills is not None:
+            fills.append((
+                cluster.scheduler.now(),
+                float(cluster.set.occupancy().get("fill", 0.0)),
+            ))
+        if step_task is not None and step_task.done():
+            step_task.result()
+            step_task = None
+        if step_task is None:
+            step_task = create_logged_task(_step(), name="ctl-step")
+    if step_task is not None:
+        await wait_for(lambda: step_task.done(), cluster.scheduler, 180.0)
+        step_task.result()
+
+
+async def remediation_storm_round(
+    *, seed: int = 1, shards: int = 2, n: int = 4, depth: int = 2,
+    spike_rate: float = 1200.0, verbose: bool = True,
+) -> dict:
+    """One rotating-fault round against the self-driving control plane
+    (ISSUE 20): load spike past the knee → engine hang→heal → muted
+    leader, all on the logical clock.  The controller must scale out on
+    the commit-latency burn BEFORE occupancy saturates, scale back in on
+    sustained idle, veto while the breaker owns the hang, and answer the
+    view-change breach with a derived-knob retune through the ordered
+    reconfig path — with ZERO actions outside the fault windows, zero
+    A→B→A flips, and every action a ``ctl.remediate`` span."""
+    import tempfile
+
+    from ..control import ControlLoop
+    from ..obs.slo import default_slo_spec
+    from .app import wait_for
+    from .sharded import ShardedCluster, sharded_config
+
+    pool_size = 4096
+    cfg = lambda s, i: sharded_config(
+        i, depth=depth,
+        request_pool_size=pool_size,
+        admission_high_water=1.0,
+        request_pool_submit_timeout=30.0,
+        request_batch_max_count=8,
+        # verify_flush_hold's derivation ceiling is the batch interval,
+        # and the hold is WALL-clock: keep it small so the retuned hold
+        # cannot inflate LOGICAL commit latency under compressed time
+        request_batch_max_interval=0.01,
+        # long protocol timers: an engine stall must not read as a dead
+        # leader (the breaker is the remedy there, not a view change)
+        request_forward_timeout=120.0,
+        request_complain_timeout=240.0,
+        request_auto_remove_timeout=480.0,
+        leader_heartbeat_timeout=30.0,
+        view_change_resend_interval=15.0,
+        view_change_timeout=60.0,
+        # device-plane fault policy (wall clock, as in sharded_soak)
+        verify_launch_timeout=0.15, verify_launch_retries=2,
+        verify_breaker_threshold=3, verify_probe_interval=0.05,
+        # compressed reflex-arc knobs (logical seconds)
+        control_interval=0.5,
+        control_cooldown=20.0,
+        control_hysteresis=12.0,
+        control_idle_hold=5.0,
+        control_budget_actions=6,
+        control_budget_window=60.0,
+        autoscale_min_shards=shards,
+        autoscale_max_shards=shards + 2,
+    )
+    # Tight SLO windows so breach/clear cycles fit a compressed round;
+    # the latency bound sits far above trickle latency and far below the
+    # spike's queueing delay.
+    spec = default_slo_spec(
+        fast_window_s=2.0, slow_window_s=20.0,
+    ).with_overrides(**{"latency.commit_p99_ms": 1500.0})
+
+    with tempfile.TemporaryDirectory(prefix="chaos-selfdrive-") as root:
+        cluster = ShardedCluster(
+            root, shards=shards, n=n, depth=depth, engine_faults=True,
+            config_fn=cfg, seed=seed, trace=True, collect_entries=True,
+            slo_spec=spec,
+        )
+        await cluster.start()
+        try:
+            ctl = ControlLoop(cluster)
+            sched = cluster.scheduler
+            samples: list = []
+            fills: list = []
+            windows: list = []
+
+            async def drive(rate, dur, pfx, sd):
+                await _drive_segments(
+                    cluster, ctl, rate=rate, duration=dur, seed=sd,
+                    prefix=pfx, samples=samples, fills=fills,
+                )
+
+            # ---- warmup: healthy steady state, zero actions expected
+            await drive(4.0, 4.0, "wu", seed)
+            assert not ctl.executed, (
+                f"controller acted on a healthy cluster: {ctl.executed}"
+            )
+
+            # ---- fault 1: open-loop spike past the knee, then cooloff.
+            # The burn must draw scale-out while the pool is still far
+            # from its occupancy trip point; drained idle must draw the
+            # matching scale-in after hysteresis.
+            t0 = sched.now()
+            await drive(spike_rate, 6.0, "sp", seed + 7)
+            await drive(3.0, 26.0, "co", seed + 13)
+            windows.append((t0, sched.now()))
+            acts = list(ctl.executed)
+            assert acts and acts[0]["action"] == "scale_out" \
+                and acts[0]["cause"] == "latency.commit_p99_ms" \
+                and acts[0]["ok"], f"spike did not draw scale-out: {acts}"
+            before = [f for (tf, f) in fills if tf <= acts[0]["at"]]
+            fill_at_out = before[-1] if before else 0.0
+            assert fill_at_out < ctl.policy.high_occupancy, (
+                f"scale-out fired AFTER the knee: fill={fill_at_out} at "
+                f"t={acts[0]['at']}"
+            )
+            assert any(
+                e["action"] == "scale_in" and e["ok"] for e in acts
+            ), f"sustained idle never drew scale-in: {acts}"
+            assert cluster.set.num_shards == shards, cluster.set.num_shards
+
+            # ---- calm gap: out of window, must stay silent and green
+            await drive(3.0, 4.0, "g1", seed + 17)
+            n_gap1 = len(ctl.executed)
+            assert n_gap1 == len(acts), (
+                f"controller acted between faults: {ctl.executed[len(acts):]}"
+            )
+
+            # ---- fault 2: engine hang.  The breaker owns this outage:
+            # commits degrade to the host fallback, and the controller's
+            # scale-out candidate (the stall's latency burn) must be
+            # VETOED while the breaker is open.
+            t1 = sched.now()
+            cluster.engine.hang()
+            base_committed = [sh.committed() for sh in cluster.shard_list]
+            for s in range(cluster.set.num_shards):
+                await cluster.submit(
+                    cluster.client_for_shard(s), f"hg-{seed}-{s}a"
+                )
+                await cluster.submit(
+                    cluster.client_for_shard(s, 1), f"hg-{seed}-{s}b"
+                )
+            await wait_for(
+                lambda: all(
+                    sh.committed() >= b + 2
+                    for sh, b in zip(cluster.shard_list, base_committed)
+                ),
+                sched, 240.0,
+            )
+            assert cluster.coalescer.breaker_open, \
+                "engine hang never opened the verify breaker"
+            # Pull the fallback commits into the latency tracker so the
+            # flush tick SEES the stall's burn: the scale-out candidate
+            # it draws is exactly what the breaker veto must suppress.
+            cluster.poll()
+            veto0 = ctl.policy.counters["veto_breaker"]
+            for _ in range(2):
+                rem = await ctl.step()
+                samples.append((
+                    rem.at, rem.__dict__.get("_verdict_status", ""),
+                    rem.status,
+                ))
+            assert ctl.policy.counters["veto_breaker"] > veto0, (
+                f"breaker open did not veto: {ctl.policy.snapshot()}"
+            )
+            cluster.engine.heal()
+            await Invariants.breaker_recovered(cluster, timeout=10.0)
+            # Let the stall's latency samples age out of the fast SLO
+            # window before the reflex arc resumes ticking: the hang was
+            # the breaker's fault to fix, not a capacity problem.
+            await _advance_clock(cluster, 3.0)
+            await drive(3.0, 6.0, "g2", seed + 19)
+            windows.append((t1, sched.now()))
+            n_hang = len(ctl.executed)
+            assert n_hang == n_gap1, (
+                f"controller scaled on a device outage: "
+                f"{ctl.executed[n_gap1:]}"
+            )
+
+            # ---- fault 3: mute shard 0's leader.  Detection rides the
+            # heartbeat timer; the view-change breach must draw a RETUNE
+            # (derived knobs through the ordered reconfig stream), never
+            # a scale action.  Trickle goes to shard 1 only — the muted
+            # shard's clients have failed over.  Quiesce first: a tracked
+            # request still in shard 0's pool would ride out the whole
+            # view change and resurface as a bogus commit-latency burn.
+            await _advance_clock(cluster, 2.0)
+            t2 = sched.now()
+            sh0 = cluster.shard_list[0]
+            muted = sh0.mute_leader()
+            for k in range(40):
+                await _advance_clock(cluster, 1.0)
+                await cluster.submit(
+                    cluster.client_for_shard(1, k % 2), f"mu-{seed}-{k}"
+                )
+                rem = await ctl.step()
+                samples.append((
+                    rem.at, rem.__dict__.get("_verdict_status", ""),
+                    rem.status,
+                ))
+            sh0.unmute(muted)
+            retunes = [
+                e for e in ctl.executed[n_hang:] if e["action"] == "retune"
+            ]
+            assert retunes and all(e["ok"] for e in retunes), (
+                f"view-change breach drew no retune: {ctl.executed[n_hang:]}"
+            )
+            assert all(
+                e["action"] == "retune" for e in ctl.executed[n_hang:]
+            ), f"mute window drew a scale action: {ctl.executed[n_hang:]}"
+            assert ctl.current_config.verify_flush_hold > 0.0
+
+            def _retune_committed():
+                cluster.poll()
+                return any(
+                    "ctl-retune" in rid
+                    for e in cluster.delivered_entries
+                    for rid in e.request_ids
+                )
+
+            await wait_for(_retune_committed, sched, 120.0)
+            await drive(3.0, 5.0, "g3", seed + 23)
+            windows.append((t2, sched.now()))
+            n_mute = len(ctl.executed)
+
+            # ---- settle: healthy, idle, and nothing left to do
+            await drive(3.0, 4.0, "st", seed + 29)
+            assert len(ctl.executed) == n_mute, (
+                f"controller acted after all faults healed: "
+                f"{ctl.executed[n_mute:]}"
+            )
+
+            # ---- the reflex-arc invariants
+            stray_unhealthy = [
+                (round(t, 1), st) for (t, st, _d) in samples
+                if st != "healthy"
+                and not any(a <= t <= b + 1.0 for (a, b) in windows)
+            ]
+            assert not stray_unhealthy, (
+                f"SLO verdicts not green outside fault windows "
+                f"{[(round(a, 1), round(b, 1)) for (a, b) in windows]}: "
+                f"{stray_unhealthy}"
+            )
+            Invariants.remediation_quiet(
+                ctl.policy.decisions, windows, grace=1.0
+            )
+            Invariants.no_flip_flop(
+                ctl.policy.decisions, ctl.policy.hysteresis
+            )
+            cluster.check_invariants()
+            spans = [
+                e for e in cluster.trace_events()
+                if e.get("kind") == "ctl.remediate"
+            ]
+            assert len(spans) == len(ctl.executed) >= 3, (
+                f"{len(ctl.executed)} actions but {len(spans)} "
+                f"ctl.remediate spans"
+            )
+            clears = [
+                e for e in cluster.trace_events()
+                if e.get("kind") == "ctl.clear"
+            ]
+            assert clears, "no ctl.clear span closed a remediation arc"
+
+            pol = ctl.policy.snapshot()
+            peak_fill = max(f for (_tf, f) in fills)
+            stats = {
+                "seed": seed,
+                "faults": 3,
+                "actions": len(ctl.executed),
+                "actions_ok": sum(1 for e in ctl.executed if e["ok"]),
+                "scale_out": pol["counters"]["scale_out"],
+                "scale_in": pol["counters"]["scale_in"],
+                "retune": pol["counters"]["retune"],
+                "vetoes": {
+                    k: v for k, v in pol["counters"].items()
+                    if k.startswith("veto_") and v
+                },
+                "reversals": pol["reversals"],
+                "actions_per_fault": round(len(ctl.executed) / 3.0, 3),
+                "ctl_spans": len(spans),
+                "clear_spans": len(clears),
+                "verdict_samples": len(samples),
+                "final_status": samples[-1][1],
+                "peak_fill": round(peak_fill, 3),
+                "fill_at_scale_out": round(fill_at_out, 3),
+                "windows": [
+                    (round(a, 1), round(b, 1)) for (a, b) in windows
+                ],
+            }
+        finally:
+            await cluster.stop()
+    if verbose:
+        print(
+            f"selfdrive seed {seed}: actions={stats['actions']} "
+            f"(out={stats['scale_out']} in={stats['scale_in']} "
+            f"retune={stats['retune']}) "
+            f"fill@out={stats['fill_at_scale_out']} "
+            f"vetoes={stats['vetoes']} reversals={stats['reversals']} "
+            f"final={stats['final_status']} — OK"
+        )
+    return stats
+
+
+async def selfdrive_soak(
+    *, rounds: int = 2, seed: int = 1, depth: int = 2,
+    verbose: bool = True,
+) -> None:
+    """The ``--selfdrive`` remediation-storm soak: rotating faults on the
+    logical clock, the controller as the ONLY remediator (the harness
+    injects faults but never heals topology or knobs itself)."""
+    for r in range(rounds):
+        stats = await remediation_storm_round(
+            seed=seed + r, depth=depth, verbose=verbose
+        )
+        assert stats["actions_per_fault"] <= 2.0, stats
+        assert stats["reversals"] == 0, stats
 
 
 # ---------------------------------------------------------------------- byzantine
@@ -2189,6 +2586,16 @@ def main(argv: Optional[list[str]] = None) -> int:
              "dies mid-chunk; disk stays bounded, no poisoning, fork-free",
     )
     ap.add_argument(
+        "--selfdrive", action="store_true",
+        help="run the remediation-storm soak (ISSUE 20): rotating faults "
+             "(load spike past the knee, engine hang->heal, muted leader) "
+             "against the self-driving control plane; the controller must "
+             "scale out on the latency burn before the knee, retune knobs "
+             "through ordered reconfig, veto during breaker/transition "
+             "windows, and stay SILENT outside fault windows with zero "
+             "A->B->A oscillation",
+    )
+    ap.add_argument(
         "--byzantine", action="store_true",
         help="run the Byzantine actor matrix (ISSUE 18): equivocation, "
              "vote forgery, leader censorship, stale-view replay and sync "
@@ -2198,6 +2605,16 @@ def main(argv: Optional[list[str]] = None) -> int:
     args = ap.parse_args(argv)
     if not args.soak:
         ap.error("nothing to do: pass --soak")
+    if args.selfdrive:
+        asyncio.run(
+            selfdrive_soak(
+                rounds=min(args.rounds, 3),
+                depth=min(args.depth, 4),
+                seed=args.seed,
+            )
+        )
+        print("chaos soak (selfdrive): all rounds passed")
+        return 0
     if args.byzantine:
         asyncio.run(
             byzantine_soak(

@@ -41,6 +41,12 @@ _MS_FIELDS = (
     "transport_reconnect_backoff_max",
     "reshard_drain_deadline",
     "autoscale_cooldown",
+    "control_interval",
+    "control_cooldown",
+    "control_hysteresis",
+    "control_idle_hold",
+    "control_budget_window",
+    "control_outbox_drain_window",
 )
 
 # occupancy fractions travel as integer basis points (x/10000): the codec
@@ -60,6 +66,9 @@ _X1000_FIELDS = (
     "heartbeat_rtt_multiplier",
     "detection_backoff_base",
     "detection_backoff_max",
+    "control_knob_deadband",
+    "control_forward_rtt_multiplier",
+    "control_hold_commit_multiplier",
 )
 
 _INT_FIELDS = (
@@ -82,6 +91,7 @@ _INT_FIELDS = (
     "flip_drain_windows",
     "snapshot_interval_decisions",
     "snapshot_chunk_bytes",
+    "control_budget_actions",
 )
 
 # transport_listen is deliberately NOT mirrored: like self_id it is a
@@ -125,6 +135,7 @@ class ConfigMirror:
     flip_drain_windows: int = 4
     snapshot_interval_decisions: int = 0
     snapshot_chunk_bytes: int = 1024 * 1024
+    control_budget_actions: int = 4
     autoscale_high_occupancy_bp: int = 8500
     autoscale_low_occupancy_bp: int = 1500
     admission_high_water_bp: int = 10000
@@ -132,6 +143,9 @@ class ConfigMirror:
     heartbeat_rtt_multiplier_x1000: int = 0
     detection_backoff_base_x1000: int = 2000
     detection_backoff_max_x1000: int = 8000
+    control_knob_deadband_x1000: int = 250
+    control_forward_rtt_multiplier_x1000: int = 8000
+    control_hold_commit_multiplier_x1000: int = 500
     rotation_granularity: str = "decision"
     verify_mesh_topology: str = "1d"
     request_batch_max_interval_ms: int = 0
@@ -150,6 +164,12 @@ class ConfigMirror:
     transport_reconnect_backoff_max_ms: int = 2000
     reshard_drain_deadline_ms: int = 30000
     autoscale_cooldown_ms: int = 60000
+    control_interval_ms: int = 1000
+    control_cooldown_ms: int = 30000
+    control_hysteresis_ms: int = 120000
+    control_idle_hold_ms: int = 60000
+    control_budget_window_ms: int = 300000
+    control_outbox_drain_window_ms: int = 2000
     sync_on_start: bool = False
     speed_up_view_change: bool = False
     leader_rotation: bool = False

@@ -364,6 +364,7 @@ class ShardedCluster:
         journal: bool = True,
         trace: bool = False,
         trace_capacity: int = 4096,
+        slo_spec=None,
     ):
         """``crypto``: "trivial" | "p256" | "ed25519" | "toy" (see module
         docstring; "toy" is the real provider stack over the array-math
@@ -487,10 +488,15 @@ class ShardedCluster:
             lambda s, i: sharded_config(i, depth=depth, rotation=rotation)
         )
         self._config_fn = cfg
+        #: boot-time config (shard 0, node 1) — the control plane's
+        #: derivation envelope: knob retunes clamp to THESE ceilings, so
+        #: repeated self-tuning can never ratchet past the operator's
+        #: original settings (control.policy.derive_knobs)
+        self.base_config = cfg(0, 1)
         if reshard_drain_deadline is None:
             # the Configuration knob is the source of truth (reconfig
             # round-trips it); an explicit constructor arg still wins
-            reshard_drain_deadline = cfg(0, 1).reshard_drain_deadline
+            reshard_drain_deadline = self.base_config.reshard_drain_deadline
         self._crypto_for = crypto_for
         #: incarnation count per shard id — a retired-then-recreated id is
         #: a NEW consensus group with its own network namespace + WAL dirs
@@ -533,6 +539,7 @@ class ShardedCluster:
         from ..obs.health import HealthMonitor, coalescer_signal_source
 
         self.health = HealthMonitor(
+            slo_spec,
             clock=self.scheduler.now, node="cluster",
             recorder=recorder_for("set"),
         )
